@@ -1,0 +1,12 @@
+"""Host-side execution core: key interning, batching, the decision engine.
+
+This package replaces the reference's local execution engine
+(reference: gubernator_pool.go + lrucache.go): the worker pool becomes
+one vectorized device step, the per-worker LRU caches become a single
+host key→slot intern table fronting device-resident bucket state.
+"""
+
+from gubernator_tpu.core.engine import DecisionEngine
+from gubernator_tpu.core.interning import InternTable
+
+__all__ = ["DecisionEngine", "InternTable"]

@@ -1,0 +1,303 @@
+"""DecisionEngine — the local rate-limit execution engine.
+
+Replaces the reference's worker pool + per-key algorithm calls
+(reference: gubernator_pool.go:250-336 → algorithms.go) with:
+
+  host: key interning (key string → device slot) + batch assembly
+  device: one `apply_batch` kernel call per round (ops/bucket_kernel.py)
+
+Per-key serialization — which the reference gets from its worker hash
+ring (reference: gubernator_pool.go:19-37,183-187) — is preserved by
+splitting a batch into *rounds*: request i goes to round k if it is the
+k-th occurrence of its key within the batch, so each kernel call sees a
+slot at most once and duplicate keys are applied in arrival order,
+exactly like the reference's per-worker FIFO.
+
+The engine never reads the wall clock on device: `now_ms` flows in from
+the caller (or the injected Clock), enabling frozen-clock conformance
+tests (SURVEY.md §4.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import nullcontext
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.clock import SYSTEM_CLOCK, Clock
+from gubernator_tpu.gregorian import (
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.ops.bucket_kernel import (
+    BatchInput,
+    BucketState,
+    apply_batch,
+    make_state,
+)
+from gubernator_tpu.ops.expiry import sweep_expired
+from gubernator_tpu.core.interning import InternTable
+from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
+
+_I32 = np.int32
+_I64 = np.int64
+
+
+def _pad_size(n: int, floor: int = 64) -> int:
+    """Next power of two ≥ n (bounded set of compiled batch shapes)."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class DecisionEngine:
+    """Single-device decision engine over `capacity` bucket slots.
+
+    The multi-device variant lives in
+    `gubernator_tpu.parallel.sharded_engine`; it shares this host tier.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 50_000,  # reference default cache size (config.go:294)
+        *,
+        clock: Clock = SYSTEM_CLOCK,
+        device: Optional[jax.Device] = None,
+        max_kernel_width: int = 8192,
+    ):
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "gubernator_tpu requires jax x64 (timestamps and counters "
+                "are int64); do not set GUBERNATOR_TPU_X64=0 when using "
+                "the engine"
+            )
+        self.capacity = capacity
+        self.clock = clock
+        self._device = device
+        self.max_kernel_width = max_kernel_width
+        self.table = InternTable(capacity)
+        with jax.default_device(device) if device else nullcontext():
+            self._state: BucketState = make_state(capacity)
+        self._lock = threading.Lock()
+        # Metrics (reference: gubernator.go:59-113 catalog; wired to
+        # prometheus in gubernator_tpu.utils.metrics).
+        self.requests_total = 0
+        self.over_limit_total = 0
+        self.batches_total = 0
+        self.rounds_total = 0
+
+    # ------------------------------------------------------------------
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Apply a batch of rate-limit checks; responses in request order."""
+        if now_ms is None:
+            now_ms = self.clock.now_ms()
+        n = len(requests)
+        if n == 0:
+            return []
+
+        responses: List[Optional[RateLimitResp]] = [None] * n
+        now_dt = None
+
+        # Host-side precompute: Gregorian fields + per-item validation.
+        greg_dur = np.zeros(n, dtype=_I64)
+        greg_exp = np.zeros(n, dtype=_I64)
+        valid_idx: List[int] = []
+        for i, r in enumerate(requests):
+            if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN:
+                if now_dt is None:
+                    now_dt = self.clock.now_datetime()
+                try:
+                    greg_dur[i] = gregorian_duration(now_dt, r.duration)
+                    greg_exp[i] = gregorian_expiration(now_dt, r.duration)
+                except GregorianError as e:
+                    # Error-in-response, not error-in-RPC
+                    # (reference: gubernator.go:264-274).
+                    responses[i] = RateLimitResp(error=str(e))
+                    continue
+            valid_idx.append(i)
+
+        with self._lock:
+            self._apply_valid(requests, valid_idx, greg_dur, greg_exp, now_ms, responses)
+            self.requests_total += n
+            self.batches_total += 1
+        return responses  # type: ignore[return-value]
+
+    def _apply_valid(
+        self,
+        requests: Sequence[RateLimitReq],
+        valid_idx: List[int],
+        greg_dur: np.ndarray,
+        greg_exp: np.ndarray,
+        now_ms: int,
+        responses: List[Optional[RateLimitResp]],
+    ) -> None:
+        if not valid_idx:
+            return
+        keys = [requests[i].hash_key() for i in valid_idx]
+
+        # Split into rounds: the k-th operation on a slot → round k, so
+        # each device step touches a slot at most once (see module
+        # docstring).  Eviction clears participate in the same per-slot
+        # sequence: a clear of slot s must run after the evicted key's
+        # last request on s (earlier rounds) and no later than the
+        # reusing key's first request (clears apply before gathers and
+        # writes within a kernel call), so a clear is scheduled at the
+        # slot's current sequence number without consuming one.
+        slots = np.empty(len(keys), dtype=_I32)
+        seq: dict[int, int] = {}
+        rounds: dict[int, List[int]] = {}
+        clear_rounds: dict[int, List[int]] = {}
+        for j, key in enumerate(keys):
+            evicted: List[int] = []
+            slot = self.table.intern(key, now_ms, evicted)
+            for es in evicted:
+                clear_rounds.setdefault(seq.get(es, 0), []).append(es)
+            k = seq.get(slot, 0)
+            seq[slot] = k + 1
+            rounds.setdefault(k, []).append(j)
+            slots[j] = slot
+
+        host_expire = np.zeros(len(valid_idx), dtype=_I64)
+        for k in sorted(rounds):
+            members = rounds[k]
+            cleared = np.asarray(clear_rounds.get(k, []), dtype=_I32)
+            # Bound device shapes: chunk wide rounds so one oversized
+            # client batch can't force unbounded XLA recompiles.
+            for lo in range(0, len(members), self.max_kernel_width):
+                self._run_round(
+                    requests,
+                    valid_idx,
+                    members[lo : lo + self.max_kernel_width],
+                    slots,
+                    cleared if lo == 0 else np.empty(0, dtype=_I32),
+                    greg_dur,
+                    greg_exp,
+                    now_ms,
+                    responses,
+                    host_expire,
+                )
+                self.rounds_total += 1
+
+        # Refresh the host TTL mirror for eviction ordering.
+        self.table.set_expiry(slots, host_expire)
+
+    def _run_round(
+        self,
+        requests: Sequence[RateLimitReq],
+        valid_idx: List[int],
+        members: List[int],
+        slots: np.ndarray,
+        cleared: np.ndarray,
+        greg_dur: np.ndarray,
+        greg_exp: np.ndarray,
+        now_ms: int,
+        responses: List[Optional[RateLimitResp]],
+        host_expire: np.ndarray,
+    ) -> None:
+        m = len(members)
+        size = _pad_size(m)
+        # Padding lanes use distinct ascending out-of-range slots so the
+        # kernel's sorted+unique gather/scatter flags stay truthful.
+        b_slot = np.arange(
+            self.capacity, self.capacity + size, dtype=np.int64
+        ).astype(_I32)
+        b_algo = np.zeros(size, dtype=_I32)
+        b_beh = np.zeros(size, dtype=_I32)
+        b_hits = np.zeros(size, dtype=_I64)
+        b_limit = np.zeros(size, dtype=_I64)
+        b_dur = np.zeros(size, dtype=_I64)
+        b_burst = np.zeros(size, dtype=_I64)
+        b_gdur = np.zeros(size, dtype=_I64)
+        b_gexp = np.zeros(size, dtype=_I64)
+
+        for lane, j in enumerate(members):
+            i = valid_idx[j]
+            r = requests[i]
+            b_slot[lane] = slots[j]
+            b_algo[lane] = int(r.algorithm)
+            b_beh[lane] = int(r.behavior)
+            b_hits[lane] = r.hits
+            b_limit[lane] = r.limit
+            b_dur[lane] = r.duration
+            b_burst[lane] = r.burst
+            b_gdur[lane] = greg_dur[i]
+            b_gexp[lane] = greg_exp[i]
+            # Host TTL mirror estimate (device value is authoritative).
+            if b_beh[lane] & Behavior.DURATION_IS_GREGORIAN:
+                host_expire[j] = b_gexp[lane]
+            else:
+                host_expire[j] = now_ms + r.duration
+
+        csize = _pad_size(len(cleared), floor=16) if len(cleared) else 16
+        b_clear = np.arange(
+            self.capacity, self.capacity + csize, dtype=np.int64
+        ).astype(_I32)
+        if len(cleared):
+            b_clear[: len(cleared)] = cleared
+
+        batch = BatchInput(
+            slot=jnp.asarray(b_slot),
+            algo=jnp.asarray(b_algo),
+            behavior=jnp.asarray(b_beh),
+            hits=jnp.asarray(b_hits),
+            limit=jnp.asarray(b_limit),
+            duration=jnp.asarray(b_dur),
+            burst=jnp.asarray(b_burst),
+            greg_duration=jnp.asarray(b_gdur),
+            greg_expire=jnp.asarray(b_gexp),
+        )
+        self._state, out = apply_batch(
+            self._state, batch, jnp.asarray(b_clear), jnp.asarray(now_ms, dtype=jnp.int64)
+        )
+
+        o_status = np.asarray(out.status)
+        o_limit = np.asarray(out.limit)
+        o_rem = np.asarray(out.remaining)
+        o_reset = np.asarray(out.reset_time)
+        for lane, j in enumerate(members):
+            i = valid_idx[j]
+            st = int(o_status[lane])
+            if st == Status.OVER_LIMIT:
+                self.over_limit_total += 1
+            responses[i] = RateLimitResp(
+                status=Status(st),
+                limit=int(o_limit[lane]),
+                remaining=int(o_rem[lane]),
+                reset_time=int(o_reset[lane]),
+            )
+
+    # ------------------------------------------------------------------
+
+    def sweep(self, now_ms: Optional[int] = None) -> int:
+        """Reclaim slots of expired buckets; returns number freed."""
+        if now_ms is None:
+            now_ms = self.clock.now_ms()
+        with self._lock:
+            new_occ, freed = sweep_expired(
+                self._state.occupied,
+                self._state.expire_hi,
+                self._state.expire_lo,
+                jnp.asarray(now_ms >> 32, dtype=jnp.int32),
+                jnp.asarray(now_ms & 0xFFFFFFFF, dtype=jnp.uint32),
+            )
+            self._state = self._state._replace(occupied=new_occ)
+            freed_slots = np.nonzero(np.asarray(freed))[0]
+            self.table.release_slots(freed_slots)
+        return int(freed_slots.size)
+
+    def cache_size(self) -> int:
+        return len(self.table)
+
+    def close(self) -> None:
+        pass
+
+

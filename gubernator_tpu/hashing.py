@@ -1,0 +1,67 @@
+"""Key hashing: FNV-1 / FNV-1a 64-bit, scalar and numpy-vectorized.
+
+The consistent-hash ring hashes keys with fnv1 by default
+(reference: replicated_hash.go:31-33, config.go:395-417 allows
+fnv1/fnv1a).  The reference's intra-node worker ring uses xxhash
+truncated to 63 bits (reference: gubernator_pool.go:155-157); our
+device-shard routing reuses fnv1a instead — the worker ring is replaced
+by device sharding so only the distribution property matters.
+
+`fnv1_64_batch` hashes a padded uint8 matrix of keys in one vectorized
+numpy pass — the host hot path feeding the batch router.  A compiled
+C++ path (gubernator_tpu.core.native) supersedes it at high QPS.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FNV1_OFFSET = 0xCBF29CE484222325
+FNV1_PRIME = 0x100000001B3
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1_64(data: bytes) -> int:
+    """FNV-1 (multiply then xor). reference: segmentio/fasthash fnv1."""
+    h = FNV1_OFFSET
+    for b in data:
+        h = ((h * FNV1_PRIME) & _MASK) ^ b
+    return h
+
+
+def fnv1a_64(data: bytes) -> int:
+    """FNV-1a (xor then multiply)."""
+    h = FNV1_OFFSET
+    for b in data:
+        h = ((h ^ b) * FNV1_PRIME) & _MASK
+    return h
+
+
+def fnv1_64_batch(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1 over a [N, max_len] uint8 matrix of padded keys.
+
+    Scans column-by-column (max_len passes over N lanes), updating only
+    lanes whose key extends to that column — O(N * max_len) numpy work
+    instead of a per-key Python loop.
+    """
+    n, max_len = padded.shape
+    h = np.full(n, FNV1_OFFSET, dtype=np.uint64)
+    prime = np.uint64(FNV1_PRIME)
+    for col in range(max_len):
+        active = lengths > col
+        if not active.any():
+            break
+        nh = (h * prime) ^ padded[:, col].astype(np.uint64)
+        h = np.where(active, nh, h)
+    return h
+
+
+def pack_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """Pack variable-length byte keys into a padded uint8 matrix."""
+    n = len(keys)
+    lengths = np.fromiter((len(k) for k in keys), count=n, dtype=np.int64)
+    max_len = int(lengths.max()) if n else 0
+    padded = np.zeros((n, max_len), dtype=np.uint8)
+    for i, k in enumerate(keys):
+        padded[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+    return padded, lengths

@@ -1,0 +1,18 @@
+"""Rate-limit algorithm models.
+
+`spec.py` is the scalar, single-slot specification of the token- and
+leaky-bucket update — a faithful transcription of the reference
+semantics (reference: algorithms.go:31-516) used as the differential
+oracle for the vectorized device kernel in `gubernator_tpu.ops`.
+`sketch.py` adds the count-min-sketch approximate limiter (a new
+algorithm beyond the reference, BASELINE.md stretch config 5).
+"""
+
+from gubernator_tpu.models.spec import (
+    SlotState,
+    SpecInput,
+    SpecOutput,
+    apply_spec,
+)
+
+__all__ = ["SlotState", "SpecInput", "SpecOutput", "apply_spec"]

@@ -1,0 +1,32 @@
+"""Periodic device-side expiry sweep.
+
+The reference's LRU expires items lazily on read and evicts on overflow
+(reference: lrucache.go:112-159).  With device-resident state, lazy
+expiry is already handled by the kernel's liveness check; this sweep
+reclaims slots of expired buckets in bulk so the host intern table can
+reuse them (SURVEY.md §7.3 item 6).
+
+The 64-bit `expire_at < now` compare is done on the stored (hi, lo)
+word pairs directly — combining to int64 would reintroduce the
+O(capacity) x64 boundary shim the split layout exists to avoid
+(see BucketState docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sweep_expired(
+    occupied: jax.Array,
+    expire_hi: jax.Array,  # int32
+    expire_lo: jax.Array,  # uint32
+    now_hi: jax.Array,  # int32 scalar
+    now_lo: jax.Array,  # uint32 scalar
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (new_occupied, freed_mask)."""
+    lt = (expire_hi < now_hi) | ((expire_hi == now_hi) & (expire_lo < now_lo))
+    freed = occupied & lt
+    return occupied & ~freed, freed

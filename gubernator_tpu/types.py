@@ -1,0 +1,145 @@
+"""Wire-level types of the rate-limit API.
+
+These mirror the reference proto contract exactly
+(reference: proto/gubernator.proto:48-192, proto/peers.proto:36-57) so a
+client of the reference can switch without changing request shapes.  The
+actual protobuf/gRPC marshaling lives in `gubernator_tpu.net`; these
+dataclasses are the in-process representation used by the engine and the
+cluster tier.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class Algorithm(enum.IntEnum):
+    """reference: proto/gubernator.proto:57-62"""
+
+    TOKEN_BUCKET = 0
+    LEAKY_BUCKET = 1
+
+
+class Behavior(enum.IntFlag):
+    """Bit flags controlling rate-limit behavior.
+
+    reference: proto/gubernator.proto:65-131.  BATCHING is 0 (the proto
+    requires a zero member); it is the default and has no effect when set.
+    """
+
+    BATCHING = 0
+    NO_BATCHING = 1
+    GLOBAL = 2
+    DURATION_IS_GREGORIAN = 4
+    RESET_REMAINING = 8
+    MULTI_REGION = 16
+
+
+class Status(enum.IntEnum):
+    """reference: proto/gubernator.proto:164-167"""
+
+    UNDER_LIMIT = 0
+    OVER_LIMIT = 1
+
+
+def has_behavior(behavior: int, flag: int) -> bool:
+    """reference: gubernator.go:812-817 (HasBehavior)"""
+    return (int(behavior) & int(flag)) != 0
+
+
+@dataclass
+class RateLimitReq:
+    """One rate-limit check; config is carried in the request.
+
+    reference: proto/gubernator.proto:133-162
+    """
+
+    name: str = ""
+    unique_key: str = ""
+    hits: int = 0
+    limit: int = 0
+    duration: int = 0  # milliseconds (or a Gregorian interval enum)
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    behavior: int = Behavior.BATCHING
+    burst: int = 0
+
+    def hash_key(self) -> str:
+        """The canonical cache/routing key.
+
+        reference: client.go:37-39 (HashKey = Name + "_" + UniqueKey)
+        """
+        return self.name + "_" + self.unique_key
+
+
+@dataclass
+class RateLimitResp:
+    """reference: proto/gubernator.proto:169-182"""
+
+    status: int = Status.UNDER_LIMIT
+    limit: int = 0
+    remaining: int = 0
+    reset_time: int = 0
+    error: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class GetRateLimitsReq:
+    """reference: proto/gubernator.proto:48-50"""
+
+    requests: List[RateLimitReq] = field(default_factory=list)
+
+
+@dataclass
+class GetRateLimitsResp:
+    """reference: proto/gubernator.proto:53-55"""
+
+    responses: List[RateLimitResp] = field(default_factory=list)
+
+
+@dataclass
+class HealthCheckReq:
+    """reference: proto/gubernator.proto:184"""
+
+
+@dataclass
+class HealthCheckResp:
+    """reference: proto/gubernator.proto:185-192"""
+
+    status: str = ""
+    message: str = ""
+    peer_count: int = 0
+
+
+@dataclass
+class UpdatePeerGlobal:
+    """reference: proto/peers.proto:52-56"""
+
+    key: str = ""
+    status: Optional[RateLimitResp] = None
+    algorithm: int = Algorithm.TOKEN_BUCKET
+
+
+@dataclass
+class PeerInfo:
+    """Identity of one cluster peer.
+
+    reference: config.go (PeerInfo struct) — GRPCAddress is the canonical
+    peer identity used by the consistent-hash ring
+    (reference: replicated_hash.go:78-91).
+    """
+
+    grpc_address: str = ""
+    http_address: str = ""
+    datacenter: str = ""
+    is_owner: bool = False
+
+    def hash_key(self) -> str:
+        return self.grpc_address
+
+
+# Max number of requests in one GetRateLimits / GetPeerRateLimits batch.
+# reference: gubernator.go:41 (maxBatchSize = 1000)
+MAX_BATCH_SIZE = 1000

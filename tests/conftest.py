@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware isn't available in CI; sharding tests run on a
+virtual CPU mesh exactly as SURVEY.md prescribes.  Must run before the
+first jax import (hence module level, and conftest loads before test
+modules)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The environment's sitecustomize may have force-registered a TPU
+# backend before conftest ran; the config update wins over it.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from gubernator_tpu.clock import Clock
+
+
+@pytest.fixture
+def frozen_clock() -> Clock:
+    """A frozen, manually advanced clock (reference: functional_test.go:160)."""
+    return Clock().freeze()

@@ -1,0 +1,443 @@
+"""Algorithm conformance tests — ported from the reference functional suite.
+
+Each test transcribes a table from reference functional_test.go (cited
+per test) and drives the DecisionEngine directly with a frozen,
+manually-advanced clock.  The tables are the behavioral spec
+(SURVEY.md §4.5c)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gubernator_tpu import Algorithm, Behavior, RateLimitReq, Status
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core.engine import DecisionEngine
+
+SECOND = 1000
+MINUTE = 60 * SECOND
+
+GREGORIAN_MINUTES = 0
+
+
+@pytest.fixture
+def engine(frozen_clock: Clock) -> DecisionEngine:
+    return DecisionEngine(capacity=1024, clock=frozen_clock)
+
+
+def hit(engine: DecisionEngine, **kw):
+    req = RateLimitReq(**kw)
+    (resp,) = engine.get_rate_limits([req])
+    return resp
+
+
+def test_over_the_limit(engine, frozen_clock):
+    """reference: functional_test.go:64-109 (TestOverTheLimit)"""
+    table = [
+        (1, Status.UNDER_LIMIT),
+        (0, Status.UNDER_LIMIT),
+        (0, Status.OVER_LIMIT),
+    ]
+    for remaining, status in table:
+        resp = hit(
+            engine,
+            name="test_over_limit",
+            unique_key="account:1234",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=SECOND * 9,
+            limit=2,
+            hits=1,
+        )
+        assert resp.error == ""
+        assert resp.status == status
+        assert resp.remaining == remaining
+        assert resp.limit == 2
+        assert resp.reset_time != 0
+
+
+def test_token_bucket(engine, frozen_clock):
+    """reference: functional_test.go:159-218 (TestTokenBucket)"""
+    table = [
+        ("remaining should be one", 1, Status.UNDER_LIMIT, 0),
+        ("remaining should be zero and under limit", 0, Status.UNDER_LIMIT, 100),
+        ("after waiting 100ms remaining should be 1 and under limit", 1, Status.UNDER_LIMIT, 0),
+    ]
+    for name, remaining, status, sleep_ms in table:
+        resp = hit(
+            engine,
+            name="test_token_bucket",
+            unique_key="account:1234",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=5,
+            limit=2,
+            hits=1,
+        )
+        assert resp.error == "", name
+        assert resp.status == status, name
+        assert resp.remaining == remaining, name
+        assert resp.limit == 2, name
+        assert resp.reset_time != 0, name
+        frozen_clock.advance(ms=sleep_ms)
+
+
+def test_token_bucket_gregorian(engine, frozen_clock):
+    """reference: functional_test.go:220-293 (TestTokenBucketGregorian)"""
+    table = [
+        ("first hit", 1, 59, Status.UNDER_LIMIT, 0),
+        ("second hit", 1, 58, Status.UNDER_LIMIT, 0),
+        ("consume remaining hits", 58, 0, Status.UNDER_LIMIT, 0),
+        ("should be over the limit", 1, 0, Status.OVER_LIMIT, 61 * SECOND),
+        ("should be under the limit", 0, 60, Status.UNDER_LIMIT, 0),
+    ]
+    for name, hits, remaining, status, sleep_ms in table:
+        resp = hit(
+            engine,
+            name="test_token_bucket_greg",
+            unique_key="account:12345",
+            behavior=Behavior.DURATION_IS_GREGORIAN,
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=GREGORIAN_MINUTES,
+            hits=hits,
+            limit=60,
+        )
+        assert resp.error == "", name
+        assert resp.status == status, name
+        assert resp.remaining == remaining, name
+        assert resp.limit == 60, name
+        assert resp.reset_time != 0, name
+        frozen_clock.advance(ms=sleep_ms)
+
+
+def test_token_bucket_negative_hits(engine, frozen_clock):
+    """reference: functional_test.go:295-365 (TestTokenBucketNegativeHits)"""
+    table = [
+        ("remaining should be three", 3, Status.UNDER_LIMIT, -1),
+        ("remaining should be four and under limit", 4, Status.UNDER_LIMIT, -1),
+        ("remaining should be 0 and under limit", 0, Status.UNDER_LIMIT, 4),
+        ("remaining should be 1 and under limit", 1, Status.UNDER_LIMIT, -1),
+    ]
+    for name, remaining, status, hits in table:
+        resp = hit(
+            engine,
+            name="test_token_bucket_negative",
+            unique_key="account:12345",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=5,
+            limit=2,
+            hits=hits,
+        )
+        assert resp.error == "", name
+        assert resp.status == status, name
+        assert resp.remaining == remaining, name
+        assert resp.limit == 2, name
+        assert resp.reset_time != 0, name
+
+
+def _leaky_assert(resp, clock, remaining, status, name, limit=10):
+    assert resp.status == status, name
+    assert resp.remaining == remaining, name
+    assert resp.limit == limit, name
+    # reference: functional_test.go:544 — reset follows the leak rate.
+    assert resp.reset_time // 1000 == clock.now_ms() // 1000 + (resp.limit - resp.remaining) * 3, name
+
+
+def test_leaky_bucket(engine, frozen_clock):
+    """reference: functional_test.go:367-492 (TestLeakyBucket)"""
+    table = [
+        ("first hit", 1, 9, Status.UNDER_LIMIT, SECOND),
+        ("second hit; no leak", 1, 8, Status.UNDER_LIMIT, SECOND),
+        ("third hit; no leak", 1, 7, Status.UNDER_LIMIT, 1500),
+        ("should leak one hit 3 seconds after first hit", 0, 8, Status.UNDER_LIMIT, 3 * SECOND),
+        ("3 Seconds later we should have leaked another hit", 0, 9, Status.UNDER_LIMIT, 0),
+        ("max out our bucket and sleep for 3 seconds", 9, 0, Status.UNDER_LIMIT, 0),
+        ("should be over the limit", 1, 0, Status.OVER_LIMIT, 3 * SECOND),
+        ("should have leaked 1 hit", 0, 1, Status.UNDER_LIMIT, 60 * SECOND),
+        ("should max out the limit", 0, 10, Status.UNDER_LIMIT, 60 * SECOND),
+        ("should use up the limit and wait until 1 second before duration period", 10, 0, Status.UNDER_LIMIT, 29 * SECOND),
+        ("should use up all hits one second before duration period", 9, 0, Status.UNDER_LIMIT, 3 * SECOND),
+        ("only have 1 hit remaining", 1, 0, Status.UNDER_LIMIT, SECOND),
+    ]
+    for name, hits, remaining, status, sleep_ms in table:
+        resp = hit(
+            engine,
+            name="test_leaky_bucket",
+            unique_key="account:1234",
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=SECOND * 30,
+            hits=hits,
+            limit=10,
+        )
+        assert resp.error == "", name
+        _leaky_assert(resp, frozen_clock, remaining, status, name)
+        frozen_clock.advance(ms=sleep_ms)
+
+
+def test_leaky_bucket_with_burst(engine, frozen_clock):
+    """reference: functional_test.go:494-599 (TestLeakyBucketWithBurst)"""
+    table = [
+        ("first hit", 1, 19, Status.UNDER_LIMIT, SECOND),
+        ("second hit; no leak", 1, 18, Status.UNDER_LIMIT, SECOND),
+        ("third hit; no leak", 1, 17, Status.UNDER_LIMIT, 1500),
+        ("should leak one hit 3 seconds after first hit", 0, 18, Status.UNDER_LIMIT, 3 * SECOND),
+        ("3 Seconds later we should have leaked another hit", 0, 19, Status.UNDER_LIMIT, 0),
+        ("max out our bucket and sleep for 3 seconds", 19, 0, Status.UNDER_LIMIT, 0),
+        ("should be over the limit", 1, 0, Status.OVER_LIMIT, 3 * SECOND),
+        ("should have leaked 1 hit", 0, 1, Status.UNDER_LIMIT, 60 * SECOND),
+        ("should max out remaining", 0, 20, Status.UNDER_LIMIT, SECOND),
+    ]
+    for name, hits, remaining, status, sleep_ms in table:
+        resp = hit(
+            engine,
+            name="test_leaky_bucket_with_burst",
+            unique_key="account:1234",
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=SECOND * 30,
+            hits=hits,
+            limit=10,
+            burst=20,
+        )
+        assert resp.error == "", name
+        _leaky_assert(resp, frozen_clock, remaining, status, name)
+        frozen_clock.advance(ms=sleep_ms)
+
+
+def test_leaky_bucket_gregorian(engine, frozen_clock):
+    """reference: functional_test.go:601-664 (TestLeakyBucketGregorian)"""
+    table = [
+        ("first hit", 1, 59, Status.UNDER_LIMIT, 500),
+        ("second hit; no leak", 1, 58, Status.UNDER_LIMIT, SECOND),
+        ("third hit; leak one hit", 1, 58, Status.UNDER_LIMIT, 0),
+    ]
+    for name, hits, remaining, status, sleep_ms in table:
+        resp = hit(
+            engine,
+            name="test_leaky_bucket_greg",
+            unique_key="account:12345",
+            behavior=Behavior.DURATION_IS_GREGORIAN,
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=GREGORIAN_MINUTES,
+            hits=hits,
+            limit=60,
+        )
+        assert resp.error == "", name
+        assert resp.status == status, name
+        assert resp.remaining == remaining, name
+        assert resp.limit == 60, name
+        assert resp.reset_time > frozen_clock.now_ms() // 1000, name
+        frozen_clock.advance(ms=sleep_ms)
+
+
+def test_leaky_bucket_negative_hits(engine, frozen_clock):
+    """reference: functional_test.go:666-735 (TestLeakyBucketNegativeHits)"""
+    table = [
+        ("first hit", 1, 9, Status.UNDER_LIMIT),
+        ("can increase remaining", -1, 10, Status.UNDER_LIMIT),
+        ("remaining should be zero", 10, 0, Status.UNDER_LIMIT),
+        ("can append one to remaining when remaining is zero", -1, 1, Status.UNDER_LIMIT),
+    ]
+    for name, hits, remaining, status in table:
+        resp = hit(
+            engine,
+            name="test_leaky_bucket_negative",
+            unique_key="account:12345",
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=SECOND * 30,
+            hits=hits,
+            limit=10,
+        )
+        assert resp.error == "", name
+        _leaky_assert(resp, frozen_clock, remaining, status, name)
+
+
+def test_leaky_bucket_div_bug(engine, frozen_clock):
+    """reference: functional_test.go:1106-1146 (TestLeakyBucketDivBug)"""
+    resp = hit(
+        engine,
+        name="test_leaky_bucket_div",
+        unique_key="account:12345",
+        algorithm=Algorithm.LEAKY_BUCKET,
+        duration=1000,
+        hits=1,
+        limit=2000,
+    )
+    assert resp.error == ""
+    assert resp.status == Status.UNDER_LIMIT
+    assert resp.remaining == 1999
+    assert resp.limit == 2000
+
+    resp = hit(
+        engine,
+        name="test_leaky_bucket_div",
+        unique_key="account:12345",
+        algorithm=Algorithm.LEAKY_BUCKET,
+        duration=1000,
+        hits=100,
+        limit=2000,
+    )
+    assert resp.remaining == 1899
+    assert resp.limit == 2000
+
+
+def test_change_limit(engine, frozen_clock):
+    """reference: functional_test.go:870-963 (TestChangeLimit)"""
+    table = [
+        ("Should subtract 1 from remaining", Algorithm.TOKEN_BUCKET, 99, 100),
+        ("Should subtract 1 from remaining", Algorithm.TOKEN_BUCKET, 98, 100),
+        ("Should subtract 1 from remaining and change limit to 10", Algorithm.TOKEN_BUCKET, 7, 10),
+        ("Should subtract 1 from remaining with new limit of 10", Algorithm.TOKEN_BUCKET, 6, 10),
+        ("Should subtract 1 from remaining with new limit of 200", Algorithm.TOKEN_BUCKET, 195, 200),
+        ("Should subtract 1 from remaining for leaky bucket", Algorithm.LEAKY_BUCKET, 99, 100),
+        ("Should subtract 1 from remaining for leaky bucket after limit change", Algorithm.LEAKY_BUCKET, 9, 10),
+        ("Should subtract 1 from remaining for leaky bucket with new limit", Algorithm.LEAKY_BUCKET, 8, 10),
+    ]
+    for name, algorithm, remaining, limit in table:
+        resp = hit(
+            engine,
+            name="test_change_limit",
+            unique_key="account:1234",
+            algorithm=algorithm,
+            duration=9000,
+            limit=limit,
+            hits=1,
+        )
+        assert resp.error == "", name
+        assert resp.status == Status.UNDER_LIMIT, name
+        assert resp.remaining == remaining, name
+        assert resp.limit == limit, name
+        assert resp.reset_time != 0, name
+
+
+def test_reset_remaining(engine, frozen_clock):
+    """reference: functional_test.go:965-1035 (TestResetRemaining)"""
+    table = [
+        ("Should subtract 1 from remaining", Behavior.BATCHING, 99),
+        ("Should subtract 2 from remaining", Behavior.BATCHING, 98),
+        ("Should reset the remaining", Behavior.RESET_REMAINING, 100),
+        ("Should subtract 1 from remaining after reset", Behavior.BATCHING, 99),
+    ]
+    for name, behavior, remaining in table:
+        resp = hit(
+            engine,
+            name="test_reset_remaining",
+            unique_key="account:1234",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=9000,
+            behavior=behavior,
+            limit=100,
+            hits=1,
+        )
+        assert resp.error == "", name
+        assert resp.status == Status.UNDER_LIMIT, name
+        assert resp.remaining == remaining, name
+        assert resp.limit == 100, name
+
+
+def test_batch_order_and_multiple_keys(engine, frozen_clock):
+    """reference: functional_test.go:113-157 (TestMultipleAsync) — batch
+    responses come back in request order."""
+    reqs = [
+        RateLimitReq(
+            name="test_multiple_async",
+            unique_key="account:9234",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=SECOND * 9,
+            limit=2,
+            hits=1,
+        ),
+        RateLimitReq(
+            name="test_multiple_async",
+            unique_key="account:5678",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=SECOND * 9,
+            limit=10,
+            hits=5,
+        ),
+    ]
+    resps = engine.get_rate_limits(reqs)
+    assert len(resps) == 2
+    assert resps[0].status == Status.UNDER_LIMIT
+    assert resps[0].remaining == 1
+    assert resps[0].limit == 2
+    assert resps[1].status == Status.UNDER_LIMIT
+    assert resps[1].remaining == 5
+    assert resps[1].limit == 10
+
+
+def test_duplicate_keys_in_one_batch_apply_sequentially(engine, frozen_clock):
+    """Duplicate keys within one batch are applied in arrival order
+    (the reference serializes them through one worker's FIFO,
+    gubernator_pool.go:19-37; here: kernel rounds)."""
+    req = dict(
+        name="dup",
+        unique_key="k",
+        algorithm=Algorithm.TOKEN_BUCKET,
+        duration=SECOND * 9,
+        limit=3,
+        hits=1,
+    )
+    resps = engine.get_rate_limits([RateLimitReq(**req) for _ in range(5)])
+    assert [r.remaining for r in resps] == [2, 1, 0, 0, 0]
+    assert [r.status for r in resps] == [
+        Status.UNDER_LIMIT,
+        Status.UNDER_LIMIT,
+        Status.UNDER_LIMIT,
+        Status.OVER_LIMIT,
+        Status.OVER_LIMIT,
+    ]
+
+
+def test_eviction_and_reuse_within_one_batch(frozen_clock):
+    """A slot evicted and reused inside one batch must not leak the old
+    key's bucket state into the new key (regression: clears used to run
+    only in round 0, before the evicted key's own round-0 write)."""
+    eng = DecisionEngine(capacity=2, clock=frozen_clock)
+    reqs = [
+        RateLimitReq(name="e", unique_key=f"k{i}", hits=1, limit=10, duration=60_000)
+        for i in range(5)
+    ]
+    resps = eng.get_rate_limits(reqs)
+    assert [r.remaining for r in resps] == [9, 9, 9, 9, 9]
+    # And an existing key evicted mid-batch starts fresh afterwards.
+    resps = eng.get_rate_limits(reqs)
+    assert [r.remaining for r in resps] == [9, 9, 9, 9, 9]
+
+
+def test_algorithm_switch_resets(engine, frozen_clock):
+    """Client switching algorithms resets the bucket
+    (reference: algorithms.go:104-117,333-345)."""
+    common = dict(name="switch", unique_key="k", duration=SECOND * 9, limit=10)
+    r1 = hit(engine, algorithm=Algorithm.TOKEN_BUCKET, hits=4, **common)
+    assert r1.remaining == 6
+    r2 = hit(engine, algorithm=Algorithm.LEAKY_BUCKET, hits=1, **common)
+    assert r2.remaining == 9  # fresh leaky bucket
+    r3 = hit(engine, algorithm=Algorithm.TOKEN_BUCKET, hits=1, **common)
+    assert r3.remaining == 9  # fresh token bucket
+
+
+def test_hits_zero_status_query(engine, frozen_clock):
+    """Hits=0 returns status without consuming
+    (reference: algorithms.go:173-176,439-442)."""
+    common = dict(
+        name="q", unique_key="k", algorithm=Algorithm.TOKEN_BUCKET,
+        duration=SECOND * 9, limit=5,
+    )
+    hit(engine, hits=3, **common)
+    for _ in range(3):
+        resp = hit(engine, hits=0, **common)
+        assert resp.remaining == 2
+        assert resp.status == Status.UNDER_LIMIT
+
+
+def test_over_limit_does_not_consume(engine, frozen_clock):
+    """Requesting more than available rejects without mutating state
+    (reference: algorithms.go:195-202)."""
+    common = dict(
+        name="noconsume", unique_key="k", algorithm=Algorithm.TOKEN_BUCKET,
+        duration=SECOND * 9, limit=100,
+    )
+    hit(engine, hits=50, **common)
+    resp = hit(engine, hits=60, **common)
+    assert resp.status == Status.OVER_LIMIT
+    assert resp.remaining == 50
+    resp = hit(engine, hits=50, **common)
+    assert resp.status == Status.UNDER_LIMIT
+    assert resp.remaining == 0

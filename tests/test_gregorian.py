@@ -1,0 +1,71 @@
+"""Gregorian interval math tests (reference: interval_test.go:29-137)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from gubernator_tpu.gregorian import (
+    GREGORIAN_DAYS,
+    GREGORIAN_HOURS,
+    GREGORIAN_MINUTES,
+    GREGORIAN_MONTHS,
+    GREGORIAN_WEEKS,
+    GREGORIAN_YEARS,
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+
+
+def _dt(y, mo, d, h=0, mi=0, s=0, ms=0):
+    return datetime(y, mo, d, h, mi, s, ms * 1000, tzinfo=timezone.utc)
+
+
+def _ms(dt):
+    return int(dt.timestamp() * 1000)
+
+
+def test_minute_expiration():
+    # Mirrors reference example (interval.go:115-116): 11:20:10 → 11:20:59.999
+    now = _dt(2019, 1, 1, 11, 20, 10)
+    assert gregorian_expiration(now, GREGORIAN_MINUTES) == _ms(_dt(2019, 1, 1, 11, 21)) - 1
+
+
+def test_hour_day_expiration():
+    now = _dt(2019, 6, 15, 11, 20, 10)
+    assert gregorian_expiration(now, GREGORIAN_HOURS) == _ms(_dt(2019, 6, 15, 12, 0)) - 1
+    assert gregorian_expiration(now, GREGORIAN_DAYS) == _ms(_dt(2019, 6, 16)) - 1
+
+
+def test_month_year_expiration():
+    now = _dt(2019, 12, 31, 23, 59, 59)
+    assert gregorian_expiration(now, GREGORIAN_MONTHS) == _ms(_dt(2020, 1, 1)) - 1
+    assert gregorian_expiration(now, GREGORIAN_YEARS) == _ms(_dt(2020, 1, 1)) - 1
+    feb = _dt(2020, 2, 10)  # leap year
+    assert gregorian_expiration(feb, GREGORIAN_MONTHS) == _ms(_dt(2020, 3, 1)) - 1
+
+
+def test_durations():
+    now = _dt(2020, 2, 10)
+    assert gregorian_duration(now, GREGORIAN_MINUTES) == 60_000
+    assert gregorian_duration(now, GREGORIAN_HOURS) == 3_600_000
+    assert gregorian_duration(now, GREGORIAN_DAYS) == 86_400_000
+    assert gregorian_duration(now, GREGORIAN_MONTHS) == 29 * 86_400_000  # leap Feb
+    assert gregorian_duration(now, GREGORIAN_YEARS) == 366 * 86_400_000
+
+
+def test_weeks_supported_here():
+    #
+
+    # The reference errors on weeks (interval.go:92-93); we support them
+    # (documented divergence, gregorian.py module docstring).
+    monday = _dt(2026, 7, 27)
+    assert gregorian_expiration(monday, GREGORIAN_WEEKS) == _ms(_dt(2026, 8, 3)) - 1
+    assert gregorian_duration(monday, GREGORIAN_WEEKS) == 7 * 86_400_000
+
+
+def test_invalid_interval_raises():
+    with pytest.raises(GregorianError):
+        gregorian_expiration(_dt(2020, 1, 1), 42)
+    with pytest.raises(GregorianError):
+        gregorian_duration(_dt(2020, 1, 1), -1)

@@ -1,0 +1,49 @@
+"""Intern table unit tests (reference behaviors: lrucache_test.go)."""
+
+from gubernator_tpu.core.interning import InternTable
+
+
+def test_basic_intern_stable_slots():
+    t = InternTable(8)
+    cleared: list[int] = []
+    s1 = t.intern("a", 0, cleared)
+    s2 = t.intern("b", 0, cleared)
+    assert s1 != s2
+    assert t.intern("a", 0, cleared) == s1
+    assert t.hits == 1 and t.misses == 2
+    assert not cleared
+
+
+def test_lru_eviction_order_and_unexpired_metric():
+    """Oldest (least recently used) evicted first; unexpired evictions
+    counted (reference: lrucache.go:148-159)."""
+    import numpy as np
+
+    t = InternTable(2)
+    cleared: list[int] = []
+    sa = t.intern("a", 0, cleared)
+    sb = t.intern("b", 0, cleared)
+    # Touch "a" so "b" becomes LRU; mark b unexpired.
+    t.intern("a", 0, cleared)
+    t.set_expiry(np.array([sb]), np.array([10_000]))
+    sc = t.intern("c", 5_000, cleared)
+    assert sc == sb  # b evicted, slot reused
+    assert cleared == [sb]
+    assert t.evictions == 1
+    assert t.unexpired_evictions == 1
+    # "a" survived
+    assert t.intern("a", 0, cleared) == sa
+    assert len(t) == 2
+
+
+def test_remove_and_release():
+    import numpy as np
+
+    t = InternTable(4)
+    cleared: list[int] = []
+    s = t.intern("x", 0, cleared)
+    assert t.remove("x") == s
+    assert t.remove("x") is None
+    s2 = t.intern("y", 0, cleared)
+    t.release_slots(np.array([s2]))
+    assert len(t) == 0
