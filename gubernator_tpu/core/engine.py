@@ -31,6 +31,7 @@ import numpy as np
 from gubernator_tpu.clock import SYSTEM_CLOCK, Clock
 from gubernator_tpu.gregorian import (
     GregorianError,
+    dt_from_ms,
     gregorian_duration,
     gregorian_expiration,
 )
@@ -114,7 +115,10 @@ class DecisionEngine:
         for i, r in enumerate(requests):
             if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN:
                 if now_dt is None:
-                    now_dt = self.clock.now_datetime()
+                    # Derive civil time from now_ms itself — a second
+                    # clock read could land in a different calendar
+                    # interval than the kernel's `now`.
+                    now_dt = dt_from_ms(now_ms)
                 try:
                     greg_dur[i] = gregorian_duration(now_dt, r.duration)
                     greg_exp[i] = gregorian_expiration(now_dt, r.duration)

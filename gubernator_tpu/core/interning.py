@@ -73,16 +73,6 @@ class InternTable:
         self._expire[slot] = 0
         return slot
 
-    def intern_batch(
-        self, keys: list[str], now_ms: int
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Intern a batch; returns (slots int32 [N], cleared int32 [C])."""
-        cleared: list[int] = []
-        slots = np.empty(len(keys), dtype=np.int32)
-        for i, k in enumerate(keys):
-            slots[i] = self.intern(k, now_ms, cleared)
-        return slots, np.asarray(cleared, dtype=np.int32)
-
     def set_expiry(self, slots: np.ndarray, expires: np.ndarray) -> None:
         """Update the host TTL mirror after a kernel step."""
         self._expire[slots] = expires

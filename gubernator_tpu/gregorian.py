@@ -45,6 +45,18 @@ def _to_ms(dt: datetime) -> int:
     return int(dt.timestamp() * 1000)
 
 
+def dt_from_ms(now_ms: int) -> datetime:
+    """Civil UTC time for a unix-ms timestamp.
+
+    The engines derive the Gregorian civil time from the same `now_ms`
+    the kernel receives — a second clock read could land in a different
+    calendar interval and create buckets already expired relative to
+    the kernel's `now` (engine time-source invariant)."""
+    from datetime import timezone
+
+    return datetime.fromtimestamp(now_ms / 1000.0, tz=timezone.utc)
+
+
 def gregorian_duration(now: datetime, d: int) -> int:
     """Total length in ms of the Gregorian interval containing `now`.
 

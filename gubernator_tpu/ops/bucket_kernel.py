@@ -79,13 +79,18 @@ class BucketState(NamedTuple):
 
 
 class BatchInput(NamedTuple):
-    """One request batch, shape [B] per field; slot == -1 marks padding.
+    """One request batch, shape [B] per field.
+
+    Padding lanes MUST use distinct, ascending, out-of-range slots
+    (capacity + lane) — the kernel declares its gather/scatter indices
+    sorted and unique, and -1 padding would both defeat the
+    `slot < capacity` mask and violate the uniqueness contract.
 
     `greg_duration`/`greg_expire` are host-precomputed per request when
     DURATION_IS_GREGORIAN is set (reference: interval.go:84-148 — the
     calendar math never runs on device)."""
 
-    slot: jax.Array  # int32, -1 = padded lane
+    slot: jax.Array  # int32; padding = capacity + lane (see above)
     algo: jax.Array  # int32
     behavior: jax.Array  # int32
     hits: jax.Array  # int64

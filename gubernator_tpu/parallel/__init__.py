@@ -1,0 +1,17 @@
+"""Device-mesh parallelism: sharded bucket state + key→shard routing.
+
+The reference shards its key space twice: across peers via a
+consistent-hash ring (reference: replicated_hash.go) and across local
+CPU workers via a linear hash ring (reference: gubernator_pool.go:128-187).
+Here the intra-node tier becomes a 1-D `jax.sharding.Mesh` over TPU
+chips: bucket state arrays are sharded over the "keys" axis, each
+~500µs batch is routed host-side to its owning shard, and one
+shard_map'ed kernel call updates every shard in parallel with zero
+cross-chip traffic on the decision path (SURVEY.md §2.2).  GLOBAL
+aggregation rides ICI collectives (see cluster/global_manager.py).
+"""
+
+from gubernator_tpu.parallel.mesh import make_mesh
+from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+
+__all__ = ["make_mesh", "ShardedDecisionEngine"]

@@ -1,0 +1,328 @@
+"""ShardedDecisionEngine — bucket state sharded over a device mesh.
+
+The multi-chip execution engine: state arrays have shape
+[n_shards, shard_capacity] sharded over the "keys" mesh axis; each
+request batch is routed host-side to its owning shard
+(fnv1a(key) mod n_shards — the TPU-native replacement for the worker
+hash ring, reference: gubernator_pool.go:183-187) and applied by ONE
+jitted shard_map step: every chip gathers/updates only its local state
+block, so the decision path needs zero inter-chip traffic; the step
+ends with a psum over the mesh (aggregate over-limit count) so cluster
+metrics ride ICI instead of per-shard host readbacks.
+
+Per-key serialization and eviction-clear scheduling reuse the round
+scheme of the single-device engine (core/engine.py), applied per shard.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.clock import SYSTEM_CLOCK, Clock
+from gubernator_tpu.gregorian import (
+    GregorianError,
+    dt_from_ms,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.hashing import fnv1a_64
+from gubernator_tpu.ops.bucket_kernel import (
+    BatchInput,
+    BucketState,
+    _apply_batch_impl,
+    make_state,
+)
+from gubernator_tpu.core.interning import InternTable
+from gubernator_tpu.parallel.mesh import KEYS_AXIS, make_mesh
+from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
+
+_I32 = np.int32
+_I64 = np.int64
+
+
+def _pad_size(n: int, floor: int = 64) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+def _squeeze(tree):
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _expand(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+class ShardedDecisionEngine:
+    """Decision engine over an N-device mesh (total capacity =
+    n_shards × shard_capacity)."""
+
+    def __init__(
+        self,
+        shard_capacity: int = 50_000,
+        *,
+        mesh: Optional[Mesh] = None,
+        clock: Clock = SYSTEM_CLOCK,
+        max_kernel_width: int = 8192,
+    ):
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError("gubernator_tpu requires jax x64")
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_shards = self.mesh.shape[KEYS_AXIS]
+        self.shard_capacity = shard_capacity
+        self.capacity = shard_capacity * self.n_shards
+        self.clock = clock
+        self.max_kernel_width = max_kernel_width
+        self.tables = [InternTable(shard_capacity) for _ in range(self.n_shards)]
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.over_limit_total = 0
+        self.batches_total = 0
+        self.rounds_total = 0
+
+        state_spec = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P(KEYS_AXIS)), make_state(0)
+        )
+        # Allocate the sharded state: [n_shards, shard_capacity] blocks.
+        self._state: BucketState = jax.tree.map(
+            lambda leaf, sh: jax.device_put(
+                jnp.tile(leaf[None], (self.n_shards, 1)), sh
+            ),
+            make_state(shard_capacity),
+            state_spec,
+        )
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------
+
+    def _build_step(self):
+        mesh = self.mesh
+        cap = self.shard_capacity
+
+        def local_step(state, batch, clear, now):
+            state1 = _squeeze(state)
+            batch1 = _squeeze(batch)
+            new_state, out = _apply_batch_impl(state1, batch1, clear[0], now)
+            active = batch1.slot < cap
+            over = jnp.sum(
+                ((out.status == int(Status.OVER_LIMIT)) & active).astype(jnp.int32)
+            )
+            # Aggregate over the ICI mesh — cluster-wide over-limit count
+            # (the GLOBAL async all-reduce analog, SURVEY.md §2.2).
+            over = jax.lax.psum(over, KEYS_AXIS)
+            return _expand(new_state), _expand(out), over
+
+        pspec = P(KEYS_AXIS)
+        state_specs = jax.tree.map(lambda _: pspec, make_state(0))
+        batch_specs = jax.tree.map(
+            lambda _: pspec,
+            BatchInput(*(0,) * len(BatchInput._fields)),
+        )
+        out_specs_batch = jax.tree.map(lambda _: pspec, _dummy_out())
+
+        stepped = jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(state_specs, batch_specs, pspec, P()),
+            out_specs=(state_specs, out_specs_batch, P()),
+        )
+        return jax.jit(stepped, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+
+    def shard_of(self, key: str) -> int:
+        return fnv1a_64(key.encode()) % self.n_shards
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        if now_ms is None:
+            now_ms = self.clock.now_ms()
+        n = len(requests)
+        if n == 0:
+            return []
+        responses: List[Optional[RateLimitResp]] = [None] * n
+        now_dt = None
+
+        greg_dur = np.zeros(n, dtype=_I64)
+        greg_exp = np.zeros(n, dtype=_I64)
+        valid: List[int] = []
+        for i, r in enumerate(requests):
+            if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN:
+                if now_dt is None:
+                    # Same time-source invariant as core.engine: civil
+                    # time derives from now_ms, never a second read.
+                    now_dt = dt_from_ms(now_ms)
+                try:
+                    greg_dur[i] = gregorian_duration(now_dt, r.duration)
+                    greg_exp[i] = gregorian_expiration(now_dt, r.duration)
+                except GregorianError as e:
+                    responses[i] = RateLimitResp(error=str(e))
+                    continue
+            valid.append(i)
+
+        with self._lock:
+            self._apply(requests, valid, greg_dur, greg_exp, now_ms, responses)
+            self.requests_total += n
+            self.batches_total += 1
+        return responses  # type: ignore[return-value]
+
+    def _apply(
+        self,
+        requests: Sequence[RateLimitReq],
+        valid: List[int],
+        greg_dur: np.ndarray,
+        greg_exp: np.ndarray,
+        now_ms: int,
+        responses: List[Optional[RateLimitResp]],
+    ) -> None:
+        if not valid:
+            return
+        n_sh = self.n_shards
+        # Route + intern + schedule rounds (per shard).
+        seqs: List[Dict[int, int]] = [dict() for _ in range(n_sh)]
+        rounds: Dict[int, List[List[Tuple[int, int]]]] = {}
+        clear_rounds: Dict[int, List[List[int]]] = {}
+        slot_of: Dict[int, Tuple[int, int]] = {}
+        for i in valid:
+            key = requests[i].hash_key()
+            sh = self.shard_of(key)
+            evicted: List[int] = []
+            slot = self.tables[sh].intern(key, now_ms, evicted)
+            for es in evicted:
+                k = seqs[sh].get(es, 0)
+                clear_rounds.setdefault(k, [[] for _ in range(n_sh)])[sh].append(es)
+            k = seqs[sh].get(slot, 0)
+            seqs[sh][slot] = k + 1
+            rounds.setdefault(k, [[] for _ in range(n_sh)])[sh].append((i, slot))
+            slot_of[i] = (sh, slot)
+
+        for k in sorted(set(rounds) | set(clear_rounds)):
+            members = rounds.get(k, [[] for _ in range(n_sh)])
+            clears = clear_rounds.get(k, [[] for _ in range(n_sh)])
+            # Chunk wide rounds to bound compiled shapes.
+            offset = 0
+            while True:
+                chunk = [m[offset : offset + self.max_kernel_width] for m in members]
+                if not any(chunk) and offset > 0:
+                    break
+                self._run_round(
+                    chunk,
+                    clears if offset == 0 else [[] for _ in range(n_sh)],
+                    greg_dur,
+                    greg_exp,
+                    now_ms,
+                    requests,
+                    responses,
+                )
+                self.rounds_total += 1
+                offset += self.max_kernel_width
+                if all(offset >= len(m) for m in members):
+                    break
+
+    def _run_round(
+        self,
+        members: List[List[Tuple[int, int]]],
+        clears: List[List[int]],
+        greg_dur: np.ndarray,
+        greg_exp: np.ndarray,
+        now_ms: int,
+        requests: Sequence[RateLimitReq],
+        responses: List[Optional[RateLimitResp]],
+    ) -> None:
+        n_sh = self.n_shards
+        cap = self.shard_capacity
+        width = _pad_size(max((len(m) for m in members), default=1))
+        csize = _pad_size(max((len(c) for c in clears), default=1), floor=16)
+
+        # Padding: distinct ascending out-of-range slots per shard.
+        b_slot = np.tile(
+            np.arange(cap, cap + width, dtype=_I64).astype(_I32), (n_sh, 1)
+        )
+        b_algo = np.zeros((n_sh, width), dtype=_I32)
+        b_beh = np.zeros((n_sh, width), dtype=_I32)
+        b_hits = np.zeros((n_sh, width), dtype=_I64)
+        b_limit = np.zeros((n_sh, width), dtype=_I64)
+        b_dur = np.zeros((n_sh, width), dtype=_I64)
+        b_burst = np.zeros((n_sh, width), dtype=_I64)
+        b_gdur = np.zeros((n_sh, width), dtype=_I64)
+        b_gexp = np.zeros((n_sh, width), dtype=_I64)
+        b_clear = np.tile(
+            np.arange(cap, cap + csize, dtype=_I64).astype(_I32), (n_sh, 1)
+        )
+
+        host_expire: List[Tuple[int, int, int]] = []  # (shard, slot, expire)
+        for sh in range(n_sh):
+            for lane, (i, slot) in enumerate(members[sh]):
+                r = requests[i]
+                b_slot[sh, lane] = slot
+                b_algo[sh, lane] = int(r.algorithm)
+                b_beh[sh, lane] = int(r.behavior)
+                b_hits[sh, lane] = r.hits
+                b_limit[sh, lane] = r.limit
+                b_dur[sh, lane] = r.duration
+                b_burst[sh, lane] = r.burst
+                b_gdur[sh, lane] = greg_dur[i]
+                b_gexp[sh, lane] = greg_exp[i]
+                exp = (
+                    greg_exp[i]
+                    if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN
+                    else now_ms + r.duration
+                )
+                host_expire.append((sh, slot, exp))
+            for c, slot in enumerate(clears[sh]):
+                b_clear[sh, c] = slot
+
+        batch = BatchInput(
+            slot=jnp.asarray(b_slot),
+            algo=jnp.asarray(b_algo),
+            behavior=jnp.asarray(b_beh),
+            hits=jnp.asarray(b_hits),
+            limit=jnp.asarray(b_limit),
+            duration=jnp.asarray(b_dur),
+            burst=jnp.asarray(b_burst),
+            greg_duration=jnp.asarray(b_gdur),
+            greg_expire=jnp.asarray(b_gexp),
+        )
+        self._state, out, over = self._step(
+            self._state,
+            batch,
+            jnp.asarray(b_clear),
+            jnp.asarray(now_ms, dtype=jnp.int64),
+        )
+        self.over_limit_total += int(over)
+
+        o_status = np.asarray(out.status)
+        o_limit = np.asarray(out.limit)
+        o_rem = np.asarray(out.remaining)
+        o_reset = np.asarray(out.reset_time)
+        for sh in range(n_sh):
+            for lane, (i, _slot) in enumerate(members[sh]):
+                responses[i] = RateLimitResp(
+                    status=Status(int(o_status[sh, lane])),
+                    limit=int(o_limit[sh, lane]),
+                    remaining=int(o_rem[sh, lane]),
+                    reset_time=int(o_reset[sh, lane]),
+                )
+        for sh, slot, exp in host_expire:
+            self.tables[sh].set_expiry(np.asarray([slot]), np.asarray([exp]))
+
+    def cache_size(self) -> int:
+        return sum(len(t) for t in self.tables)
+
+    def close(self) -> None:
+        pass
+
+
+def _dummy_out():
+    from gubernator_tpu.ops.bucket_kernel import BatchOutput
+
+    return BatchOutput(*(0,) * len(BatchOutput._fields))

@@ -28,8 +28,10 @@ class SpecShadow:
         self.buckets: dict[str, SlotState] = {}
 
     def apply(self, reqs: list[RateLimitReq]):
+        from gubernator_tpu.gregorian import dt_from_ms
+
         now = self.clock.now_ms()
-        now_dt = self.clock.now_datetime()
+        now_dt = dt_from_ms(now)
         outs = []
         for r in reqs:
             greg_dur = greg_exp = 0
