@@ -1,0 +1,84 @@
+"""Headline benchmark: end-to-end rate-limit decisions/sec on one chip.
+
+Drives the full local decision path — key interning, round scheduling,
+batch assembly, the jitted bucket kernel on the TPU, response
+materialization — exactly what a daemon does per 500µs window.
+
+Baseline: the reference sustains > 2,000 requests/sec on a production
+node (reference: README.md:97-100; SURVEY.md §6).  `vs_baseline` is the
+multiple over that figure.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "decisions/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_DECISIONS_PER_SEC = 2000.0  # reference README.md:97-100
+
+BATCH = 8192
+N_KEYS = 100_000
+CAPACITY = 1 << 17  # 131072 slots
+WARMUP_BATCHES = 3
+MEASURE_SECONDS = 5.0
+
+
+def main() -> None:
+    from gubernator_tpu import Algorithm, Behavior, RateLimitReq
+    from gubernator_tpu.core.engine import DecisionEngine
+
+    engine = DecisionEngine(capacity=CAPACITY)
+
+    # Pre-build request objects (client-side cost, not engine cost).
+    reqs = []
+    for b in range((N_KEYS + BATCH - 1) // BATCH):
+        batch = [
+            RateLimitReq(
+                name="bench",
+                unique_key=f"k{(b * BATCH + i) % N_KEYS}",
+                hits=1,
+                limit=1_000_000,
+                duration=3_600_000,
+                algorithm=(
+                    Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET
+                ),
+                behavior=Behavior.BATCHING,
+            )
+            for i in range(BATCH)
+        ]
+        reqs.append(batch)
+
+    for i in range(WARMUP_BATCHES):
+        engine.get_rate_limits(reqs[i % len(reqs)])
+
+    n_done = 0
+    start = time.perf_counter()
+    i = 0
+    while True:
+        engine.get_rate_limits(reqs[i % len(reqs)])
+        n_done += BATCH
+        i += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= MEASURE_SECONDS:
+            break
+
+    rate = n_done / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "rate-limit decisions/sec, single chip, end-to-end "
+                f"(batch={BATCH}, {N_KEYS} hot keys)",
+                "value": round(rate, 1),
+                "unit": "decisions/sec",
+                "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,8 +7,8 @@ Here the intra-node tier becomes a 1-D `jax.sharding.Mesh` over TPU
 chips: bucket state arrays are sharded over the "keys" axis, each
 ~500µs batch is routed host-side to its owning shard, and one
 shard_map'ed kernel call updates every shard in parallel with zero
-cross-chip traffic on the decision path (SURVEY.md §2.2).  GLOBAL
-aggregation rides ICI collectives (see cluster/global_manager.py).
+cross-chip traffic on the decision path (SURVEY.md §2.2); the step ends
+with a psum over the mesh so cluster metrics ride ICI.
 """
 
 from gubernator_tpu.parallel.mesh import make_mesh

@@ -27,7 +27,3 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
 def keys_sharding(mesh: Mesh) -> NamedSharding:
     """Shard a leading-axis array over the keys axis."""
     return NamedSharding(mesh, PartitionSpec(KEYS_AXIS))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, PartitionSpec())

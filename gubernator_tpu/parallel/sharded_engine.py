@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gubernator_tpu.clock import SYSTEM_CLOCK, Clock
 from gubernator_tpu.gregorian import (
@@ -39,7 +39,7 @@ from gubernator_tpu.ops.bucket_kernel import (
     make_state,
 )
 from gubernator_tpu.core.interning import InternTable
-from gubernator_tpu.parallel.mesh import KEYS_AXIS, make_mesh
+from gubernator_tpu.parallel.mesh import KEYS_AXIS, keys_sharding, make_mesh
 from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
 
 _I32 = np.int32
@@ -88,9 +88,7 @@ class ShardedDecisionEngine:
         self.batches_total = 0
         self.rounds_total = 0
 
-        state_spec = jax.tree.map(
-            lambda _: NamedSharding(self.mesh, P(KEYS_AXIS)), make_state(0)
-        )
+        state_spec = jax.tree.map(lambda _: keys_sharding(self.mesh), make_state(0))
         # Allocate the sharded state: [n_shards, shard_capacity] blocks.
         self._state: BucketState = jax.tree.map(
             lambda leaf, sh: jax.device_put(
@@ -259,7 +257,9 @@ class ShardedDecisionEngine:
             np.arange(cap, cap + csize, dtype=_I64).astype(_I32), (n_sh, 1)
         )
 
-        host_expire: List[Tuple[int, int, int]] = []  # (shard, slot, expire)
+        host_expire: List[Tuple[List[int], List[int]]] = [
+            ([], []) for _ in range(n_sh)
+        ]  # per shard: (slots, expires)
         for sh in range(n_sh):
             for lane, (i, slot) in enumerate(members[sh]):
                 r = requests[i]
@@ -277,7 +277,8 @@ class ShardedDecisionEngine:
                     if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN
                     else now_ms + r.duration
                 )
-                host_expire.append((sh, slot, exp))
+                host_expire[sh][0].append(slot)
+                host_expire[sh][1].append(exp)
             for c, slot in enumerate(clears[sh]):
                 b_clear[sh, c] = slot
 
@@ -312,8 +313,35 @@ class ShardedDecisionEngine:
                     remaining=int(o_rem[sh, lane]),
                     reset_time=int(o_reset[sh, lane]),
                 )
-        for sh, slot, exp in host_expire:
-            self.tables[sh].set_expiry(np.asarray([slot]), np.asarray([exp]))
+        for sh, (e_slots, e_exps) in enumerate(host_expire):
+            if e_slots:
+                self.tables[sh].set_expiry(
+                    np.asarray(e_slots, dtype=_I32), np.asarray(e_exps, dtype=_I64)
+                )
+
+    def sweep(self, now_ms: Optional[int] = None) -> int:
+        """Reclaim slots of expired buckets on every shard; returns the
+        number freed (sharded counterpart of DecisionEngine.sweep)."""
+        from gubernator_tpu.ops.expiry import sweep_expired
+
+        if now_ms is None:
+            now_ms = self.clock.now_ms()
+        with self._lock:
+            new_occ, freed = sweep_expired(
+                self._state.occupied,
+                self._state.expire_hi,
+                self._state.expire_lo,
+                jnp.asarray(now_ms >> 32, dtype=jnp.int32),
+                jnp.asarray(now_ms & 0xFFFFFFFF, dtype=jnp.uint32),
+            )
+            self._state = self._state._replace(occupied=new_occ)
+            freed_np = np.asarray(freed)  # [n_shards, shard_capacity]
+            total = 0
+            for sh in range(self.n_shards):
+                slots = np.nonzero(freed_np[sh])[0]
+                self.tables[sh].release_slots(slots)
+                total += int(slots.size)
+        return total
 
     def cache_size(self) -> int:
         return sum(len(t) for t in self.tables)

@@ -89,6 +89,19 @@ def test_duplicate_keys_sequential_on_shard(sharded, frozen_clock):
     assert [r.remaining for r in resps] == [2, 1, 0, 0, 0]
 
 
+def test_sharded_sweep_reclaims_expired(sharded, frozen_clock):
+    reqs = [
+        RateLimitReq(name="sw", unique_key=f"k{i}", hits=1, limit=5, duration=SECOND)
+        for i in range(32)
+    ]
+    sharded.get_rate_limits(reqs)
+    assert sharded.cache_size() == 32
+    assert sharded.sweep() == 0  # nothing expired yet
+    frozen_clock.advance(ms=2 * SECOND)
+    assert sharded.sweep() == 32
+    assert sharded.cache_size() == 0
+
+
 def test_eviction_and_reuse_within_one_batch_sharded(frozen_clock):
     eng = ShardedDecisionEngine(shard_capacity=1, clock=frozen_clock)
     reqs = [
