@@ -1,0 +1,19 @@
+"""Cluster tier: peer topology, routing, forwarding, GLOBAL sync.
+
+The host-level distribution plane of the framework (SURVEY.md §2.2/§2.3):
+consistent-hash key→owner routing, batched peer forwarding over gRPC,
+async GLOBAL aggregation/broadcast.  The device-level plane (key→shard
+within the mesh, ICI collectives) lives in `gubernator_tpu.parallel`.
+"""
+
+from gubernator_tpu.cluster.hash_ring import (
+    DEFAULT_REPLICAS,
+    ReplicatedConsistentHash,
+    RegionPicker,
+)
+
+__all__ = [
+    "DEFAULT_REPLICAS",
+    "ReplicatedConsistentHash",
+    "RegionPicker",
+]

@@ -1,0 +1,205 @@
+"""Consistent-hash peer routing.
+
+Host-level key→owner-peer assignment with the same semantics as the
+reference (reference: replicated_hash.go:29-119): each peer contributes
+`replicas` virtual points on a uint64 ring — point i is
+`hash(str(i) + md5hex(grpc_address))` — and a key routes to the first
+ring point clockwise from `hash(key)`.  Hash is FNV-1 by default, FNV-1a
+selectable (reference: config.go:395-417).
+
+TPU-first twist: routing is *batch-vectorized*.  The ring is a sorted
+numpy uint64 array, a request batch is hashed in one vectorized FNV pass
+(`hashing.fnv1_64_batch`) and routed with one `np.searchsorted` — the
+host-side analog of the device kernel's gather, so the per-request
+Python cost stays flat as batches grow.
+
+`RegionPicker` keeps one ring per datacenter for MULTI_REGION routing
+(reference: region_picker.go:33-111).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict, Generic, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+from gubernator_tpu.hashing import fnv1_64, fnv1a_64, fnv1_64_batch, fnv1a_64_batch, pack_keys
+from gubernator_tpu.types import PeerInfo
+
+# reference: replicated_hash.go:29 (defaultReplicas = 512)
+DEFAULT_REPLICAS = 512
+
+T = TypeVar("T")  # the member type: anything carrying a PeerInfo via .info
+
+
+class PoolEmptyError(RuntimeError):
+    """reference: replicated_hash.go:106 ("unable to pick a peer; pool is empty")"""
+
+    def __init__(self) -> None:
+        super().__init__("unable to pick a peer; pool is empty")
+
+
+_SCALAR = {"fnv1": fnv1_64, "fnv1a": fnv1a_64}
+_BATCH = {"fnv1": fnv1_64_batch, "fnv1a": fnv1a_64_batch}
+
+
+class ReplicatedConsistentHash(Generic[T]):
+    """Ring of virtual peer replicas; keys route via binary search.
+
+    Members are arbitrary objects exposing `.info -> PeerInfo`; identity
+    is `info.grpc_address` (reference: replicated_hash.go:78-79).
+    """
+
+    def __init__(self, hash_name: str = "fnv1", replicas: int = DEFAULT_REPLICAS):
+        if hash_name not in _SCALAR:
+            raise ValueError(f"unknown hash {hash_name!r}; want fnv1 or fnv1a")
+        self.hash_name = hash_name
+        self.replicas = replicas
+        self._hash: Callable[[bytes], int] = _SCALAR[hash_name]
+        self._members: Dict[str, T] = {}
+        # Virtual ring points per member address, computed once on first
+        # add (vectorized) and reused across rebuilds.
+        self._points: Dict[str, np.ndarray] = {}
+        # Sorted ring: hashes[i] is the ring point, owner_idx[i] indexes
+        # into _member_list.
+        self._member_list: List[T] = []
+        self._hashes = np.empty(0, dtype=np.uint64)
+        self._owner_idx = np.empty(0, dtype=np.int32)
+
+    def new(self) -> "ReplicatedConsistentHash[T]":
+        """Fresh empty picker with the same configuration.
+
+        reference: replicated_hash.go:61-67
+        """
+        return ReplicatedConsistentHash(self.hash_name, self.replicas)
+
+    # -- membership ----------------------------------------------------
+
+    def add(self, member: T) -> None:
+        """reference: replicated_hash.go:78-91"""
+        info: PeerInfo = member.info  # type: ignore[attr-defined]
+        self._members[info.grpc_address] = member
+        self._rebuild()
+
+    def add_all(self, members: Sequence[T]) -> None:
+        for m in members:
+            info: PeerInfo = m.info  # type: ignore[attr-defined]
+            self._members[info.grpc_address] = m
+        self._rebuild()
+
+    def _member_points(self, address: str) -> np.ndarray:
+        """The member's `replicas` ring points, cached after first use.
+
+        Virtual point i = hash(str(i) + md5hex(address))
+        (reference: replicated_hash.go:81-84), all `replicas` points
+        hashed in one vectorized pass.
+        """
+        points = self._points.get(address)
+        if points is None:
+            key = hashlib.md5(address.encode()).hexdigest()
+            padded, lengths = pack_keys(
+                [(str(i) + key).encode() for i in range(self.replicas)]
+            )
+            points = _BATCH[self.hash_name](padded, lengths)
+            self._points[address] = points
+        return points
+
+    def _rebuild(self) -> None:
+        self._member_list = list(self._members.values())
+        addresses = [m.info.grpc_address for m in self._member_list]  # type: ignore[attr-defined]
+        if not addresses:
+            self._hashes = np.empty(0, dtype=np.uint64)
+            self._owner_idx = np.empty(0, dtype=np.int32)
+            return
+        hashes = np.concatenate([self._member_points(a) for a in addresses])
+        owners = np.repeat(
+            np.arange(len(addresses), dtype=np.int32), self.replicas
+        )
+        order = np.argsort(hashes, kind="stable")
+        self._hashes = hashes[order]
+        self._owner_idx = owners[order]
+
+    def size(self) -> int:
+        return len(self._members)
+
+    def peers(self) -> List[T]:
+        return list(self._members.values())
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[T]:
+        """reference: replicated_hash.go:99-101"""
+        return self._members.get(info.grpc_address)
+
+    # -- routing -------------------------------------------------------
+
+    def get(self, key: str) -> T:
+        """Owner of one key. reference: replicated_hash.go:104-119"""
+        if not self._member_list:
+            raise PoolEmptyError()
+        h = self._hash(key.encode())
+        idx = int(np.searchsorted(self._hashes, np.uint64(h), side="left"))
+        if idx == len(self._hashes):
+            idx = 0
+        return self._member_list[self._owner_idx[idx]]
+
+    def get_batch(self, keys: Sequence[str]) -> List[T]:
+        """Vectorized owner lookup for a whole request batch."""
+        if not self._member_list:
+            raise PoolEmptyError()
+        if not keys:
+            return []
+        padded, lengths = pack_keys([k.encode() for k in keys])
+        hashes = _BATCH[self.hash_name](padded, lengths)
+        idx = np.searchsorted(self._hashes, hashes, side="left")
+        idx[idx == len(self._hashes)] = 0
+        owners = self._owner_idx[idx]
+        return [self._member_list[i] for i in owners]
+
+
+class RegionPicker(Generic[T]):
+    """One consistent-hash ring per datacenter.
+
+    reference: region_picker.go:33-111.  `get_clients(key)` returns the
+    key's owner in *every* region (used by MULTI_REGION replication).
+    """
+
+    def __init__(self, hash_name: str = "fnv1", replicas: int = DEFAULT_REPLICAS):
+        self.hash_name = hash_name
+        self.replicas = replicas
+        self._regions: Dict[str, ReplicatedConsistentHash[T]] = {}
+
+    def new(self) -> "RegionPicker[T]":
+        return RegionPicker(self.hash_name, self.replicas)
+
+    def add(self, member: T) -> None:
+        """reference: region_picker.go:104-111"""
+        info: PeerInfo = member.info  # type: ignore[attr-defined]
+        picker = self._regions.get(info.datacenter)
+        if picker is None:
+            picker = ReplicatedConsistentHash(self.hash_name, self.replicas)
+            self._regions[info.datacenter] = picker
+        picker.add(member)
+
+    def get_clients(self, key: str) -> List[T]:
+        """The key's owner in every region. reference: region_picker.go:63-75"""
+        return [picker.get(key) for picker in self._regions.values()]
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[T]:
+        """reference: region_picker.go:78-85"""
+        for picker in self._regions.values():
+            member = picker.get_by_peer_info(info)
+            if member is not None:
+                return member
+        return None
+
+    def pickers(self) -> Dict[str, ReplicatedConsistentHash[T]]:
+        return self._regions
+
+    def peers(self) -> List[T]:
+        out: List[T] = []
+        for picker in self._regions.values():
+            out.extend(picker.peers())
+        return out
+
+    def size(self) -> int:
+        return sum(p.size() for p in self._regions.values())

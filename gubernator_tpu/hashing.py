@@ -56,6 +56,20 @@ def fnv1_64_batch(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     return h
 
 
+def fnv1a_64_batch(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a (xor then multiply) — see fnv1_64_batch."""
+    n, max_len = padded.shape
+    h = np.full(n, FNV1_OFFSET, dtype=np.uint64)
+    prime = np.uint64(FNV1_PRIME)
+    for col in range(max_len):
+        active = lengths > col
+        if not active.any():
+            break
+        nh = (h ^ padded[:, col].astype(np.uint64)) * prime
+        h = np.where(active, nh, h)
+    return h
+
+
 def pack_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
     """Pack variable-length byte keys into a padded uint8 matrix."""
     n = len(keys)
