@@ -1,0 +1,87 @@
+"""Client library for gubernator_tpu (and reference) daemons.
+
+reference: client.go — DialV1Server (:42-64), HashKey (:37-39, lives on
+RateLimitReq.hash_key here), millisecond timestamp helpers (:69-85),
+RandomPeer/RandomString (:88-104).
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import time
+from typing import List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.net import serde
+from gubernator_tpu.net.grpc_service import V1Stub, dial
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import (
+    HealthCheckResp,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+)
+
+
+class V1Client:
+    """Typed client over the V1 gRPC service."""
+
+    def __init__(
+        self,
+        address: str,
+        *,
+        credentials: Optional[grpc.ChannelCredentials] = None,
+    ):
+        self.address = address
+        self._channel = dial(address, credentials=credentials)
+        self._stub = V1Stub(self._channel)
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
+        resp = self._stub.GetRateLimits(
+            serde.get_rate_limits_req_to_pb(requests), timeout=timeout
+        )
+        return [serde.rate_limit_resp_from_pb(m) for m in resp.responses]
+
+    def health_check(self, timeout: Optional[float] = None) -> HealthCheckResp:
+        return serde.health_check_resp_from_pb(
+            self._stub.HealthCheck(pb.HealthCheckReq(), timeout=timeout)
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "V1Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def to_timestamp(t: float) -> int:
+    """Seconds → unix-epoch ms. reference: client.go:69-77."""
+    return int(t * 1000)
+
+
+def from_timestamp(ms: int) -> float:
+    """Unix-epoch ms → seconds. reference: client.go:80-85."""
+    return ms / 1000.0
+
+
+def now_ms() -> int:
+    return to_timestamp(time.time())
+
+
+def random_peer(peers: List[PeerInfo]) -> PeerInfo:
+    """reference: client.go:88-91."""
+    return random.choice(peers)
+
+
+def random_string(n: int = 10, prefix: str = "") -> str:
+    """reference: client.go:94-104."""
+    return prefix + "".join(
+        random.choices(string.ascii_lowercase + string.digits, k=n)
+    )

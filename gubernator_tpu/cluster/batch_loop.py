@@ -1,0 +1,87 @@
+"""Interval-driven aggregation loop — the framework's host-side batcher.
+
+The reference builds this pattern three times (peer batching
+peer_client.go:380-453, GLOBAL hit/broadcast loops global.go:78-202,
+multi-region multiregion.go:43-92): accumulate items into an aggregate,
+flush when the aggregate reaches `batch_limit` or `sync_wait` has
+elapsed since the first item.  This is the one host-side primitive that
+feeds the TPU step cadence, so it lives in one place.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Generic, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class IntervalBatcher(Generic[K, V]):
+    """Aggregate (key, item) pairs; flush at batch_limit or sync_wait.
+
+    `combine(existing, item) -> merged` merges a new item into the
+    aggregate for its key (None existing for the first).  `flush(dict)`
+    runs on the batcher thread; long work should hop to an executor.
+    """
+
+    def __init__(
+        self,
+        sync_wait: float,
+        batch_limit: int,
+        combine: Callable,
+        flush: Callable[[Dict[K, V]], None],
+        *,
+        name: str = "batcher",
+    ):
+        self.sync_wait = sync_wait
+        self.batch_limit = batch_limit
+        self._combine = combine
+        self._flush = flush
+        self._items: Dict[K, V] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closing = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def add(self, key: K, item) -> None:
+        with self._lock:
+            if self._closing:
+                return
+            self._items[key] = self._combine(self._items.get(key), item)
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._items and not self._closing:
+                    self._cv.wait()
+                if self._closing and not self._items:
+                    return
+                deadline = time.monotonic() + self.sync_wait
+                while len(self._items) < self.batch_limit and not self._closing:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch = self._items
+                self._items = {}
+            try:
+                self._flush(batch)
+            except Exception:  # noqa: BLE001 — loop must survive flush errors
+                import logging
+
+                logging.getLogger("gubernator_tpu").exception(
+                    "batcher flush failed"
+                )
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop, flushing anything still queued."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+            self._cv.notify_all()
+        self._thread.join(timeout)

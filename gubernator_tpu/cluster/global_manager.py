@@ -1,0 +1,165 @@
+"""GLOBAL behavior: async hit aggregation + owner broadcast.
+
+reference: global.go.  Two independent interval loops:
+
+- hits loop (non-owners): aggregate queued hits per key (summing
+  `hits`, global.go:92-95), then group per owner peer and forward via
+  `GetPeerRateLimits` (global.go:124-164).
+- broadcast loop (owner): dedupe updated keys per window, re-read own
+  authoritative state with GLOBAL cleared and hits=0, and push
+  `UpdatePeerGlobals` to every other peer (global.go:167-250).
+
+The broadcast's local re-read rides the TPU engine as one batch (the
+reference loops per key); the per-peer fan-out is host gRPC over DCN —
+the ICI-level aggregation lives in the sharded engine's psum step.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import replace
+from typing import TYPE_CHECKING, Dict, List
+
+from gubernator_tpu.cluster.batch_loop import IntervalBatcher
+from gubernator_tpu.cluster.peer_client import PeerError
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, UpdatePeerGlobal
+
+if TYPE_CHECKING:
+    from gubernator_tpu.service import V1Instance
+
+log = logging.getLogger("gubernator_tpu.global")
+
+
+def _combine_hits(existing: RateLimitReq | None, r: RateLimitReq) -> RateLimitReq:
+    """Sum hits for the same key within a window. reference: global.go:92-95."""
+    if existing is None:
+        return r
+    return replace(existing, hits=existing.hits + r.hits)
+
+
+def _combine_updates(existing: RateLimitReq | None, r: RateLimitReq) -> RateLimitReq:
+    """Broadcasts dedupe by key, keeping the latest. reference: global.go:176."""
+    return r
+
+
+class GlobalManager:
+    """reference: global.go:33-66 (globalManager)."""
+
+    def __init__(self, conf: BehaviorConfig, instance: "V1Instance"):
+        self.conf = conf
+        self.instance = instance
+        # Metrics counters (scraped via utils.metrics).
+        self.async_sends = 0
+        self.broadcasts = 0
+        self._hits = IntervalBatcher(
+            conf.global_sync_wait,
+            conf.global_batch_limit,
+            _combine_hits,
+            self._send_hits,
+            name="guber-global-hits",
+        )
+        self._updates = IntervalBatcher(
+            conf.global_sync_wait,
+            conf.global_batch_limit,
+            _combine_updates,
+            self._broadcast_peers,
+            name="guber-global-bcast",
+        )
+
+    def queue_hit(self, r: RateLimitReq) -> None:
+        """Queue hits observed by a non-owner. reference: global.go:68-70."""
+        self._hits.add(r.hash_key(), r)
+
+    def queue_update(self, r: RateLimitReq) -> None:
+        """Mark a key the owner must re-broadcast. reference: global.go:72-74."""
+        self._updates.add(r.hash_key(), r)
+
+    # -- flush paths (run on batcher threads) --------------------------
+
+    def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        """Group aggregated hits per owner and forward.
+
+        reference: global.go:124-164 (sendHits).
+        """
+        by_peer: Dict[str, List[RateLimitReq]] = {}
+        clients = {}
+        for key, r in hits.items():
+            try:
+                peer = self.instance.get_peer(key)
+            except Exception as e:  # noqa: BLE001
+                log.error("while getting peer for hash key '%s': %s", key, e)
+                continue
+            addr = peer.info.grpc_address
+            by_peer.setdefault(addr, []).append(r)
+            clients[addr] = peer
+        for addr, reqs in by_peer.items():
+            peer = clients[addr]
+            try:
+                if peer.info.is_owner:
+                    # Ownership may have moved to us between the queue
+                    # and the flush; apply locally instead of dialing
+                    # ourselves.
+                    self.instance.apply_local_batch(reqs)
+                else:
+                    peer.get_peer_rate_limits(
+                        reqs, timeout=self.conf.global_timeout
+                    )
+            except PeerError as e:
+                log.error("error sending global hits to '%s': %s", addr, e)
+                continue
+        self.async_sends += 1
+
+    def _broadcast_peers(self, updates: Dict[str, RateLimitReq]) -> None:
+        """Re-read own state and push it to every peer.
+
+        reference: global.go:205-250 (broadcastPeers).
+        """
+        # Clear GLOBAL (so the re-read doesn't requeue a broadcast) and
+        # zero the hits (status query), then one engine batch.
+        reqs = [
+            replace(
+                r,
+                behavior=int(r.behavior) & ~int(Behavior.GLOBAL),
+                hits=0,
+            )
+            for r in updates.values()
+        ]
+        resps = self.instance.apply_local_batch(reqs)
+        globals_: List[UpdatePeerGlobal] = []
+        for r, resp in zip(reqs, resps):
+            if resp.error:
+                log.error(
+                    "while broadcasting update to peers for '%s': %s",
+                    r.hash_key(),
+                    resp.error,
+                )
+                continue
+            globals_.append(
+                UpdatePeerGlobal(
+                    key=r.hash_key(),
+                    status=resp,
+                    algorithm=Algorithm(r.algorithm),
+                )
+            )
+        if not globals_:
+            return
+        for peer in self.instance.get_peer_list():
+            if peer.info.is_owner:  # exclude ourselves
+                continue
+            try:
+                peer.update_peer_globals(globals_, timeout=self.conf.global_timeout)
+            except PeerError as e:
+                if not e.not_ready:
+                    log.error(
+                        "while broadcasting global updates to '%s': %s",
+                        peer.info.grpc_address,
+                        e,
+                    )
+                continue
+        self.broadcasts += 1
+
+    def close(self) -> None:
+        self._hits.close()
+        self._updates.close()

@@ -1,0 +1,154 @@
+"""In-process cluster harness: N full daemons in one process.
+
+reference: cluster/cluster.go — StartWith spawns real daemons with
+test-tuned behaviors (:101-136), injects the full peer list directly
+via SetPeers instead of running discovery (:131-134), and supports
+kill/restart for failure tests (:89-98).  Every "node" here is a full
+Daemon: its own gRPC server, gateway, engine, and managers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+from typing import List, Optional, Sequence
+
+from gubernator_tpu.clock import SYSTEM_CLOCK, Clock
+from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.daemon import Daemon, spawn_daemon
+from gubernator_tpu.types import PeerInfo
+
+
+def test_behaviors() -> BehaviorConfig:
+    """Cluster-test knobs (reference: cluster/cluster.go:109-115 tunes
+    GlobalSyncWait etc. for fast tests)."""
+    return BehaviorConfig(
+        global_sync_wait=0.05,
+        global_timeout=1.0,
+        batch_timeout=1.0,
+        batch_wait=0.005,
+        multi_region_sync_wait=0.05,
+        multi_region_timeout=1.0,
+    )
+
+
+class ClusterHarness:
+    """Spawn-and-wire N in-process daemons."""
+
+    def __init__(self) -> None:
+        self.daemons: List[Daemon] = []
+        self._datacenters: List[str] = []
+        self._clock: Clock = SYSTEM_CLOCK
+        self._behaviors = test_behaviors()
+        self._cache_size = 5_000
+
+    # -- startup -------------------------------------------------------
+
+    def start(
+        self,
+        count: int,
+        *,
+        datacenters: Optional[Sequence[str]] = None,
+        clock: Clock = SYSTEM_CLOCK,
+        behaviors: Optional[BehaviorConfig] = None,
+        cache_size: int = 5_000,
+    ) -> "ClusterHarness":
+        """Start `count` daemons (datacenters[i] assigns DCs) and give
+        every daemon the full peer list.
+
+        reference: cluster/cluster.go:101-136 (StartWith).
+        """
+        self._datacenters = list(datacenters or [""] * count)
+        assert len(self._datacenters) == count
+        self._clock = clock
+        if behaviors is not None:
+            self._behaviors = behaviors
+        self._cache_size = cache_size
+        for i in range(count):
+            self.daemons.append(self._spawn(self._datacenters[i]))
+        self._push_peers()
+        return self
+
+    def _spawn(self, datacenter: str, grpc_address: str = "127.0.0.1:0") -> Daemon:
+        conf = DaemonConfig(
+            grpc_listen_address=grpc_address,
+            http_listen_address="127.0.0.1:0",
+            behaviors=dc_replace(self._behaviors),
+            cache_size=self._cache_size,
+            data_center=datacenter,
+            peer_discovery_type="none",
+            device_count=1,  # one engine per in-process daemon
+        )
+        return spawn_daemon(conf, clock=self._clock)
+
+    def _push_peers(self) -> None:
+        peers = self.peers()
+        for d in self.daemons:
+            d.set_peers(peers)
+
+    # -- introspection -------------------------------------------------
+
+    def peers(self) -> List[PeerInfo]:
+        return [d.peer_info() for d in self.daemons]
+
+    def daemon_at(self, idx: int) -> Daemon:
+        """reference: cluster/cluster.go:63-66 (DaemonAt)."""
+        return self.daemons[idx]
+
+    def peer_at(self, idx: int) -> PeerInfo:
+        """reference: cluster/cluster.go:58-61 (PeerAt)."""
+        return self.daemons[idx].peer_info()
+
+    def get_random_peer(self, datacenter: str = "") -> PeerInfo:
+        """reference: cluster/cluster.go:68-79 (GetRandomPeer)."""
+        import random
+
+        options = [
+            d.peer_info()
+            for d, dc in zip(self.daemons, self._datacenters)
+            if dc == datacenter
+        ]
+        if not options:
+            raise ValueError(f"no peers in datacenter {datacenter!r}")
+        return random.choice(options)
+
+    def owner_of(self, key: str) -> Daemon:
+        """The daemon that owns `key` on the default-DC ring."""
+        peer = self.daemons[0].instance.get_peer(key)
+        addr = peer.info.grpc_address
+        for d in self.daemons:
+            if d.peer_info().grpc_address == addr:
+                return d
+        raise AssertionError(f"owner {addr} not in harness")
+
+    def non_owner_of(self, key: str) -> Daemon:
+        """A daemon in the default DC that does NOT own `key`."""
+        owner_addr = self.owner_of(key).peer_info().grpc_address
+        for d, dc in zip(self.daemons, self._datacenters):
+            if dc == "" and d.peer_info().grpc_address != owner_addr:
+                return d
+        raise AssertionError("cluster too small for a non-owner")
+
+    # -- lifecycle -----------------------------------------------------
+
+    def kill(self, idx: int) -> None:
+        """Stop one daemon without removing it from peer lists (peers
+        will see connection errors — failure-injection for health
+        tests; reference: functional_test.go:1063-1071)."""
+        self.daemons[idx].close()
+
+    def restart(self, idx: int) -> None:
+        """Restart a killed daemon on the same address.
+
+        reference: cluster/cluster.go:89-98 (Restart).
+        """
+        old = self.daemons[idx]
+        addr = old.grpc_address
+        old.close()
+        self.daemons[idx] = self._spawn(self._datacenters[idx], grpc_address=addr)
+        self._push_peers()
+
+    def stop(self) -> None:
+        """reference: cluster/cluster.go:139-145 (Stop)."""
+        for d in self.daemons:
+            d.close()
+        self.daemons = []

@@ -68,11 +68,14 @@ class ReplicatedConsistentHash(Generic[T]):
         self._owner_idx = np.empty(0, dtype=np.int32)
 
     def new(self) -> "ReplicatedConsistentHash[T]":
-        """Fresh empty picker with the same configuration.
+        """Fresh empty picker with the same configuration (ring-point
+        cache carried over so re-adding a known peer is free).
 
         reference: replicated_hash.go:61-67
         """
-        return ReplicatedConsistentHash(self.hash_name, self.replicas)
+        picker = ReplicatedConsistentHash(self.hash_name, self.replicas)
+        picker._points = dict(self._points)
+        return picker
 
     # -- membership ----------------------------------------------------
 
@@ -108,6 +111,10 @@ class ReplicatedConsistentHash(Generic[T]):
     def _rebuild(self) -> None:
         self._member_list = list(self._members.values())
         addresses = [m.info.grpc_address for m in self._member_list]  # type: ignore[attr-defined]
+        # Prune cached points of departed members — new() copies the
+        # cache forward on every membership change, so without pruning
+        # it would grow with every address ever seen.
+        self._points = {a: p for a, p in self._points.items() if a in self._members}
         if not addresses:
             self._hashes = np.empty(0, dtype=np.uint64)
             self._owner_idx = np.empty(0, dtype=np.int32)

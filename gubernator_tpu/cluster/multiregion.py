@@ -1,0 +1,67 @@
+"""Multi-region replication manager (wired, eventually-consistent stub).
+
+reference: multiregion.go — the reference queues and aggregates
+MULTI_REGION hits per key but its `sendHits` is an empty TODO stub
+(multiregion.go:94-98) and its test is empty (functional_test.go:
+1148-1156).  Capability parity is therefore "wired but stub": hits are
+aggregated per window; `_send_hits` resolves each key's owner in every
+region via the RegionPicker (the push itself is intentionally a no-op,
+matching the reference).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import replace
+from typing import TYPE_CHECKING, Dict
+
+from gubernator_tpu.cluster.batch_loop import IntervalBatcher
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.types import RateLimitReq
+
+if TYPE_CHECKING:
+    from gubernator_tpu.service import V1Instance
+
+log = logging.getLogger("gubernator_tpu.multiregion")
+
+
+def _combine(existing: RateLimitReq | None, r: RateLimitReq) -> RateLimitReq:
+    if existing is None:
+        return r
+    return replace(existing, hits=existing.hits + r.hits)
+
+
+class MultiRegionManager:
+    """reference: multiregion.go:22-40 (mutliRegionManager)."""
+
+    def __init__(self, conf: BehaviorConfig, instance: "V1Instance"):
+        self.conf = conf
+        self.instance = instance
+        self.windows = 0
+        self._hits = IntervalBatcher(
+            conf.multi_region_sync_wait,
+            conf.multi_region_batch_limit,
+            _combine,
+            self._send_hits,
+            name="guber-multiregion",
+        )
+
+    def queue_hits(self, r: RateLimitReq) -> None:
+        """reference: multiregion.go:43-45."""
+        self._hits.add(r.hash_key(), r)
+
+    def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        """Resolve each key's owner per region; pushing is a stub.
+
+        reference: multiregion.go:78-98 — "TODO: Send the hits to other
+        regions". Kept a no-op for parity.
+        """
+        for key in hits:
+            try:
+                self.instance.region_picker.get_clients(key)
+            except Exception as e:  # noqa: BLE001
+                log.error("while picking regional peers for '%s': %s", key, e)
+        self.windows += 1
+
+    def close(self) -> None:
+        self._hits.close()

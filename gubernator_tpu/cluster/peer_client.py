@@ -1,0 +1,325 @@
+"""PeerClient — connection + request batcher toward one owner peer.
+
+reference: peer_client.go.  Semantics preserved:
+
+- Lazy dial on first use (:96-162); TLS credentials optional.
+- BATCHING (default): requests enqueue into a per-peer queue drained by
+  a batcher thread that flushes when `batch_wait` (500µs default) has
+  elapsed since the first queued item or the queue reaches
+  `batch_limit` (1000); responses are redistributed to callers in order
+  (:308-376, :380-453, :457-516).
+- NO_BATCHING: a single-item unary RPC (:185-195).
+- Graceful shutdown drains queued + in-flight requests before closing
+  the channel (:519-553); requests after shutdown fail NotReady.
+- `last_errs` keeps a 5-minute TTL window of recent errors for
+  HealthCheck aggregation (:277-306).
+- `PeerError.not_ready` distinguishes retryable connection states; the
+  router's forward path retries on it (:556-580).
+
+Flushes run on a small per-client executor so a slow RPC doesn't stall
+the next 500µs window (the reference fires a goroutine per flush).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.net import serde
+from gubernator_tpu.net.grpc_service import PeersV1Stub, dial
+from gubernator_tpu.net.pb import peers_pb2 as peers_pb
+from gubernator_tpu.types import (
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+
+_LAST_ERRS_TTL = 300.0  # reference: peer_client.go:64 (5-minute TTL LRU)
+_LAST_ERRS_CAP = 100
+
+
+class PeerError(RuntimeError):
+    """Error talking to a peer; `not_ready` means the peer was not
+    connected and the caller may retry against a re-picked owner.
+
+    reference: peer_client.go:556-580 (PeerErr / NotReady).
+    """
+
+    def __init__(self, message: str, *, not_ready: bool = False):
+        super().__init__(message)
+        self.not_ready = not_ready
+
+
+class _Pending:
+    __slots__ = ("req", "future")
+
+    def __init__(self, req: RateLimitReq):
+        self.req = req
+        self.future: Future = Future()
+
+
+class PeerClient:
+    """A connection to one peer with request batching."""
+
+    def __init__(
+        self,
+        info: PeerInfo,
+        behaviors: Optional[BehaviorConfig] = None,
+        *,
+        credentials: Optional[grpc.ChannelCredentials] = None,
+    ):
+        self.info = info
+        self.behaviors = behaviors or BehaviorConfig()
+        self._credentials = credentials
+        self._channel: Optional[grpc.Channel] = None
+        self._stub: Optional[PeersV1Stub] = None
+        self._lock = threading.Lock()
+        self._queue: List[_Pending] = []
+        self._queue_cv = threading.Condition(self._lock)
+        self._closing = False
+        self._batcher: Optional[threading.Thread] = None
+        self._flusher: Optional[ThreadPoolExecutor] = None
+        self._inflight = 0
+        self._drained = threading.Condition(self._lock)
+        self._last_errs: Dict[str, float] = {}
+
+    # -- connection ----------------------------------------------------
+
+    def _connect(self) -> PeersV1Stub:
+        """Lazy dial. reference: peer_client.go:96-162."""
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            if self._stub is None:
+                self._channel = dial(
+                    self.info.grpc_address, credentials=self._credentials
+                )
+                self._stub = PeersV1Stub(self._channel)
+                self._flusher = ThreadPoolExecutor(
+                    max_workers=4,
+                    thread_name_prefix=f"guber-flush-{self.info.grpc_address}",
+                )
+                self._batcher = threading.Thread(
+                    target=self._run,
+                    name=f"guber-batch-{self.info.grpc_address}",
+                    daemon=True,
+                )
+                self._batcher.start()
+            return self._stub
+
+    def _set_last_err(self, err: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._last_errs[err] = now
+            if len(self._last_errs) > _LAST_ERRS_CAP:
+                for k in sorted(self._last_errs, key=self._last_errs.get)[
+                    : len(self._last_errs) - _LAST_ERRS_CAP
+                ]:
+                    del self._last_errs[k]
+
+    def last_errs(self) -> List[str]:
+        """Recent (≤5 min) errors. reference: peer_client.go:294-306."""
+        cutoff = time.monotonic() - _LAST_ERRS_TTL
+        with self._lock:
+            self._last_errs = {
+                k: t for k, t in self._last_errs.items() if t >= cutoff
+            }
+            return list(self._last_errs)
+
+    # -- public API ----------------------------------------------------
+
+    def get_peer_rate_limit(
+        self, req: RateLimitReq, timeout: Optional[float] = None
+    ) -> RateLimitResp:
+        """Forward one request; batched unless NO_BATCHING.
+
+        reference: peer_client.go:171-205.
+        """
+        if has_behavior(req.behavior, Behavior.NO_BATCHING):
+            resps = self.get_peer_rate_limits([req], timeout=timeout)
+            return resps[0]
+        return self._get_batched(req, timeout)
+
+    def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
+        """Unary batch RPC. reference: peer_client.go:208-246."""
+        stub = self._connect()
+        msg = peers_pb.GetPeerRateLimitsReq(
+            requests=[serde.rate_limit_req_to_pb(r) for r in reqs]
+        )
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            self._inflight += 1
+        try:
+            resp = stub.GetPeerRateLimits(
+                msg, timeout=timeout or self.behaviors.batch_timeout
+            )
+        except grpc.RpcError as e:
+            err = f"GetPeerRateLimits to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+        if len(resp.rate_limits) != len(reqs):
+            err = "number of rate limits in peer response does not match request"
+            self._set_last_err(err)
+            raise PeerError(err)
+        return [serde.rate_limit_resp_from_pb(r) for r in resp.rate_limits]
+
+    def update_peer_globals(
+        self, globals_: Sequence[UpdatePeerGlobal], timeout: Optional[float] = None
+    ) -> None:
+        """Push authoritative GLOBAL state to this peer.
+
+        reference: peer_client.go:248-275.
+        """
+        stub = self._connect()
+        msg = peers_pb.UpdatePeerGlobalsReq(
+            globals=[serde.update_peer_global_to_pb(g) for g in globals_]
+        )
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            self._inflight += 1
+        try:
+            stub.UpdatePeerGlobals(
+                msg, timeout=timeout or self.behaviors.global_timeout
+            )
+        except grpc.RpcError as e:
+            err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
+    # -- batching ------------------------------------------------------
+
+    def _get_batched(
+        self, req: RateLimitReq, timeout: Optional[float]
+    ) -> RateLimitResp:
+        """Enqueue and wait. reference: peer_client.go:308-376."""
+        self._connect()
+        pending = _Pending(req)
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            self._queue.append(pending)
+            self._queue_cv.notify()
+        try:
+            result = pending.future.result(
+                timeout=timeout or self.behaviors.batch_timeout
+            )
+        except TimeoutError:
+            raise PeerError(
+                f"timeout waiting for batched response from {self.info.grpc_address}"
+            )
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def _run(self) -> None:
+        """Batcher loop: flush at batch_wait after first item or at
+        batch_limit. reference: peer_client.go:380-453."""
+        wait = self.behaviors.batch_wait
+        limit = self.behaviors.batch_limit
+        while True:
+            with self._lock:
+                while not self._queue and not self._closing:
+                    self._queue_cv.wait()
+                if self._closing and not self._queue:
+                    return
+                # First item arrived; hold the window open until the
+                # deadline or the batch limit.
+                deadline = time.monotonic() + wait
+                while len(self._queue) < limit and not self._closing:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._queue_cv.wait(remaining)
+                batch = self._queue[:limit]
+                del self._queue[: len(batch)]
+                self._inflight += 1
+            assert self._flusher is not None
+            self._flusher.submit(self._send_queue, batch)
+
+    def _send_queue(self, batch: List[_Pending]) -> None:
+        """One flush: RPC + redistribute responses in order.
+
+        reference: peer_client.go:457-516.
+        """
+        try:
+            msg = peers_pb.GetPeerRateLimitsReq(
+                requests=[serde.rate_limit_req_to_pb(p.req) for p in batch]
+            )
+            assert self._stub is not None
+            resp = self._stub.GetPeerRateLimits(
+                msg, timeout=self.behaviors.batch_timeout
+            )
+            if len(resp.rate_limits) != len(batch):
+                raise PeerError(
+                    "number of rate limits in peer response does not match request"
+                )
+            for p, r in zip(batch, resp.rate_limits):
+                p.future.set_result(serde.rate_limit_resp_from_pb(r))
+        except Exception as e:  # noqa: BLE001 — every caller gets the error
+            if isinstance(e, grpc.RpcError):
+                err_text = f"GetPeerRateLimits batch to {self.info.grpc_address}: {e.code().name}"
+                e = PeerError(
+                    err_text, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+                )
+            self._set_last_err(str(e))
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_result(e)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop accepting work, drain queue + in-flight, close channel.
+
+        reference: peer_client.go:519-553.
+        """
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+            self._queue_cv.notify_all()
+        if self._batcher is not None:
+            self._batcher.join(timeout)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._drained.wait(remaining)
+        if self._flusher is not None:
+            self._flusher.shutdown(wait=True)
+        if self._channel is not None:
+            self._channel.close()
+
+    def queue_length(self) -> int:
+        with self._lock:
+            return len(self._queue)

@@ -1,0 +1,294 @@
+"""Configuration structs + env-driven loading (GUBER_* surface).
+
+reference: config.go — BehaviorConfig (:44-65, defaults :113-123),
+library Config (:68-110), DaemonConfig (:169-229), env loading
+SetupDaemonConfig (:247-451) with optional KEY=VALUE config file
+(fromEnvFile :556-584).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+# ----------------------------------------------------------------------
+# Durations are float seconds host-side; the wire/kernels use int ms.
+
+MICROSECOND = 1e-6
+MILLISECOND = 1e-3
+
+
+@dataclass
+class BehaviorConfig:
+    """Batching / GLOBAL / multi-region knobs.
+
+    reference: config.go:44-65; defaults config.go:113-123 (500µs wait,
+    500ms timeout, 1000-item limit for each tier).
+    """
+
+    # Peer-forward batching (reference: peer_client.go:380-453).
+    batch_timeout: float = 0.5
+    batch_wait: float = 500 * MICROSECOND
+    batch_limit: int = 1000
+
+    # GLOBAL manager (reference: global.go).
+    global_timeout: float = 0.5
+    global_sync_wait: float = 500 * MICROSECOND
+    global_batch_limit: int = 1000
+
+    # Multi-region manager (reference: multiregion.go).
+    multi_region_timeout: float = 0.5
+    multi_region_sync_wait: float = 500 * MICROSECOND
+    multi_region_batch_limit: int = 1000
+
+
+@dataclass
+class Config:
+    """Library-level config for a service instance.
+
+    reference: config.go:68-110 (Config struct); defaults
+    SetDefaults config.go:112-147.
+    """
+
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    # Total bucket slots on this node (reference default 50k cache size,
+    # config.go:294; here: slots across the device mesh).
+    cache_size: int = 50_000
+    # Consistent-hash function for the cluster ring ("fnv1" | "fnv1a").
+    # reference: config.go:395-417
+    hash_algorithm: str = "fnv1"
+    # This node's datacenter (MULTI_REGION routing).
+    data_center: str = ""
+    # Local peer identity; set by the daemon once listeners are bound.
+    instance_id: str = ""
+    # grpc.ChannelCredentials for dialing peers (None = plaintext);
+    # set by the daemon when TLS is configured.
+    peer_credentials: Optional[object] = None
+
+
+def _env(d: Dict[str, str], key: str, default: str = "") -> str:
+    return d.get(key, os.environ.get(key, default)) or default
+
+
+def _env_int(d: Dict[str, str], key: str, default: int) -> int:
+    v = _env(d, key)
+    return int(v) if v else default
+
+
+def _env_float_seconds(d: Dict[str, str], key: str, default: float) -> float:
+    """Parse Go-style duration strings ("500us", "30s", "1m") or float
+    seconds. reference duration envs like GUBER_BATCH_WAIT."""
+    v = _env(d, key)
+    if not v:
+        return default
+    return parse_duration(v)
+
+
+_DURATION_UNITS = [
+    ("ms", MILLISECOND),
+    ("us", MICROSECOND),
+    ("µs", MICROSECOND),
+    ("ns", 1e-9),
+    ("s", 1.0),
+    ("m", 60.0),
+    ("h", 3600.0),
+]
+
+
+def parse_duration(v: str) -> float:
+    """Parse a Go duration string into float seconds."""
+    v = v.strip()
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    # Compound forms like "1m30s" parse unit-by-unit.
+    total = 0.0
+    num = ""
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c.isdigit() or c in ".+-":
+            num += c
+            i += 1
+            continue
+        for unit, mult in _DURATION_UNITS:
+            if v.startswith(unit, i) and (
+                i + len(unit) == len(v) or v[i + len(unit)].isdigit() or v[i + len(unit)] in ".+-"
+            ):
+                if not num:
+                    raise ValueError(f"bad duration {v!r}")
+                total += float(num) * mult
+                num = ""
+                i += len(unit)
+                break
+        else:
+            raise ValueError(f"bad duration {v!r}")
+    if num:
+        raise ValueError(f"bad duration {v!r}")
+    return total
+
+
+def load_env_file(path: str) -> Dict[str, str]:
+    """Read a KEY=VALUE config file (reference: config.go:556-584).
+
+    Lines starting with # and blank lines are ignored; values are also
+    exported into os.environ, matching the reference's behavior of
+    loading the file into the environment.
+    """
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}:{lineno}: expected KEY=VALUE")
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    os.environ.update(out)
+    return out
+
+
+@dataclass
+class DaemonConfig:
+    """Process-level config. reference: config.go:169-229."""
+
+    grpc_listen_address: str = "localhost:81"
+    http_listen_address: str = "localhost:80"
+    # Optional plain-HTTP status listener when mTLS is on
+    # (reference: daemon.go:279-307).
+    http_status_listen_address: str = ""
+    advertise_address: str = ""
+    cache_size: int = 50_000
+    data_center: str = ""
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    hash_algorithm: str = "fnv1"
+
+    # Peer discovery: "member-list" | "etcd" | "dns" | "k8s" | "none"
+    # (reference default member-list, config.go:300).
+    peer_discovery_type: str = "none"
+    # Static seed peers / memberlist known hosts.
+    member_list_address: str = ""
+    known_hosts: List[str] = field(default_factory=list)
+    advertise_port: int = 7946  # reference: config.go:373
+    # DNS discovery.
+    dns_fqdn: str = ""
+    dns_poll_interval: float = 300.0
+    # etcd discovery.
+    etcd_endpoints: List[str] = field(default_factory=list)
+    etcd_key_prefix: str = "/gubernator/peers/"
+
+    # TLS (None = plaintext); see gubernator_tpu.net.tls.
+    tls: Optional["object"] = None
+
+    # Device-mesh shape for the sharded engine; None = all local devices.
+    device_count: Optional[int] = None
+
+    metric_flags: List[str] = field(default_factory=list)
+
+
+def setup_daemon_config(
+    config_file: Optional[str] = None, env: Optional[Dict[str, str]] = None
+) -> DaemonConfig:
+    """Build a DaemonConfig from GUBER_* env vars (+ optional file).
+
+    reference: config.go:247-451 (SetupDaemonConfig).
+    """
+    d: Dict[str, str] = dict(env or {})
+    if config_file:
+        d.update(load_env_file(config_file))
+
+    behaviors = BehaviorConfig(
+        batch_timeout=_env_float_seconds(d, "GUBER_BATCH_TIMEOUT", 0.5),
+        batch_wait=_env_float_seconds(d, "GUBER_BATCH_WAIT", 500 * MICROSECOND),
+        batch_limit=_env_int(d, "GUBER_BATCH_LIMIT", 1000),
+        global_timeout=_env_float_seconds(d, "GUBER_GLOBAL_TIMEOUT", 0.5),
+        global_sync_wait=_env_float_seconds(
+            d, "GUBER_GLOBAL_SYNC_WAIT", 500 * MICROSECOND
+        ),
+        global_batch_limit=_env_int(d, "GUBER_GLOBAL_BATCH_LIMIT", 1000),
+        multi_region_timeout=_env_float_seconds(d, "GUBER_MULTI_REGION_TIMEOUT", 0.5),
+        multi_region_sync_wait=_env_float_seconds(
+            d, "GUBER_MULTI_REGION_SYNC_WAIT", 500 * MICROSECOND
+        ),
+        multi_region_batch_limit=_env_int(d, "GUBER_MULTI_REGION_BATCH_LIMIT", 1000),
+    )
+
+    hash_algorithm = _env(d, "GUBER_PEER_PICKER_HASH", "fnv1")
+    if hash_algorithm not in ("fnv1", "fnv1a"):
+        raise ValueError(
+            f"GUBER_PEER_PICKER_HASH={hash_algorithm!r}: want fnv1 or fnv1a"
+        )
+    discovery = _env(d, "GUBER_PEER_DISCOVERY_TYPE", "none")
+    if discovery not in ("none", "member-list", "etcd", "dns", "k8s"):
+        raise ValueError(
+            f"GUBER_PEER_DISCOVERY_TYPE={discovery!r}: want none, "
+            "member-list, etcd, dns or k8s"
+        )
+
+    tls = None
+    if _env(d, "GUBER_TLS_CA") or _env(d, "GUBER_TLS_CERT") or _env(d, "GUBER_TLS_AUTO"):
+        from gubernator_tpu.net.tls import TLSConfig
+
+        tls = TLSConfig(
+            ca_file=_env(d, "GUBER_TLS_CA"),
+            ca_key_file=_env(d, "GUBER_TLS_CA_KEY"),
+            cert_file=_env(d, "GUBER_TLS_CERT"),
+            key_file=_env(d, "GUBER_TLS_KEY"),
+            auto_tls=_env(d, "GUBER_TLS_AUTO") in ("1", "true", "yes"),
+            client_auth=_env(d, "GUBER_TLS_CLIENT_AUTH"),
+            client_auth_ca_file=_env(d, "GUBER_TLS_CLIENT_AUTH_CA_CERT"),
+            client_auth_cert_file=_env(d, "GUBER_TLS_CLIENT_AUTH_CERT"),
+            client_auth_key_file=_env(d, "GUBER_TLS_CLIENT_AUTH_KEY"),
+        )
+
+    dc = _env(d, "GUBER_DATA_CENTER")
+    device_count = _env_int(d, "GUBER_DEVICE_COUNT", 0) or None
+
+    return DaemonConfig(
+        grpc_listen_address=_env(d, "GUBER_GRPC_ADDRESS", "localhost:81"),
+        http_listen_address=_env(d, "GUBER_HTTP_ADDRESS", "localhost:80"),
+        http_status_listen_address=_env(d, "GUBER_STATUS_HTTP_ADDRESS", ""),
+        advertise_address=_env(d, "GUBER_ADVERTISE_ADDRESS", ""),
+        cache_size=_env_int(d, "GUBER_CACHE_SIZE", 50_000),
+        data_center=dc,
+        behaviors=behaviors,
+        hash_algorithm=hash_algorithm,
+        peer_discovery_type=discovery,
+        member_list_address=_env(d, "GUBER_MEMBERLIST_ADDRESS", ""),
+        known_hosts=[
+            h.strip()
+            for h in _env(d, "GUBER_MEMBERLIST_KNOWN_NODES", "").split(",")
+            if h.strip()
+        ],
+        advertise_port=_env_int(d, "GUBER_MEMBERLIST_ADVERTISE_PORT", 7946),
+        dns_fqdn=_env(d, "GUBER_DNS_FQDN", ""),
+        dns_poll_interval=_env_float_seconds(d, "GUBER_DNS_POLL_INTERVAL", 300.0),
+        etcd_endpoints=[
+            h.strip()
+            for h in _env(d, "GUBER_ETCD_ENDPOINTS", "").split(",")
+            if h.strip()
+        ],
+        etcd_key_prefix=_env(d, "GUBER_ETCD_KEY_PREFIX", "/gubernator/peers/"),
+        tls=tls,
+        device_count=device_count,
+        metric_flags=[
+            f.strip()
+            for f in _env(d, "GUBER_METRIC_FLAGS", "").split(",")
+            if f.strip()
+        ],
+    )
+
+
+def resolve_advertise_address(listen: str, advertise: str = "") -> str:
+    """Resolve 0.0.0.0/:: listen addresses to a routable advertise
+    address. reference: net.go:28-49."""
+    if advertise:
+        return advertise
+    host, _, port = listen.rpartition(":")
+    if host in ("0.0.0.0", "::", ""):
+        host = socket.gethostbyname(socket.gethostname())
+    return f"{host}:{port}"

@@ -39,6 +39,7 @@ from gubernator_tpu.ops.bucket_kernel import (
     BatchInput,
     BucketState,
     apply_batch,
+    clear_occupied,
     make_state,
 )
 from gubernator_tpu.ops.expiry import sweep_expired
@@ -241,12 +242,20 @@ class DecisionEngine:
             else:
                 host_expire[j] = now_ms + r.duration
 
-        csize = _pad_size(len(cleared), floor=16) if len(cleared) else 16
-        b_clear = np.arange(
-            self.capacity, self.capacity + csize, dtype=np.int64
-        ).astype(_I32)
+        # Eviction clears run as a separate tiny scatter so the apply
+        # kernel's compiled shapes never depend on eviction pressure.
         if len(cleared):
-            b_clear[: len(cleared)] = cleared
+            csize = _pad_size(len(cleared), floor=16)
+            c = np.arange(
+                self.capacity, self.capacity + csize, dtype=np.int64
+            ).astype(_I32)
+            c[: len(cleared)] = cleared
+            self._state = self._state._replace(
+                occupied=clear_occupied(self._state.occupied, jnp.asarray(c))
+            )
+        b_clear = np.arange(
+            self.capacity, self.capacity + 16, dtype=np.int64
+        ).astype(_I32)
 
         batch = BatchInput(
             slot=jnp.asarray(b_slot),
@@ -297,6 +306,53 @@ class DecisionEngine:
             freed_slots = np.nonzero(np.asarray(freed))[0]
             self.table.release_slots(freed_slots)
         return int(freed_slots.size)
+
+    def warmup(self, max_width: int = 1024) -> None:
+        """Pre-compile the kernel for every padded batch width up to
+        `max_width` (server batches cap at MAX_BATCH_SIZE=1000 → width
+        1024) and every eviction-clear width, so no client request pays
+        an XLA compile.  Warmup keys expire after 1ms, a sweep reclaims
+        their slots, and metric counters are restored afterwards."""
+        saved = (
+            self.requests_total,
+            self.batches_total,
+            self.rounds_total,
+            self.table.hits,
+            self.table.misses,
+        )
+        now = self.clock.now_ms()
+        width = 64
+        while width <= max_width:
+            reqs = [
+                RateLimitReq(
+                    name="__warmup__",
+                    unique_key=str(i),
+                    hits=0,
+                    limit=1,
+                    duration=1,
+                )
+                for i in range(width)
+            ]
+            self.get_rate_limits(reqs, now_ms=now)
+            width *= 2
+        # Clear-scatter ladder (no-op out-of-range slots).
+        csize = 16
+        while csize <= max_width:
+            dummy = jnp.asarray(
+                np.arange(self.capacity, self.capacity + csize, dtype=np.int64).astype(_I32)
+            )
+            self._state = self._state._replace(
+                occupied=clear_occupied(self._state.occupied, dummy)
+            )
+            csize *= 2
+        self.sweep(now_ms=now + 2)
+        (
+            self.requests_total,
+            self.batches_total,
+            self.rounds_total,
+            self.table.hits,
+            self.table.misses,
+        ) = saved
 
     def cache_size(self) -> int:
         return len(self.table)

@@ -1,0 +1,276 @@
+"""Daemon — process bootstrap: engine + service + listeners + discovery.
+
+reference: daemon.go.  `spawn_daemon(conf)` builds the TPU decision
+engine (single-device or mesh-sharded), wires the V1 service, starts
+the gRPC server + HTTP gateway (+ optional plain status listener when
+mTLS is on), hooks up peer discovery, and exposes `set_peers` for
+membership pushes (daemon.go:370-380 marks self by address match).
+"""
+
+from __future__ import annotations
+
+import logging
+import ssl
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.clock import SYSTEM_CLOCK, Clock
+from gubernator_tpu.config import Config, DaemonConfig, resolve_advertise_address
+from gubernator_tpu.net.gateway import Gateway
+from gubernator_tpu.net.grpc_service import (
+    V1Stub,
+    add_peers_v1_to_server,
+    add_v1_to_server,
+    dial,
+)
+from gubernator_tpu.net.server import GrpcPeersV1Adapter, GrpcV1Adapter
+from gubernator_tpu.service import V1Instance
+from gubernator_tpu.types import PeerInfo
+from gubernator_tpu.utils.metrics import build_registry
+
+log = logging.getLogger("gubernator_tpu.daemon")
+
+
+class Daemon:
+    """One gubernator_tpu process. reference: daemon.go:56-80."""
+
+    def __init__(
+        self,
+        conf: DaemonConfig,
+        *,
+        clock: Clock = SYSTEM_CLOCK,
+        engine=None,
+    ):
+        self.conf = conf
+        self.clock = clock
+        self._engine = engine
+        self.instance: Optional[V1Instance] = None
+        self.grpc_server: Optional[grpc.Server] = None
+        self.gateway: Optional[Gateway] = None
+        self.status_gateway: Optional[Gateway] = None
+        self.registry = None
+        self.grpc_address = conf.grpc_listen_address
+        self.http_address = conf.http_listen_address
+        self._tls_bundle = None
+        self._discovery = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+
+    def _build_engine(self):
+        if self._engine is not None:
+            return self._engine
+        import jax
+
+        devices = jax.devices()
+        n = self.conf.device_count or len(devices)
+        if n > 1:
+            from gubernator_tpu.parallel.mesh import make_mesh
+            from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+
+            mesh = make_mesh(devices[:n])
+            return ShardedDecisionEngine(
+                shard_capacity=max(1, self.conf.cache_size // n),
+                mesh=mesh,
+                clock=self.clock,
+            )
+        from gubernator_tpu.core.engine import DecisionEngine
+
+        return DecisionEngine(
+            capacity=self.conf.cache_size, clock=self.clock, device=devices[0]
+        )
+
+    def start(self) -> None:
+        """reference: daemon.go:82-339 (Daemon.Start)."""
+        conf = self.conf
+        engine = self._build_engine()
+        self._warmup(engine)
+
+        creds = None
+        if conf.tls is not None:
+            self._tls_bundle = conf.tls.setup()
+            creds = self._tls_bundle.client_credentials()
+
+        service_conf = Config(
+            behaviors=conf.behaviors,
+            cache_size=conf.cache_size,
+            hash_algorithm=conf.hash_algorithm,
+            data_center=conf.data_center,
+            peer_credentials=creds,
+        )
+        self.instance = V1Instance(service_conf, engine)
+        self.registry = build_registry(self.instance)
+
+        # gRPC server (both services on one listener; the reference's
+        # second loopback server exists only for grpc-gateway's dial,
+        # which our native gateway doesn't need).
+        self.grpc_server = grpc.server(
+            ThreadPoolExecutor(max_workers=32, thread_name_prefix="guber-grpc"),
+            options=[
+                ("grpc.max_receive_message_length", 1024 * 1024),  # daemon.go:103
+                ("grpc.max_connection_age_ms", 120_000),  # daemon.go:110-115
+            ],
+        )
+        add_v1_to_server(GrpcV1Adapter(self.instance), self.grpc_server)
+        add_peers_v1_to_server(GrpcPeersV1Adapter(self.instance), self.grpc_server)
+        if self._tls_bundle is not None:
+            port = self.grpc_server.add_secure_port(
+                conf.grpc_listen_address, self._tls_bundle.server_credentials()
+            )
+        else:
+            port = self.grpc_server.add_insecure_port(conf.grpc_listen_address)
+        if port == 0:
+            raise RuntimeError(f"failed to bind gRPC on {conf.grpc_listen_address}")
+        host = conf.grpc_listen_address.rpartition(":")[0]
+        self.grpc_address = f"{host}:{port}"
+        self.grpc_server.start()
+
+        # HTTP gateway (+ /metrics).  Under TLS the gateway serves HTTPS
+        # (reference: daemon.go:311-328).
+        ssl_ctx = None
+        if self._tls_bundle is not None:
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, tempfile.NamedTemporaryFile(
+                suffix=".pem"
+            ) as kf:
+                cf.write(self._tls_bundle.server_cert_pem)
+                cf.flush()
+                kf.write(self._tls_bundle.server_key_pem)
+                kf.flush()
+                ssl_ctx.load_cert_chain(cf.name, kf.name)
+        self.gateway = Gateway(
+            self.instance,
+            conf.http_listen_address,
+            self.registry,
+            ssl_context=ssl_ctx,
+        )
+        self.gateway.start()
+        host = conf.http_listen_address.rpartition(":")[0]
+        self.http_address = f"{host}:{self.gateway.port}"
+
+        # Optional plain-HTTP status listener for probes when mTLS
+        # would block them (reference: daemon.go:279-307).
+        if conf.http_status_listen_address:
+            self.status_gateway = Gateway(
+                self.instance,
+                conf.http_status_listen_address,
+                self.registry,
+                serve_metrics=True,
+            )
+            self.status_gateway.start()
+
+        self._start_discovery()
+
+    @staticmethod
+    def _warmup(engine) -> None:
+        """Pay the kernel jit compiles before serving, not on the first
+        client requests (an XLA compile can exceed the peer batch
+        timeout)."""
+        engine.warmup()
+
+    # ------------------------------------------------------------------
+
+    def _start_discovery(self) -> None:
+        """reference: daemon.go:185-220 (discovery selection switch)."""
+        kind = self.conf.peer_discovery_type
+        if kind == "none":
+            self.set_peers([self.peer_info()])
+            return
+        from gubernator_tpu.discovery import create_discovery
+
+        self._discovery = create_discovery(self.conf, self)
+        self._discovery.start()
+
+    def peer_info(self) -> PeerInfo:
+        advertise = resolve_advertise_address(
+            self.grpc_address, self.conf.advertise_address
+        )
+        return PeerInfo(
+            grpc_address=advertise,
+            http_address=self.http_address,
+            datacenter=self.conf.data_center,
+        )
+
+    def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Mark ourselves in the list, then hand to the service.
+
+        reference: daemon.go:370-380 (SetPeers).
+        """
+        me = self.peer_info()
+        marked: List[PeerInfo] = []
+        for p in peers:
+            marked.append(
+                PeerInfo(
+                    grpc_address=p.grpc_address,
+                    http_address=p.http_address,
+                    datacenter=p.datacenter,
+                    is_owner=p.grpc_address == me.grpc_address,
+                )
+            )
+        if not any(p.is_owner for p in marked):
+            me.is_owner = True
+            marked.append(me)
+        assert self.instance is not None
+        self.instance.set_peers(marked)
+
+    # ------------------------------------------------------------------
+
+    def wait_for_connect(self, timeout: float = 10.0) -> None:
+        """Block until our own gRPC endpoint answers HealthCheck.
+
+        reference: daemon.go:330-337, 398-437 (WaitForConnect).
+        """
+        from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+        deadline = time.monotonic() + timeout
+        creds = (
+            self._tls_bundle.client_credentials() if self._tls_bundle else None
+        )
+        addr = self.grpc_address
+        if addr.startswith("0.0.0.0:") or addr.startswith(":::"):
+            addr = "127.0.0.1:" + addr.rpartition(":")[2]
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                channel = dial(addr, credentials=creds)
+                V1Stub(channel).HealthCheck(pb.HealthCheckReq(), timeout=1.0)
+                channel.close()
+                return
+            except grpc.RpcError as e:  # pragma: no cover - timing
+                last_err = e
+                time.sleep(0.05)
+        raise TimeoutError(f"daemon at {addr} never became ready: {last_err}")
+
+    def close(self) -> None:
+        """Graceful stop. reference: daemon.go:342-367 (Close)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._discovery is not None:
+            self._discovery.close()
+        if self.gateway is not None:
+            self.gateway.close()
+        if self.status_gateway is not None:
+            self.status_gateway.close()
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=1.0).wait()
+        if self.instance is not None:
+            self.instance.close()
+
+
+def spawn_daemon(
+    conf: DaemonConfig, *, clock: Clock = SYSTEM_CLOCK, engine=None
+) -> Daemon:
+    """Start a daemon and wait for readiness.
+
+    reference: daemon.go:66-80 (SpawnDaemon).
+    """
+    d = Daemon(conf, clock=clock, engine=engine)
+    d.start()
+    d.wait_for_connect()
+    return d

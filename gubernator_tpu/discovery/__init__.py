@@ -1,0 +1,37 @@
+"""Peer discovery backends (L0).
+
+reference: memberlist.go / etcd.go / kubernetes.go / dns.go — each
+backend watches a membership source and pushes the full peer list to
+`Daemon.set_peers` via an on-update callback (reference: config.go:165,
+daemon.go:185-220).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import Daemon
+
+
+def create_discovery(conf: "DaemonConfig", daemon: "Daemon"):
+    """Build the configured backend (reference: daemon.go:185-220)."""
+    kind = conf.peer_discovery_type
+    if kind == "member-list":
+        from gubernator_tpu.discovery.memberlist import MemberListPool
+
+        return MemberListPool(conf, daemon)
+    if kind == "dns":
+        from gubernator_tpu.discovery.dns import DNSPool
+
+        return DNSPool(conf, daemon)
+    if kind == "etcd":
+        from gubernator_tpu.discovery.etcd import EtcdPool
+
+        return EtcdPool(conf, daemon)
+    if kind == "k8s":
+        from gubernator_tpu.discovery.kubernetes import K8sPool
+
+        return K8sPool(conf, daemon)
+    raise ValueError(f"unknown peer discovery type {kind!r}")

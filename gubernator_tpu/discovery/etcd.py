@@ -1,0 +1,119 @@
+"""etcd peer discovery (gated on the optional etcd3 client).
+
+reference: etcd.go — lease-TTL registration (30s) with keep-alive and
+re-register (etcd.go:222-316), prefix watch with revision resume
+(:110-220), delete+revoke on shutdown (:298-311).
+
+The `etcd3` package is not part of this image; the backend raises a
+clear error at construction when unavailable and implements the full
+register/watch protocol when it is.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import TYPE_CHECKING, Dict
+
+from gubernator_tpu.discovery.base import DiscoveryBase, log
+from gubernator_tpu.types import PeerInfo
+
+if TYPE_CHECKING:
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import Daemon
+
+LEASE_TTL_S = 30  # reference: etcd.go:35 (etcdTTL)
+
+
+class EtcdPool(DiscoveryBase):
+    def __init__(self, conf: "DaemonConfig", daemon: "Daemon"):
+        super().__init__(daemon)
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "etcd discovery requires the 'etcd3' package, which is "
+                "not installed in this environment; use member-list or "
+                "dns discovery instead"
+            ) from e
+        import etcd3
+
+        endpoint = (conf.etcd_endpoints or ["localhost:2379"])[0]
+        host, _, port = endpoint.rpartition(":")
+        self._client = etcd3.client(host=host or "localhost", port=int(port or 2379))
+        self.key_prefix = conf.etcd_key_prefix
+        self._lease = None
+        self._watch_id = None
+        self._peers: Dict[str, PeerInfo] = {}
+        self._keepalive = threading.Thread(
+            target=self._keepalive_loop, name="guber-etcd-lease", daemon=True
+        )
+
+    def _my_key(self) -> str:
+        return self.key_prefix + self.daemon.peer_info().grpc_address
+
+    def _register(self) -> None:
+        """reference: etcd.go:222-316 (register + keep-alive loop)."""
+        me = self.daemon.peer_info()
+        self._lease = self._client.lease(LEASE_TTL_S)
+        self._client.put(
+            self._my_key(),
+            json.dumps(
+                {
+                    "grpc": me.grpc_address,
+                    "http": me.http_address,
+                    "dc": me.datacenter,
+                }
+            ),
+            lease=self._lease,
+        )
+
+    def _keepalive_loop(self) -> None:
+        while not self._closed.wait(LEASE_TTL_S / 3):
+            try:
+                if self._lease is not None:
+                    self._lease.refresh()
+            except Exception:  # noqa: BLE001
+                log.exception("etcd lease refresh failed; re-registering")
+                try:
+                    self._register()
+                except Exception:  # noqa: BLE001
+                    log.exception("etcd re-register failed")
+
+    def _sync(self) -> None:
+        peers: Dict[str, PeerInfo] = {}
+        for value, meta in self._client.get_prefix(self.key_prefix):
+            try:
+                obj = json.loads(value)
+                peers[obj["grpc"]] = PeerInfo(
+                    grpc_address=obj["grpc"],
+                    http_address=obj.get("http", ""),
+                    datacenter=obj.get("dc", ""),
+                )
+            except (ValueError, KeyError):
+                continue
+        self._peers = peers
+        self.on_update(list(peers.values()))
+
+    def _on_event(self, event) -> None:
+        self._sync()
+
+    def start(self) -> None:
+        self._register()
+        self._sync()
+        self._watch_id = self._client.add_watch_prefix_callback(
+            self.key_prefix, self._on_event
+        )
+        self._keepalive.start()
+
+    def close(self) -> None:
+        super().close()
+        try:
+            if self._watch_id is not None:
+                self._client.cancel_watch(self._watch_id)
+            # Delete our key + revoke lease (reference: etcd.go:298-311).
+            self._client.delete(self._my_key())
+            if self._lease is not None:
+                self._lease.revoke()
+        except Exception:  # noqa: BLE001
+            log.exception("etcd deregister failed")

@@ -1,0 +1,196 @@
+"""Gossip peer discovery (memberlist-style, self-contained).
+
+reference: memberlist.go — the reference embeds hashicorp/memberlist
+(SWIM gossip over UDP+TCP) and carries each node's PeerInfo as JSON
+metadata (memberlist.go:126-151); join/leave/update events rebuild the
+peer map (:187-233).
+
+This backend reproduces the capability without the dependency: an
+anti-entropy heartbeat gossip over UDP.  Each node keeps a member map
+`addr -> (incarnation, heartbeat, PeerInfo, last_seen)`; every interval
+it bumps its own heartbeat and sends its full map (JSON, one datagram)
+to `fanout` random members plus any configured seed.  `last_seen` only
+refreshes when a member's (incarnation, heartbeat) RISES — second-hand
+gossip cannot keep a dead member alive — so members whose heartbeat
+stalls for `suspect_after` are dropped.  Incarnations (startup
+timestamps) resolve restarts: the higher incarnation wins.  Full-map gossip converges in
+O(log N) rounds and a datagram holds ~hundreds of members — the
+intended deployment sizes for the host tier (the data plane scales via
+the device mesh, not host count).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Dict, List, Tuple
+
+from gubernator_tpu.discovery.base import DiscoveryBase, log
+from gubernator_tpu.types import PeerInfo
+
+if TYPE_CHECKING:
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import Daemon
+
+
+@dataclass
+class _Member:
+    incarnation: int
+    heartbeat: int
+    info: PeerInfo
+    last_seen: float
+
+
+class MemberListPool(DiscoveryBase):
+    """reference: memberlist.go:40-233 (MemberListPool)."""
+
+    def __init__(
+        self,
+        conf: "DaemonConfig",
+        daemon: "Daemon",
+        *,
+        interval: float = 1.0,
+        suspect_after: float = 5.0,
+        fanout: int = 3,
+    ):
+        super().__init__(daemon)
+        self.interval = interval
+        self.suspect_after = suspect_after
+        self.fanout = fanout
+        bind = conf.member_list_address or f"0.0.0.0:{conf.advertise_port}"
+        host, _, port = bind.rpartition(":")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host or "0.0.0.0", int(port)))
+        self._sock.settimeout(0.25)
+        self.gossip_address = (
+            f"{self._advertise_host(host)}:{self._sock.getsockname()[1]}"
+        )
+        self.seeds = [s for s in conf.known_hosts if s != self.gossip_address]
+        self.incarnation = time.time_ns()
+        self.heartbeat = 0
+        self._members: Dict[str, _Member] = {}
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._recv_loop, name="guber-gossip-rx", daemon=True),
+            threading.Thread(target=self._gossip_loop, name="guber-gossip-tx", daemon=True),
+        ]
+
+    @staticmethod
+    def _advertise_host(bind_host: str) -> str:
+        if bind_host in ("", "0.0.0.0", "::"):
+            return "127.0.0.1"
+        return bind_host
+
+    # -- wire ------------------------------------------------------------
+
+    def _snapshot(self) -> Dict[str, dict]:
+        me = self.daemon.peer_info()
+        with self._lock:
+            out = {
+                addr: {
+                    "inc": m.incarnation,
+                    "hb": m.heartbeat,
+                    "grpc": m.info.grpc_address,
+                    "http": m.info.http_address,
+                    "dc": m.info.datacenter,
+                }
+                for addr, m in self._members.items()
+            }
+        out[self.gossip_address] = {
+            "inc": self.incarnation,
+            "hb": self.heartbeat,
+            "grpc": me.grpc_address,
+            "http": me.http_address,
+            "dc": me.datacenter,
+        }
+        return out
+
+    def _merge(self, payload: Dict[str, dict]) -> bool:
+        """Merge a received member map; True if membership changed."""
+        changed = False
+        now = time.monotonic()
+        with self._lock:
+            for addr, meta in payload.items():
+                if addr == self.gossip_address:
+                    continue
+                cur = self._members.get(addr)
+                inc = int(meta.get("inc", 0))
+                hb = int(meta.get("hb", 0))
+                if cur is None or (inc, hb) > (cur.incarnation, cur.heartbeat):
+                    self._members[addr] = _Member(
+                        incarnation=inc,
+                        heartbeat=hb,
+                        info=PeerInfo(
+                            grpc_address=meta.get("grpc", ""),
+                            http_address=meta.get("http", ""),
+                            datacenter=meta.get("dc", ""),
+                        ),
+                        last_seen=now,
+                    )
+                    changed = changed or cur is None
+        return changed
+
+    def _expire(self) -> bool:
+        cutoff = time.monotonic() - self.suspect_after
+        with self._lock:
+            dead = [a for a, m in self._members.items() if m.last_seen < cutoff]
+            for a in dead:
+                del self._members[a]
+        return bool(dead)
+
+    def _push_peers(self) -> None:
+        me = self.daemon.peer_info()
+        with self._lock:
+            peers = [m.info for m in self._members.values()]
+        peers.append(me)
+        self.on_update(sorted(peers, key=lambda p: p.grpc_address))
+
+    # -- loops -----------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                data, _ = self._sock.recvfrom(256 * 1024)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                payload = json.loads(data)
+            except ValueError:
+                continue
+            if self._merge(payload):
+                self._push_peers()
+
+    def _gossip_loop(self) -> None:
+        # Announce immediately so joins propagate fast.
+        self._push_peers()
+        while not self._closed.wait(self.interval):
+            self.heartbeat += 1
+            blob = json.dumps(self._snapshot()).encode()
+            with self._lock:
+                members = list(self._members)
+            targets = set(random.sample(members, min(self.fanout, len(members))))
+            targets.update(self.seeds)
+            for addr in targets:
+                host, _, port = addr.rpartition(":")
+                try:
+                    self._sock.sendto(blob, (host, int(port)))
+                except OSError as e:
+                    log.debug("gossip send to %s failed: %s", addr, e)
+            if self._expire():
+                self._push_peers()
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def close(self) -> None:
+        super().close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._sock.close()

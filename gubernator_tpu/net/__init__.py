@@ -1,0 +1,20 @@
+"""Network plane: proto contract, gRPC services, HTTP/JSON gateway, TLS.
+
+Client-facing and peer-facing RPC stays a host-level concern (SURVEY.md
+§2.3): the TPU data path begins after batches are decoded.  Wire contract
+is identical to the reference so its clients work unchanged.
+"""
+
+from gubernator_tpu.net.serde import (
+    rate_limit_req_from_pb,
+    rate_limit_req_to_pb,
+    rate_limit_resp_from_pb,
+    rate_limit_resp_to_pb,
+)
+
+__all__ = [
+    "rate_limit_req_from_pb",
+    "rate_limit_req_to_pb",
+    "rate_limit_resp_from_pb",
+    "rate_limit_resp_to_pb",
+]

@@ -1,0 +1,151 @@
+"""HTTP/JSON gateway: REST facade over the service + /metrics.
+
+reference: gubernator.pb.gw.go + daemon.go:222-268 — grpc-gateway v2
+semantics: `POST /v1/GetRateLimits` and `GET /v1/HealthCheck` with
+proto-JSON marshaling in snake_case (`UseProtoNames`), int64 as JSON
+strings, enums as names; plus the prometheus `/metrics` endpoint and
+`/healthz` for probes (reference: daemon.go:279-307 status listener).
+
+Implemented directly on the service core (no loopback gRPC hop — the
+reference only dials loopback because grpc-gateway needs a channel).
+protobuf's own json_format does the marshaling, so the JSON contract is
+byte-compatible with the reference gateway.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from google.protobuf import json_format
+
+from prometheus_client import generate_latest
+from prometheus_client.registry import CollectorRegistry
+
+from gubernator_tpu.net import serde
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.service import ServiceError, V1Instance
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by the server factory.
+    instance: V1Instance
+    registry: Optional[CollectorRegistry] = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _reply(self, code: int, body: bytes, content_type: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_error(self, http_code: int, grpc_code: int, message: str):
+        # grpc-gateway error shape: {"code": ..., "message": ...}.
+        self._reply(
+            http_code,
+            json.dumps({"code": grpc_code, "message": message}).encode(),
+        )
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        path = self.path.split("?", 1)[0]
+        if path == "/v1/HealthCheck" or path == "/healthz":
+            resp = serde.health_check_resp_to_pb(self.instance.health_check())
+            self._reply(
+                200,
+                json_format.MessageToJson(
+                    resp,
+                    preserving_proto_field_name=True,
+                    always_print_fields_with_no_presence=True,
+                ).encode(),
+            )
+        elif path == "/metrics" and self.registry is not None:
+            self._reply(
+                200,
+                generate_latest(self.registry),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        else:
+            self._reply_error(404, 5, "not found")
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path != "/v1/GetRateLimits":
+            self._reply_error(404, 5, "not found")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            req = json_format.Parse(
+                body or b"{}", pb.GetRateLimitsReq(), ignore_unknown_fields=True
+            )
+        except json_format.ParseError as e:
+            self._reply_error(400, 3, str(e))  # INVALID_ARGUMENT
+            return
+        try:
+            resps = self.instance.get_rate_limits(
+                [serde.rate_limit_req_from_pb(m) for m in req.requests]
+            )
+        except ServiceError as e:
+            self._reply_error(400, 11, str(e))  # OUT_OF_RANGE
+            return
+        out = serde.get_rate_limits_resp_to_pb(resps)
+        self._reply(
+            200,
+            json_format.MessageToJson(
+                out,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            ).encode(),
+        )
+
+
+class Gateway:
+    """The HTTP listener (gateway + metrics + health probes)."""
+
+    def __init__(
+        self,
+        instance: V1Instance,
+        address: str,
+        registry: Optional[CollectorRegistry] = None,
+        *,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        serve_metrics: bool = True,
+    ):
+        host, _, port = address.rpartition(":")
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {
+                "instance": instance,
+                "registry": registry if serve_metrics else None,
+            },
+        )
+        self._server = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+        self._server.daemon_threads = True
+        if ssl_context is not None:
+            self._server.socket = ssl_context.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"guber-gateway-{address}",
+            daemon=True,
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

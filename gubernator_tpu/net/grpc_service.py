@@ -1,0 +1,145 @@
+"""gRPC service registration and client stubs.
+
+No grpc_python_plugin exists in this image, so instead of generated
+service classes the two services are registered with
+`grpc.method_handlers_generic_handler` and clients use
+`channel.unary_unary` with the generated message (de)serializers —
+byte-identical on the wire to the reference's generated stubs
+(reference: gubernator_grpc.pb.go, peers_grpc.pb.go).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Protocol
+
+import grpc
+
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.net.pb import peers_pb2 as peers_pb
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_SERVICE = "pb.gubernator.PeersV1"
+
+
+class V1Servicer(Protocol):
+    """The public service (reference: proto/gubernator.proto:27-45)."""
+
+    def GetRateLimits(
+        self, request: pb.GetRateLimitsReq, context: grpc.ServicerContext
+    ) -> pb.GetRateLimitsResp: ...
+
+    def HealthCheck(
+        self, request: pb.HealthCheckReq, context: grpc.ServicerContext
+    ) -> pb.HealthCheckResp: ...
+
+
+class PeersV1Servicer(Protocol):
+    """The peer-only service (reference: proto/peers.proto:28-34)."""
+
+    def GetPeerRateLimits(
+        self, request: peers_pb.GetPeerRateLimitsReq, context: grpc.ServicerContext
+    ) -> peers_pb.GetPeerRateLimitsResp: ...
+
+    def UpdatePeerGlobals(
+        self, request: peers_pb.UpdatePeerGlobalsReq, context: grpc.ServicerContext
+    ) -> peers_pb.UpdatePeerGlobalsResp: ...
+
+
+def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+def add_v1_to_server(servicer: V1Servicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                V1_SERVICE,
+                {
+                    "GetRateLimits": _unary(
+                        servicer.GetRateLimits,
+                        pb.GetRateLimitsReq,
+                        pb.GetRateLimitsResp,
+                    ),
+                    "HealthCheck": _unary(
+                        servicer.HealthCheck,
+                        pb.HealthCheckReq,
+                        pb.HealthCheckResp,
+                    ),
+                },
+            ),
+        )
+    )
+
+
+def add_peers_v1_to_server(servicer: PeersV1Servicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                PEERS_SERVICE,
+                {
+                    "GetPeerRateLimits": _unary(
+                        servicer.GetPeerRateLimits,
+                        peers_pb.GetPeerRateLimitsReq,
+                        peers_pb.GetPeerRateLimitsResp,
+                    ),
+                    "UpdatePeerGlobals": _unary(
+                        servicer.UpdatePeerGlobals,
+                        peers_pb.UpdatePeerGlobalsReq,
+                        peers_pb.UpdatePeerGlobalsResp,
+                    ),
+                },
+            ),
+        )
+    )
+
+
+class V1Stub:
+    """Client stub for the public service."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetRateLimits = channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=pb.GetRateLimitsReq.SerializeToString,
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+        self.HealthCheck = channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=pb.HealthCheckReq.SerializeToString,
+            response_deserializer=pb.HealthCheckResp.FromString,
+        )
+
+
+class PeersV1Stub:
+    """Client stub for the peer-only service."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetPeerRateLimits = channel.unary_unary(
+            f"/{PEERS_SERVICE}/GetPeerRateLimits",
+            request_serializer=peers_pb.GetPeerRateLimitsReq.SerializeToString,
+            response_deserializer=peers_pb.GetPeerRateLimitsResp.FromString,
+        )
+        self.UpdatePeerGlobals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+            request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
+            response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
+        )
+
+
+def dial(
+    address: str,
+    *,
+    credentials: Optional[grpc.ChannelCredentials] = None,
+    options: Optional[list] = None,
+) -> grpc.Channel:
+    """Open a channel to a daemon or peer.
+
+    reference: client.go:42-64 (DialV1Server).
+    """
+    opts = options or []
+    if credentials is not None:
+        return grpc.secure_channel(address, credentials, options=opts)
+    return grpc.insecure_channel(address, options=opts)

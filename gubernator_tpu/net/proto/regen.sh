@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate gubernator_tpu/net/pb from the .proto sources.
+# The generated peers_pb2 imports its sibling with a bare top-level
+# import; rewrite it package-relative so `import gubernator_tpu` works
+# without sys.path games.
+set -e
+cd "$(dirname "$0")/.."
+protoc -Iproto --python_out=pb proto/gubernator.proto proto/peers.proto
+sed -i 's/^import gubernator_pb2 as gubernator__pb2$/from gubernator_tpu.net.pb import gubernator_pb2 as gubernator__pb2/' pb/peers_pb2.py

@@ -1,0 +1,129 @@
+"""Dataclass ↔ protobuf conversion for the wire contract.
+
+The engine and cluster tier work with the plain dataclasses in
+`gubernator_tpu.types`; conversion happens once at the RPC boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.net.pb import peers_pb2 as peers_pb
+from gubernator_tpu.types import (
+    GetRateLimitsReq,
+    GetRateLimitsResp,
+    HealthCheckResp,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+)
+
+
+def rate_limit_req_to_pb(r: RateLimitReq) -> pb.RateLimitReq:
+    return pb.RateLimitReq(
+        name=r.name,
+        unique_key=r.unique_key,
+        hits=r.hits,
+        limit=r.limit,
+        duration=r.duration,
+        algorithm=int(r.algorithm),
+        behavior=int(r.behavior),
+        burst=r.burst,
+    )
+
+
+def rate_limit_req_from_pb(m: pb.RateLimitReq) -> RateLimitReq:
+    return RateLimitReq(
+        name=m.name,
+        unique_key=m.unique_key,
+        hits=m.hits,
+        limit=m.limit,
+        duration=m.duration,
+        algorithm=m.algorithm,
+        behavior=m.behavior,
+        burst=m.burst,
+    )
+
+
+def rate_limit_resp_to_pb(r: RateLimitResp) -> pb.RateLimitResp:
+    m = pb.RateLimitResp(
+        status=int(r.status),
+        limit=r.limit,
+        remaining=r.remaining,
+        reset_time=r.reset_time,
+        error=r.error,
+    )
+    for k, v in r.metadata.items():
+        m.metadata[k] = v
+    return m
+
+
+def rate_limit_resp_from_pb(m: pb.RateLimitResp) -> RateLimitResp:
+    return RateLimitResp(
+        status=m.status,
+        limit=m.limit,
+        remaining=m.remaining,
+        reset_time=m.reset_time,
+        error=m.error,
+        metadata=dict(m.metadata),
+    )
+
+
+def get_rate_limits_req_to_pb(reqs: Iterable[RateLimitReq]) -> pb.GetRateLimitsReq:
+    return pb.GetRateLimitsReq(requests=[rate_limit_req_to_pb(r) for r in reqs])
+
+
+def get_rate_limits_req_from_pb(m: pb.GetRateLimitsReq) -> GetRateLimitsReq:
+    return GetRateLimitsReq(requests=[rate_limit_req_from_pb(r) for r in m.requests])
+
+
+def get_rate_limits_resp_to_pb(resps: Iterable[RateLimitResp]) -> pb.GetRateLimitsResp:
+    return pb.GetRateLimitsResp(responses=[rate_limit_resp_to_pb(r) for r in resps])
+
+
+def get_rate_limits_resp_from_pb(m: pb.GetRateLimitsResp) -> GetRateLimitsResp:
+    return GetRateLimitsResp(
+        responses=[rate_limit_resp_from_pb(r) for r in m.responses]
+    )
+
+
+def health_check_resp_to_pb(r: HealthCheckResp) -> pb.HealthCheckResp:
+    return pb.HealthCheckResp(
+        status=r.status, message=r.message, peer_count=r.peer_count
+    )
+
+
+def health_check_resp_from_pb(m: pb.HealthCheckResp) -> HealthCheckResp:
+    return HealthCheckResp(
+        status=m.status, message=m.message, peer_count=m.peer_count
+    )
+
+
+def update_peer_global_to_pb(u: UpdatePeerGlobal) -> peers_pb.UpdatePeerGlobal:
+    m = peers_pb.UpdatePeerGlobal(key=u.key, algorithm=int(u.algorithm))
+    if u.status is not None:
+        m.status.CopyFrom(rate_limit_resp_to_pb(u.status))
+    return m
+
+
+def update_peer_global_from_pb(m: peers_pb.UpdatePeerGlobal) -> UpdatePeerGlobal:
+    return UpdatePeerGlobal(
+        key=m.key,
+        status=rate_limit_resp_from_pb(m.status),
+        algorithm=m.algorithm,
+    )
+
+
+def peer_rate_limits_resp_to_pb(
+    resps: Iterable[RateLimitResp],
+) -> peers_pb.GetPeerRateLimitsResp:
+    return peers_pb.GetPeerRateLimitsResp(
+        rate_limits=[rate_limit_resp_to_pb(r) for r in resps]
+    )
+
+
+def peer_rate_limits_resp_from_pb(
+    m: peers_pb.GetPeerRateLimitsResp,
+) -> List[RateLimitResp]:
+    return [rate_limit_resp_from_pb(r) for r in m.rate_limits]

@@ -1,0 +1,61 @@
+"""gRPC servicer adapters: pb messages ↔ V1Instance.
+
+The service core (gubernator_tpu.service) speaks dataclasses; these
+adapters sit at the transport edge, converting once per RPC and mapping
+ServiceError to gRPC status codes (the only RPC-level error the
+contract allows — oversized batches; reference: gubernator.go:212-216).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gubernator_tpu.net import serde
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.net.pb import peers_pb2 as peers_pb
+from gubernator_tpu.service import ServiceError, V1Instance
+
+_CODE = {
+    "OUT_OF_RANGE": grpc.StatusCode.OUT_OF_RANGE,
+    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+    "INTERNAL": grpc.StatusCode.INTERNAL,
+}
+
+
+class GrpcV1Adapter:
+    """Public service (reference: proto/gubernator.proto:27-45)."""
+
+    def __init__(self, instance: V1Instance):
+        self.instance = instance
+
+    def GetRateLimits(self, request, context):
+        reqs = [serde.rate_limit_req_from_pb(m) for m in request.requests]
+        try:
+            resps = self.instance.get_rate_limits(reqs)
+        except ServiceError as e:
+            context.abort(_CODE.get(e.code, grpc.StatusCode.INTERNAL), str(e))
+        return serde.get_rate_limits_resp_to_pb(resps)
+
+    def HealthCheck(self, request, context):
+        return serde.health_check_resp_to_pb(self.instance.health_check())
+
+
+class GrpcPeersV1Adapter:
+    """Peer-only service (reference: proto/peers.proto:28-34)."""
+
+    def __init__(self, instance: V1Instance):
+        self.instance = instance
+
+    def GetPeerRateLimits(self, request, context):
+        reqs = [serde.rate_limit_req_from_pb(m) for m in request.requests]
+        try:
+            resps = self.instance.get_peer_rate_limits(reqs)
+        except ServiceError as e:
+            context.abort(_CODE.get(e.code, grpc.StatusCode.INTERNAL), str(e))
+        return serde.peer_rate_limits_resp_to_pb(resps)
+
+    def UpdatePeerGlobals(self, request, context):
+        self.instance.update_peer_globals(
+            [serde.update_peer_global_from_pb(g) for g in request.globals]
+        )
+        return peers_pb.UpdatePeerGlobalsResp()

@@ -175,6 +175,20 @@ def split_remf(v: jax.Array) -> tuple[jax.Array, jax.Array]:
     return wc.astype(_I32), ((v - w) * (2.0**32)).astype(_U32)
 
 
+@jax.jit
+def clear_occupied(occupied: jax.Array, slots: jax.Array) -> jax.Array:
+    """Mark evicted slots unoccupied (host eviction executed on device).
+
+    Split out of the apply kernel so the compile cache is one shape per
+    clear width instead of a (batch width × clear width) matrix —
+    eviction bursts then never trigger apply-kernel recompiles.
+    Padding lanes use distinct ascending out-of-range slots.
+    """
+    return occupied.at[jnp.sort(slots)].set(
+        False, mode="drop", indices_are_sorted=True, unique_indices=True
+    )
+
+
 def _apply_batch_impl(
     state: BucketState,
     batch: BatchInput,

@@ -132,6 +132,22 @@ class ShardedDecisionEngine:
             in_specs=(state_specs, batch_specs, pspec, P()),
             out_specs=(state_specs, out_specs_batch, P()),
         )
+
+        def local_clear(occupied, slots):
+            # occupied/slots carry the leading shard axis inside
+            # shard_map; clear is a per-shard scatter.
+            from gubernator_tpu.ops.bucket_kernel import clear_occupied
+
+            return clear_occupied(occupied[0], slots[0])[None]
+
+        self._clear_step = jax.jit(
+            jax.shard_map(
+                local_clear,
+                mesh=mesh,
+                in_specs=(pspec, pspec),
+                out_specs=pspec,
+            )
+        )
         return jax.jit(stepped, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
@@ -239,7 +255,21 @@ class ShardedDecisionEngine:
         n_sh = self.n_shards
         cap = self.shard_capacity
         width = _pad_size(max((len(m) for m in members), default=1))
-        csize = _pad_size(max((len(c) for c in clears), default=1), floor=16)
+
+        # Eviction clears run as a separate sharded scatter (own shape
+        # ladder, independent of the apply step's batch width).
+        n_clear = max((len(c) for c in clears), default=0)
+        if n_clear:
+            csize = _pad_size(n_clear, floor=16)
+            c = np.tile(
+                np.arange(cap, cap + csize, dtype=_I64).astype(_I32), (n_sh, 1)
+            )
+            for sh in range(n_sh):
+                c[sh, : len(clears[sh])] = clears[sh]
+            self._state = self._state._replace(
+                occupied=self._clear_step(self._state.occupied, jnp.asarray(c))
+            )
+        csize = 16
 
         # Padding: distinct ascending out-of-range slots per shard.
         b_slot = np.tile(
@@ -279,8 +309,6 @@ class ShardedDecisionEngine:
                 )
                 host_expire[sh][0].append(slot)
                 host_expire[sh][1].append(exp)
-            for c, slot in enumerate(clears[sh]):
-                b_clear[sh, c] = slot
 
         batch = BatchInput(
             slot=jnp.asarray(b_slot),
@@ -342,6 +370,68 @@ class ShardedDecisionEngine:
                 self.tables[sh].release_slots(slots)
                 total += int(slots.size)
         return total
+
+    def warmup(self, max_width: int = 1024) -> None:
+        """Pre-compile the sharded step for padded widths up to
+        `max_width` per shard and the clear ladder (see
+        DecisionEngine.warmup).  Keys are picked so each shard gets
+        exactly `width` of them — hashing arbitrary keys would leave
+        the per-shard count fluctuating around `width` and compile the
+        wrong padded widths."""
+        saved = (
+            self.requests_total,
+            self.batches_total,
+            self.rounds_total,
+            [(t.hits, t.misses) for t in self.tables],
+        )
+        # Pre-assign keys per shard by rejection sampling once, at the
+        # largest width; smaller widths use prefixes.
+        per_shard: List[List[str]] = [[] for _ in range(self.n_shards)]
+        i = 0
+        while any(len(ks) < max_width for ks in per_shard):
+            req = RateLimitReq(name="__warmup__", unique_key=f"{i}")
+            sh = self.shard_of(req.hash_key())
+            if len(per_shard[sh]) < max_width:
+                per_shard[sh].append(req.unique_key)
+            i += 1
+        now = self.clock.now_ms()
+        width = 64
+        while width <= max_width:
+            reqs = [
+                RateLimitReq(
+                    name="__warmup__",
+                    unique_key=k,
+                    hits=0,
+                    limit=1,
+                    duration=1,
+                )
+                for ks in per_shard
+                for k in ks[:width]
+            ]
+            self.get_rate_limits(reqs, now_ms=now)
+            width *= 2
+        csize = 16
+        cap = self.shard_capacity
+        while csize <= max_width:
+            dummy = jnp.asarray(
+                np.tile(
+                    np.arange(cap, cap + csize, dtype=_I64).astype(_I32),
+                    (self.n_shards, 1),
+                )
+            )
+            self._state = self._state._replace(
+                occupied=self._clear_step(self._state.occupied, dummy)
+            )
+            csize *= 2
+        self.sweep(now_ms=now + 2)
+        (
+            self.requests_total,
+            self.batches_total,
+            self.rounds_total,
+            table_stats,
+        ) = saved
+        for t, (h, m) in zip(self.tables, table_stats):
+            t.hits, t.misses = h, m
 
     def cache_size(self) -> int:
         return sum(len(t) for t in self.tables)

@@ -1,0 +1,471 @@
+"""V1Instance — the request router over the TPU decision engine.
+
+reference: gubernator.go:46-854.  The reference walks each request item
+through a goroutine maze (per-item peer pick → worker channel hop →
+per-key algorithm call).  Here the router is *batch-first*, matching
+how the TPU engine wants its work:
+
+  1. validate every item (error-in-response, never error-in-RPC);
+  2. one vectorized owner lookup for the whole batch (hash ring);
+  3. partition: LOCAL (we own) / GLOBAL non-owner / FORWARD per peer;
+  4. LOCAL items go to the engine as ONE batch (one device step per
+     duplicate-key round) — the reference's worker fan-out collapses
+     into the vmapped kernel;
+  5. GLOBAL non-owners answer from the host status cache (owner
+     broadcasts land there) and queue async hits;
+  6. FORWARD items ride the per-peer batching client with the
+     reference's 5-retry ownership-migration loop.
+
+Responses keep request order exactly (reference: gubernator.go:524-531).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gubernator_tpu.cluster.global_manager import GlobalManager
+from gubernator_tpu.cluster.hash_ring import (
+    RegionPicker,
+    ReplicatedConsistentHash,
+)
+from gubernator_tpu.cluster.multiregion import MultiRegionManager
+from gubernator_tpu.cluster.peer_client import PeerClient, PeerError
+from gubernator_tpu.config import BehaviorConfig, Config
+from gubernator_tpu.types import (
+    MAX_BATCH_SIZE,
+    Behavior,
+    HealthCheckResp,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+
+log = logging.getLogger("gubernator_tpu.service")
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+
+
+class ServiceError(RuntimeError):
+    """RPC-level error (maps to a gRPC status at the transport edge).
+
+    The only RPC-level failure the contract allows is an oversized
+    batch (reference: gubernator.go:212-216, 501-505); per-item
+    problems travel in RateLimitResp.error.
+    """
+
+    def __init__(self, message: str, code: str = "OUT_OF_RANGE"):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclass
+class _GlobalEntry:
+    resp: RateLimitResp
+    algorithm: int
+    expire_at: int  # unix ms (ResetTime of the broadcast status)
+
+
+class _GlobalStatusCache:
+    """Host cache of owner-broadcast GLOBAL statuses on non-owners.
+
+    The reference stores a RateLimitResp (not bucket state) in the same
+    size-bounded LRU as buckets (gubernator.go:470-490, read
+    gubernator.go:440-453).  Our bucket state lives on device, so the
+    non-owner overwrite dance gets its own host-side LRU with the same
+    ExpireAt=ResetTime rule and capacity bound.
+    """
+
+    def __init__(self, capacity: int = 50_000) -> None:
+        from collections import OrderedDict
+
+        self.capacity = capacity
+        self._items: "OrderedDict[str, _GlobalEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: str, now_ms: int) -> Optional[RateLimitResp]:
+        with self._lock:
+            e = self._items.get(key)
+            if e is None:
+                return None
+            if e.expire_at and now_ms >= e.expire_at:
+                del self._items[key]
+                return None
+            self._items.move_to_end(key)
+            return e.resp
+
+    def put(self, key: str, resp: RateLimitResp, algorithm: int) -> None:
+        with self._lock:
+            self._items[key] = _GlobalEntry(
+                resp=resp, algorithm=algorithm, expire_at=resp.reset_time
+            )
+            self._items.move_to_end(key)
+            while len(self._items) > self.capacity:
+                self._items.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class V1Instance:
+    """The service core: routing + local engine + cluster managers."""
+
+    def __init__(self, conf: Config, engine):
+        """`engine` is a DecisionEngine or ShardedDecisionEngine (both
+        expose get_rate_limits/sweep/cache_size/close)."""
+        self.conf = conf
+        self.engine = engine
+        self.global_cache = _GlobalStatusCache(capacity=conf.cache_size)
+        self.global_mgr = GlobalManager(conf.behaviors, self)
+        self.multi_region_mgr = MultiRegionManager(conf.behaviors, self)
+        self.local_picker: ReplicatedConsistentHash[PeerClient] = (
+            ReplicatedConsistentHash(conf.hash_algorithm)
+        )
+        self.region_picker: RegionPicker[PeerClient] = RegionPicker(
+            conf.hash_algorithm
+        )
+        self._peer_lock = threading.RLock()
+        self._forward_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="guber-forward"
+        )
+        self._closed = False
+        # Metric counters (reference: gubernator.go:59-113), scraped by
+        # utils.metrics into the /metrics endpoint.
+        self.counters = {
+            "local": 0,
+            "forward": 0,
+            "global": 0,
+            "check_errors": 0,
+            "async_retries": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # Public API (reference: proto/gubernator.proto service V1)
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """reference: gubernator.go:197-317 (GetRateLimits)."""
+        if len(requests) > MAX_BATCH_SIZE:
+            self.counters["check_errors"] += 1
+            raise ServiceError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        n = len(requests)
+        responses: List[Optional[RateLimitResp]] = [None] * n
+        now_ms = self.engine.clock.now_ms()
+
+        # 1. validate (reference: gubernator.go:231-243)
+        candidates: List[int] = []
+        for i, r in enumerate(requests):
+            if not r.unique_key:
+                self.counters["check_errors"] += 1
+                responses[i] = RateLimitResp(error="field 'unique_key' cannot be empty")
+            elif not r.name:
+                self.counters["check_errors"] += 1
+                responses[i] = RateLimitResp(error="field 'namespace' cannot be empty")
+            else:
+                candidates.append(i)
+
+        # 2. one vectorized owner lookup for the batch
+        keys = [requests[i].hash_key() for i in candidates]
+        with self._peer_lock:
+            if self.local_picker.size() == 0:
+                owners: List[Optional[PeerClient]] = [None] * len(candidates)
+            else:
+                owners = self.local_picker.get_batch(keys)
+
+        # 3. partition
+        local_idx: List[int] = []
+        forward: Dict[str, Tuple[PeerClient, List[int]]] = {}
+        global_miss: List[Tuple[int, PeerClient]] = []
+        for i, owner in zip(candidates, owners):
+            r = requests[i]
+            if owner is None or owner.info.is_owner:
+                local_idx.append(i)
+            elif has_behavior(r.behavior, Behavior.GLOBAL):
+                # reference: gubernator.go:276-287, 426-466
+                self.counters["global"] += 1
+                self.global_mgr.queue_hit(r)
+                cached = self.global_cache.get(r.hash_key(), now_ms)
+                if cached is not None:
+                    responses[i] = replace(
+                        cached,
+                        metadata={"owner": owner.info.grpc_address},
+                    )
+                else:
+                    # Cache miss: process locally as a NO_BATCHING copy
+                    # (reference: gubernator.go:455-460).
+                    global_miss.append((i, owner))
+            else:
+                addr = owner.info.grpc_address
+                forward.setdefault(addr, (owner, []))[1].append(i)
+
+        # 4. local + global-miss items: ONE engine batch
+        engine_items = local_idx + [i for i, _ in global_miss]
+        if engine_items:
+            engine_reqs = [requests[i] for i in local_idx]
+            for i, _ in global_miss:
+                engine_reqs.append(
+                    replace(requests[i], behavior=int(Behavior.NO_BATCHING))
+                )
+            self.counters["local"] += len(local_idx)
+            engine_resps = self.apply_local_batch(engine_reqs, now_ms=now_ms)
+            for j, i in enumerate(engine_items):
+                responses[i] = engine_resps[j]
+            for i, owner in global_miss:
+                responses[i].metadata = {"owner": owner.info.grpc_address}
+
+        # 5. forward the rest (async per peer, 5-retry loop)
+        if forward:
+            futures = []
+            for addr, (peer, idxs) in forward.items():
+                self.counters["forward"] += len(idxs)
+                futures.append(
+                    self._forward_pool.submit(
+                        self._forward_group, peer, idxs, requests, responses
+                    )
+                )
+            for f in futures:
+                f.result()
+
+        return responses  # type: ignore[return-value]
+
+    def _forward_group(
+        self,
+        peer: PeerClient,
+        idxs: List[int],
+        requests: Sequence[RateLimitReq],
+        responses: List[Optional[RateLimitResp]],
+    ) -> None:
+        """Forward a same-owner group with the ownership-migration loop.
+
+        reference: gubernator.go:333-422 (asyncRequests) — ≤5 retries on
+        NotReady, re-picking the owner each time; if ownership migrated
+        to us mid-flight, apply locally.
+
+        Multi-item groups go as ONE unary GetPeerRateLimits RPC (our
+        client batch already coalesced them); singletons ride the
+        per-peer batching client so concurrent small requests still
+        coalesce across windows (the reference's thundering-herd
+        protection, peer_client.go:308-376).
+        """
+        groups: Dict[str, Tuple[PeerClient, List[int]]] = {
+            peer.info.grpc_address: (peer, idxs)
+        }
+        attempts = 0
+        while groups:
+            if attempts > 5:
+                for _, (p, ids) in groups.items():
+                    for i in ids:
+                        self.counters["check_errors"] += 1
+                        responses[i] = RateLimitResp(
+                            error=(
+                                "GetPeer() keeps returning peers that are not "
+                                f"connected for '{requests[i].hash_key()}'"
+                            )
+                        )
+                return
+            retry: List[int] = []
+            for _, (p, ids) in groups.items():
+                if attempts != 0 and p.info.is_owner:
+                    # Ownership moved to us (reference: gubernator.go:368-383).
+                    resps = self.apply_local_batch([requests[i] for i in ids])
+                    for i, resp in zip(ids, resps):
+                        responses[i] = resp
+                    continue
+                try:
+                    if len(ids) == 1:
+                        resps = [p.get_peer_rate_limit(requests[ids[0]])]
+                    else:
+                        resps = p.get_peer_rate_limits(
+                            [requests[i] for i in ids]
+                        )
+                except PeerError as e:
+                    if e.not_ready:
+                        self.counters["async_retries"] += len(ids)
+                        retry.extend(ids)
+                        continue
+                    for i in ids:
+                        responses[i] = RateLimitResp(
+                            error=(
+                                "Error while fetching rate limit "
+                                f"'{requests[i].hash_key()}' from peer: {e}"
+                            )
+                        )
+                    continue
+                for i, resp in zip(ids, resps):
+                    resp.metadata = {"owner": p.info.grpc_address}
+                    responses[i] = resp
+            if not retry:
+                return
+            # Re-pick owners for the retried items; they may now map to
+            # different peers or to us.
+            attempts += 1
+            groups = {}
+            for i in retry:
+                try:
+                    p = self.get_peer(requests[i].hash_key())
+                except Exception as pick_err:  # noqa: BLE001
+                    responses[i] = RateLimitResp(
+                        error=(
+                            "Error finding peer that owns rate limit "
+                            f"'{requests[i].hash_key()}': {pick_err}"
+                        )
+                    )
+                    continue
+                groups.setdefault(p.info.grpc_address, (p, []))[1].append(i)
+
+    def get_peer_rate_limits(
+        self, requests: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """Owner side of a forwarded batch — answered authoritatively,
+        never re-forwarded.
+
+        reference: gubernator.go:493-559.  The reference fans items over
+        a worker pool with an order-restoring collector; here the whole
+        batch is one engine call, order preserved by construction.
+        """
+        if len(requests) > MAX_BATCH_SIZE:
+            self.counters["check_errors"] += 1
+            raise ServiceError(
+                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        return self.apply_local_batch(list(requests))
+
+    def update_peer_globals(self, globals_: Sequence[UpdatePeerGlobal]) -> None:
+        """Owner-broadcast GLOBAL statuses land in the host status cache.
+
+        reference: gubernator.go:470-490.
+        """
+        for g in globals_:
+            if g.status is None:
+                continue
+            self.global_cache.put(g.key, g.status, g.algorithm)
+
+    def health_check(self) -> HealthCheckResp:
+        """Aggregate recent peer errors. reference: gubernator.go:562-619."""
+        errs: List[str] = []
+        with self._peer_lock:
+            local_peers = self.local_picker.peers()
+            region_peers = self.region_picker.peers()
+        for p in local_peers:
+            for e in p.last_errs():
+                errs.append(f"Error returned from local peer.GetLastErr: {e}")
+        for p in region_peers:
+            for e in p.last_errs():
+                errs.append(f"Error returned from region peer.GetLastErr: {e}")
+        resp = HealthCheckResp(
+            status=HEALTHY, peer_count=len(local_peers) + len(region_peers)
+        )
+        if errs:
+            resp.status = UNHEALTHY
+            resp.message = "|".join(errs)
+        return resp
+
+    # ------------------------------------------------------------------
+    # Local execution
+
+    def apply_local_batch(
+        self, reqs: List[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Run a batch on the local engine, handling behavior queues.
+
+        reference: gubernator.go:621-654 (getRateLimit): GLOBAL items
+        queue an owner broadcast, MULTI_REGION items queue region hits,
+        then the algorithm runs (here: one vectorized engine call).
+        """
+        for r in reqs:
+            if has_behavior(r.behavior, Behavior.GLOBAL):
+                self.global_mgr.queue_update(r)
+            if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                self.multi_region_mgr.queue_hits(r)
+        return self.engine.get_rate_limits(reqs, now_ms=now_ms)
+
+    # ------------------------------------------------------------------
+    # Peer management (reference: gubernator.go:657-765)
+
+    def set_peers(self, peer_infos: Sequence[PeerInfo]) -> None:
+        """Rebuild pickers from a fresh peer list, reusing existing
+        clients and draining dropped ones.
+
+        reference: gubernator.go:657-740 (SetPeers).
+        """
+        local_picker = self.local_picker.new()
+        region_picker = self.region_picker.new()
+
+        with self._peer_lock:
+            creds = self.conf.peer_credentials
+            local_members: List[PeerClient] = []
+            for info in peer_infos:
+                # Strict DC match, like the reference — a node with
+                # datacenter="" treats only ""-DC peers as local
+                # (reference: gubernator.go:661-676).
+                if info.datacenter != self.conf.data_center:
+                    existing = self.region_picker.get_by_peer_info(info)
+                    peer = existing or PeerClient(
+                        info, self.conf.behaviors, credentials=creds
+                    )
+                    peer.info = info
+                    region_picker.add(peer)
+                else:
+                    existing = self.local_picker.get_by_peer_info(info)
+                    peer = existing or PeerClient(
+                        info, self.conf.behaviors, credentials=creds
+                    )
+                    peer.info = info
+                    local_members.append(peer)
+            local_picker.add_all(local_members)  # one ring rebuild
+
+            old_local = self.local_picker
+            old_region = self.region_picker
+            self.local_picker = local_picker
+            self.region_picker = region_picker
+
+        # Drain peers that fell out of the pool (in the background, like
+        # the reference's goroutine at gubernator.go:719-731).
+        keep = {p.info.grpc_address for p in local_picker.peers()}
+        keep |= {p.info.grpc_address for p in region_picker.peers()}
+        dropped = [
+            p
+            for p in (old_local.peers() + old_region.peers())
+            if p.info.grpc_address not in keep
+        ]
+        for p in dropped:
+            threading.Thread(target=p.shutdown, daemon=True).start()
+
+    def get_peer(self, key: str) -> PeerClient:
+        """Owner of one key. reference: gubernator.go:743-765."""
+        with self._peer_lock:
+            return self.local_picker.get(key)
+
+    def get_peer_list(self) -> List[PeerClient]:
+        with self._peer_lock:
+            return self.local_picker.peers()
+
+    def get_region_pickers(self):
+        with self._peer_lock:
+            return self.region_picker.pickers()
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        """reference: gubernator.go:159-192 (Close)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.global_mgr.close()
+        self.multi_region_mgr.close()
+        self._forward_pool.shutdown(wait=True)
+        with self._peer_lock:
+            peers = self.local_picker.peers() + self.region_picker.peers()
+        for p in peers:
+            p.shutdown(timeout=1.0)
+        self.engine.close()

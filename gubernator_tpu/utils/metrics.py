@@ -1,0 +1,119 @@
+"""Prometheus metrics for the daemon's /metrics endpoint.
+
+Mirrors the reference's metric catalog (reference: prometheus.md:17-36;
+series defined across gubernator.go:59-113, lrucache.go:48-59,
+global.go:41-57, grpc_stats.go:41-131).  Counters are kept as plain
+ints on the hot-path objects (engine/service/managers) — zero
+contention on the decision path — and exported through one custom
+Collector at scrape time, which also serves as the test oracle
+(SURVEY.md §4.2: metrics-as-oracle tests).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable
+
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+)
+from prometheus_client.registry import Collector, CollectorRegistry
+
+if TYPE_CHECKING:
+    from gubernator_tpu.service import V1Instance
+
+
+class InstanceCollector(Collector):
+    """Exports engine + service + manager counters.
+
+    reference: V1Instance itself implements prometheus.Collector
+    (gubernator.go:780-809).
+    """
+
+    def __init__(self, instance: "V1Instance"):
+        self.instance = instance
+
+    def collect(self) -> Iterable:
+        inst = self.instance
+        eng = inst.engine
+
+        c = CounterMetricFamily(
+            "gubernator_check_counter",
+            "The number of rate limits checked.",
+        )
+        c.add_metric([], eng.requests_total)
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_over_limit_counter",
+            "The number of rate limit checks that are over the limit.",
+        )
+        c.add_metric([], eng.over_limit_total)
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_check_error_counter",
+            "The number of errors while checking rate limits.",
+        )
+        c.add_metric([], inst.counters["check_errors"])
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_getratelimit_counter",
+            "The count of getRateLimit() calls by calltype.",
+            labels=["calltype"],
+        )
+        c.add_metric(["local"], inst.counters["local"])
+        c.add_metric(["forward"], inst.counters["forward"])
+        c.add_metric(["global"], inst.counters["global"])
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_asyncrequest_retries",
+            "The count of retries in the forward path.",
+        )
+        c.add_metric([], inst.counters["async_retries"])
+        yield c
+
+        g = GaugeMetricFamily(
+            "gubernator_cache_size",
+            "The number of bucket slots currently interned.",
+        )
+        g.add_metric([], eng.cache_size())
+        yield g
+
+        c = CounterMetricFamily(
+            "gubernator_global_async_sends",
+            "The count of GLOBAL async hit windows flushed to owners.",
+        )
+        c.add_metric([], inst.global_mgr.async_sends)
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_global_broadcasts",
+            "The count of GLOBAL broadcast windows pushed to peers.",
+        )
+        c.add_metric([], inst.global_mgr.broadcasts)
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_engine_batches",
+            "Engine batches applied (device step groups).",
+        )
+        c.add_metric([], eng.batches_total)
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_engine_rounds",
+            "Device kernel rounds executed (≥1 per batch; >1 when a "
+            "batch repeats keys).",
+        )
+        c.add_metric([], eng.rounds_total)
+        yield c
+
+
+def build_registry(instance: "V1Instance") -> CollectorRegistry:
+    """Fresh registry per daemon (reference: daemon.go:85-99)."""
+    reg = CollectorRegistry()
+    reg.register(InstanceCollector(instance))
+    return reg

@@ -1,0 +1,98 @@
+"""Discovery backend tests: gossip convergence, gated backends.
+
+reference analog: memberlist join/leave handling (memberlist.go:187-233)
+— here daemons find each other through the gossip backend instead of
+injected peer lists.
+"""
+
+import time
+
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster.harness import test_behaviors
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.types import RateLimitReq
+
+
+def _until(pred, timeout=10.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _daemon_conf(known_hosts):
+    return DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=test_behaviors(),
+        cache_size=2_000,
+        peer_discovery_type="member-list",
+        member_list_address="127.0.0.1:0",
+        known_hosts=known_hosts,
+        device_count=1,
+    )
+
+
+def test_memberlist_gossip_convergence():
+    """Three daemons discover each other via gossip alone and serve a
+    forwarded request."""
+    daemons = []
+    try:
+        d0 = spawn_daemon(_daemon_conf([]))
+        seed = d0._discovery.gossip_address
+        daemons.append(d0)
+        for _ in range(2):
+            daemons.append(spawn_daemon(_daemon_conf([seed])))
+
+        def all_know_all():
+            return all(
+                d.instance.local_picker.size() == 3 for d in daemons
+            )
+
+        assert _until(all_know_all), [
+            d.instance.local_picker.size() for d in daemons
+        ]
+
+        # A request through any daemon routes to the gossip-discovered
+        # owner and succeeds.
+        req = RateLimitReq(
+            name="gossip", unique_key="k1", hits=1, limit=5, duration=60_000
+        )
+        with V1Client(daemons[1].grpc_address) as c:
+            rs = c.get_rate_limits([req], timeout=10)
+            assert rs[0].error == ""
+            assert rs[0].remaining == 4
+
+        # Kill one daemon; the survivors drop it from membership.
+        daemons[2].close()
+        assert _until(
+            lambda: daemons[0].instance.local_picker.size() == 2, timeout=15
+        )
+    finally:
+        for d in daemons:
+            d.close()
+
+
+def test_etcd_backend_gated():
+    """etcd3 is not installed in this image: the backend must fail with
+    an actionable error, not an ImportError at call depth."""
+    conf = DaemonConfig(peer_discovery_type="etcd")
+    from gubernator_tpu.discovery import create_discovery
+
+    with pytest.raises((RuntimeError, ImportError)) as exc:
+        create_discovery(conf, daemon=None)
+    assert "etcd" in str(exc.value)
+
+
+def test_k8s_backend_gated():
+    conf = DaemonConfig(peer_discovery_type="k8s")
+    from gubernator_tpu.discovery import create_discovery
+
+    with pytest.raises((RuntimeError, ImportError)) as exc:
+        create_discovery(conf, daemon=None)
+    assert "k8s" in str(exc.value) or "kubernetes" in str(exc.value)
