@@ -28,38 +28,47 @@ MEASURE_SECONDS = 5.0
 
 
 def main() -> None:
-    from gubernator_tpu import Algorithm, Behavior, RateLimitReq
+    import numpy as np
+
+    from gubernator_tpu import Algorithm
     from gubernator_tpu.core.engine import DecisionEngine
 
     engine = DecisionEngine(capacity=CAPACITY)
 
-    # Pre-build request objects (client-side cost, not engine cost).
-    reqs = []
+    # Pre-build columnar batches (client-side cost, not engine cost) —
+    # the engine's native request format (DecisionEngine.apply_columnar);
+    # the dataclass/gRPC tier sits above this.
+    batches = []
     for b in range((N_KEYS + BATCH - 1) // BATCH):
-        batch = [
-            RateLimitReq(
-                name="bench",
-                unique_key=f"k{(b * BATCH + i) % N_KEYS}",
-                hits=1,
-                limit=1_000_000,
-                duration=3_600_000,
-                algorithm=(
-                    Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET
-                ),
-                behavior=Behavior.BATCHING,
+        keys = [b"bench_k%d" % ((b * BATCH + i) % N_KEYS) for i in range(BATCH)]
+        algo = np.fromiter(
+            (
+                int(Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET)
+                for i in range(BATCH)
+            ),
+            dtype=np.int32,
+            count=BATCH,
+        )
+        batches.append(
+            dict(
+                keys=keys,
+                algo=algo,
+                behavior=np.zeros(BATCH, dtype=np.int32),
+                hits=np.ones(BATCH, dtype=np.int64),
+                limit=np.full(BATCH, 1_000_000, dtype=np.int64),
+                duration=np.full(BATCH, 3_600_000, dtype=np.int64),
+                burst=np.full(BATCH, 1_000_000, dtype=np.int64),
             )
-            for i in range(BATCH)
-        ]
-        reqs.append(batch)
+        )
 
     for i in range(WARMUP_BATCHES):
-        engine.get_rate_limits(reqs[i % len(reqs)])
+        engine.apply_columnar(**batches[i % len(batches)])
 
     n_done = 0
     start = time.perf_counter()
     i = 0
     while True:
-        engine.get_rate_limits(reqs[i % len(reqs)])
+        engine.apply_columnar(**batches[i % len(batches)])
         n_done += BATCH
         i += 1
         elapsed = time.perf_counter() - start

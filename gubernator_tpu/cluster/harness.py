@@ -51,9 +51,12 @@ class ClusterHarness:
         clock: Clock = SYSTEM_CLOCK,
         behaviors: Optional[BehaviorConfig] = None,
         cache_size: int = 5_000,
+        base_port: Optional[int] = None,
     ) -> "ClusterHarness":
         """Start `count` daemons (datacenters[i] assigns DCs) and give
-        every daemon the full peer list.
+        every daemon the full peer list.  With `base_port`, daemon i
+        listens on 127.0.0.1:base_port+i (the reference's fixed-port
+        style); otherwise ports are OS-assigned.
 
         reference: cluster/cluster.go:101-136 (StartWith).
         """
@@ -64,7 +67,14 @@ class ClusterHarness:
             self._behaviors = behaviors
         self._cache_size = cache_size
         for i in range(count):
-            self.daemons.append(self._spawn(self._datacenters[i]))
+            addr = (
+                f"127.0.0.1:{base_port + i}"
+                if base_port is not None
+                else "127.0.0.1:0"
+            )
+            self.daemons.append(
+                self._spawn(self._datacenters[i], grpc_address=addr)
+            )
         self._push_peers()
         return self
 

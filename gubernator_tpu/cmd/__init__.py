@@ -1,0 +1,1 @@
+"""Executable entry points (reference: cmd/)."""

@@ -1,0 +1,107 @@
+"""Load-generator CLI.
+
+reference: cmd/gubernator-cli/main.go:52-224 — dial one endpoint,
+generate N random token-bucket limits, replay them forever with a
+concurrency fan-out, optional client-side rate limit, report over-limit
+responses and timings.
+
+Run: python -m gubernator_tpu.cmd.cli [address] [--rate N] [--concurrency N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+from typing import List
+
+from gubernator_tpu.client import V1Client, random_string
+from gubernator_tpu.types import Algorithm, RateLimitReq, Status
+
+
+def make_requests(count: int = 2000) -> List[RateLimitReq]:
+    """2000 random limits (reference: main.go:52-70)."""
+    out = []
+    for _ in range(count):
+        out.append(
+            RateLimitReq(
+                name=random_string(10, prefix="ID-"),
+                unique_key=random_string(10, prefix="ID-"),
+                hits=1,
+                limit=random.randint(1, 100),
+                duration=random.randint(1, 10) * 1000,
+                algorithm=Algorithm.TOKEN_BUCKET,
+            )
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="gubernator_tpu load CLI")
+    parser.add_argument("address", nargs="?", default="localhost:81")
+    parser.add_argument("--concurrency", type=int, default=32)
+    parser.add_argument("--checks", type=int, default=1, help="requests per RPC batch")
+    parser.add_argument("--rate", type=float, default=0, help="client-side req/s cap")
+    parser.add_argument("--duration", type=float, default=10, help="seconds to run")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    reqs = make_requests()
+    stop = time.monotonic() + args.duration
+    stats_lock = threading.Lock()
+    stats = {"sent": 0, "over": 0, "errors": 0, "lat_ms": []}
+    interval = args.concurrency / args.rate if args.rate else 0.0
+
+    def worker() -> None:
+        client = V1Client(args.address)
+        rng = random.Random()
+        try:
+            while time.monotonic() < stop:
+                batch = [rng.choice(reqs) for _ in range(args.checks)]
+                t0 = time.perf_counter()
+                try:
+                    resps = client.get_rate_limits(batch, timeout=5)
+                except Exception:  # noqa: BLE001
+                    with stats_lock:
+                        stats["errors"] += len(batch)
+                    continue
+                dt = (time.perf_counter() - t0) * 1000
+                with stats_lock:
+                    stats["sent"] += len(batch)
+                    stats["lat_ms"].append(dt)
+                    for r in resps:
+                        if r.status == Status.OVER_LIMIT:
+                            stats["over"] += 1
+                        if r.error and not args.quiet:
+                            print("error:", r.error, file=sys.stderr)
+                            stats["errors"] += 1
+                if interval:
+                    time.sleep(interval)
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(args.concurrency)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+
+    lat = sorted(stats["lat_ms"])
+    p = lambda q: lat[min(int(len(lat) * q), len(lat) - 1)] if lat else 0.0
+    print(
+        f"sent={stats['sent']} over_limit={stats['over']} "
+        f"errors={stats['errors']} rps={stats['sent'] / max(elapsed, 1e-9):.0f} "
+        f"p50={p(0.5):.2f}ms p99={p(0.99):.2f}ms"
+    )
+    return 0 if stats["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

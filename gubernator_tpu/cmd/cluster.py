@@ -1,0 +1,38 @@
+"""Boot a local in-process cluster for client testing.
+
+reference: cmd/gubernator-cluster/main.go:30-56 (6-node local cluster).
+
+Run: python -m gubernator_tpu.cmd.cluster [--nodes N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="local gubernator_tpu cluster")
+    parser.add_argument("--nodes", type=int, default=6)
+    parser.add_argument("--base-port", type=int, default=9190)
+    args = parser.parse_args(argv)
+
+    from gubernator_tpu.cluster.harness import ClusterHarness
+
+    h = ClusterHarness()
+    h.start(args.nodes, base_port=args.base_port)
+    for i, d in enumerate(h.daemons):
+        print(f"node {i}: grpc={d.grpc_address} http={d.http_address}")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    h.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""The gubernator_tpu daemon binary.
+
+reference: cmd/gubernator/main.go — flag parse (-config, -debug),
+env-driven config, SpawnDaemon, SIGINT/SIGTERM cleanup.
+
+Run:  python -m gubernator_tpu.cmd.daemon [-config FILE] [-debug]
+Env:  GUBER_* variables (see gubernator_tpu/config.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="gubernator_tpu daemon")
+    parser.add_argument(
+        "-config", "--config", default="", help="KEY=VALUE environment file"
+    )
+    parser.add_argument(
+        "-debug", "--debug", action="store_true", help="debug logging"
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    from gubernator_tpu.config import setup_daemon_config
+    from gubernator_tpu.daemon import spawn_daemon
+
+    conf = setup_daemon_config(args.config or None)
+    daemon = spawn_daemon(conf)
+    log = logging.getLogger("gubernator_tpu")
+    log.info(
+        "gubernator_tpu listening: grpc=%s http=%s discovery=%s",
+        daemon.grpc_address,
+        daemon.http_address,
+        conf.peer_discovery_type,
+    )
+
+    stop = threading.Event()
+
+    def _shutdown(signum, frame):
+        log.info("signal %s: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGTERM, _shutdown)
+    stop.wait()
+    daemon.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,13 +38,22 @@ from gubernator_tpu.gregorian import (
 from gubernator_tpu.ops.bucket_kernel import (
     BatchInput,
     BucketState,
+    SlotRecord,
     apply_batch,
+    apply_batch_sorted,
     clear_occupied,
+    load_slots,
     make_state,
 )
 from gubernator_tpu.ops.expiry import sweep_expired
 from gubernator_tpu.core.interning import InternTable
-from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+)
 
 _I32 = np.int32
 _I64 = np.int64
@@ -56,6 +65,61 @@ def _pad_size(n: int, floor: int = 64) -> int:
     while size < n:
         size *= 2
     return size
+
+
+class _ZerosCache:
+    """Reusable zero arrays (columnar no-greg fast path)."""
+
+    def __init__(self) -> None:
+        self._arrays: dict[int, np.ndarray] = {}
+
+    def get(self, n: int) -> np.ndarray:
+        a = self._arrays.get(n)
+        if a is None:
+            a = np.zeros(n, dtype=_I64)
+            self._arrays[n] = a
+        return a
+
+
+_ZEROS_CACHE = _ZerosCache()
+
+
+class PendingColumnar:
+    """In-flight columnar batch: device work dispatched, packed outputs
+    copying to host asynchronously.  `.get()` materializes (status,
+    limit, remaining, reset_time) in request order."""
+
+    __slots__ = ("_engine", "_pieces", "_limit", "_n", "_result")
+
+    def __init__(self, engine, pieces, limit, n):
+        self._engine = engine
+        self._pieces = pieces
+        self._limit = limit
+        self._n = n
+        self._result = None
+
+    def get(self):
+        if self._result is not None:
+            return self._result
+        n = self._n
+        o_status = np.empty(n, dtype=np.int32)
+        o_remaining = np.empty(n, dtype=_I64)
+        o_reset = np.empty(n, dtype=_I64)
+        for packed, dst_idx, m, size in self._pieces:
+            arr = np.asarray(packed)  # one transfer: [3*size] int64
+            o_status[dst_idx] = arr[:m]
+            o_remaining[dst_idx] = arr[size : size + m]
+            o_reset[dst_idx] = arr[2 * size : 2 * size + m]
+        over = int(np.sum(o_status == int(Status.OVER_LIMIT)))
+        with self._engine._lock:
+            # Counted at materialization; a dropped PendingColumnar
+            # (fire-and-forget caller) does not contribute.
+            self._engine.over_limit_total += over
+        # limit is echoed from the request (the kernel's limit output is
+        # always the request limit).
+        self._result = (o_status, self._limit, o_remaining, o_reset)
+        self._pieces = ()
+        return self._result
 
 
 class DecisionEngine:
@@ -72,6 +136,7 @@ class DecisionEngine:
         clock: Clock = SYSTEM_CLOCK,
         device: Optional[jax.Device] = None,
         max_kernel_width: int = 8192,
+        store=None,  # gubernator_tpu.store.Store (write-through hooks)
     ):
         if not jax.config.jax_enable_x64:
             raise RuntimeError(
@@ -83,9 +148,20 @@ class DecisionEngine:
         self.clock = clock
         self._device = device
         self.max_kernel_width = max_kernel_width
-        self.table = InternTable(capacity)
+        # Native C++ table when buildable (batch schedule() fast path),
+        # Python InternTable otherwise — behaviorally identical
+        # (fuzz-tested in tests/test_native_table.py).
+        from gubernator_tpu.core.native import make_intern_table
+
+        self.table = make_intern_table(capacity)
+        self.store = store
         with jax.default_device(device) if device else nullcontext():
             self._state: BucketState = make_state(capacity)
+            # Reusable no-op clear argument for apply_batch (all lanes
+            # out of range — real clears run via clear_occupied).
+            self._noop_clear = jnp.asarray(
+                np.arange(capacity, capacity + 16, dtype=np.int64).astype(_I32)
+            )
         self._lock = threading.Lock()
         # Metrics (reference: gubernator.go:59-113 catalog; wired to
         # prometheus in gubernator_tpu.utils.metrics).
@@ -154,27 +230,56 @@ class DecisionEngine:
         # docstring).  Eviction clears participate in the same per-slot
         # sequence: a clear of slot s must run after the evicted key's
         # last request on s (earlier rounds) and no later than the
-        # reusing key's first request (clears apply before gathers and
-        # writes within a kernel call), so a clear is scheduled at the
-        # slot's current sequence number without consuming one.
-        slots = np.empty(len(keys), dtype=_I32)
-        seq: dict[int, int] = {}
+        # reusing key's first request (clears run before the round's
+        # apply step), so a clear is scheduled at the slot's current
+        # sequence number without consuming one.  Store restores (write-
+        # through hydration of new keys) run after the clear, before the
+        # apply, in that same round.
         rounds: dict[int, List[int]] = {}
         clear_rounds: dict[int, List[int]] = {}
-        for j, key in enumerate(keys):
-            evicted: List[int] = []
-            slot = self.table.intern(key, now_ms, evicted)
-            for es in evicted:
-                clear_rounds.setdefault(seq.get(es, 0), []).append(es)
-            k = seq.get(slot, 0)
-            seq[slot] = k + 1
-            rounds.setdefault(k, []).append(j)
-            slots[j] = slot
+        restore_rounds: dict[int, List[tuple]] = {}
+        if self.store is None and hasattr(self.table, "schedule"):
+            # Batch fast path: one native call interns the whole batch
+            # and assigns rounds + eviction clears.
+            slots, rounds_arr, evicted, evict_rounds = self.table.schedule(
+                [k.encode() for k in keys], now_ms
+            )
+            max_round = int(rounds_arr.max()) if len(rounds_arr) else 0
+            if max_round == 0:
+                rounds[0] = list(range(len(keys)))
+            else:
+                for j, k in enumerate(rounds_arr.tolist()):
+                    rounds.setdefault(k, []).append(j)
+            for es, k in zip(evicted.tolist(), evict_rounds.tolist()):
+                clear_rounds.setdefault(k, []).append(es)
+        else:
+            slots = np.empty(len(keys), dtype=_I32)
+            seq: dict[int, int] = {}
+            for j, key in enumerate(keys):
+                evicted_l: List[int] = []
+                is_new = not self.table.contains(key)
+                slot = self.table.intern(key, now_ms, evicted_l)
+                for es in evicted_l:
+                    clear_rounds.setdefault(seq.get(es, 0), []).append(es)
+                k = seq.get(slot, 0)
+                seq[slot] = k + 1
+                rounds.setdefault(k, []).append(j)
+                slots[j] = slot
+                if is_new and self.store is not None:
+                    # Read-through (reference: algorithms.go:46-54).
+                    item = self.store.get(requests[valid_idx[j]])
+                    if item is not None and item.value is not None:
+                        restore_rounds.setdefault(k, []).append((slot, item))
 
         host_expire = np.zeros(len(valid_idx), dtype=_I64)
         for k in sorted(rounds):
             members = rounds[k]
-            cleared = np.asarray(clear_rounds.get(k, []), dtype=_I32)
+            cleared = clear_rounds.get(k)
+            if cleared:
+                self._apply_clears(np.asarray(cleared, dtype=_I32))
+            restores = restore_rounds.get(k)
+            if restores:
+                self._apply_restores(restores)
             # Bound device shapes: chunk wide rounds so one oversized
             # client batch can't force unbounded XLA recompiles.
             for lo in range(0, len(members), self.max_kernel_width):
@@ -183,7 +288,6 @@ class DecisionEngine:
                     valid_idx,
                     members[lo : lo + self.max_kernel_width],
                     slots,
-                    cleared if lo == 0 else np.empty(0, dtype=_I32),
                     greg_dur,
                     greg_exp,
                     now_ms,
@@ -195,13 +299,139 @@ class DecisionEngine:
         # Refresh the host TTL mirror for eviction ordering.
         self.table.set_expiry(slots, host_expire)
 
+        if self.store is not None:
+            self._write_through(
+                requests, valid_idx, greg_dur, now_ms, responses, host_expire
+            )
+
+    def _apply_clears(self, cleared: np.ndarray) -> None:
+        """Eviction clears: a separate tiny scatter so the apply
+        kernel's compiled shapes never depend on eviction pressure."""
+        csize = _pad_size(len(cleared), floor=16)
+        c = np.arange(
+            self.capacity, self.capacity + csize, dtype=np.int64
+        ).astype(_I32)
+        c[: len(cleared)] = cleared
+        self._state = self._state._replace(
+            occupied=clear_occupied(self._state.occupied, jnp.asarray(c))
+        )
+
+    def _apply_restores(self, restores: List[tuple]) -> None:
+        """Hydrate store-provided bucket values into fresh slots.
+
+        reference: the Store.Get read-through path of
+        algorithms.go:46-54 — here it is one batched device scatter."""
+        restores = sorted(restores, key=lambda r: r[0])
+        n = len(restores)
+        size = _pad_size(n, floor=16)
+        rec = {
+            "slot": np.arange(
+                self.capacity, self.capacity + size, dtype=np.int64
+            ).astype(_I32),
+            "algo": np.zeros(size, dtype=_I32),
+            "status": np.zeros(size, dtype=_I32),
+            "limit": np.zeros(size, dtype=_I64),
+            "remaining": np.zeros(size, dtype=_I64),
+            "remf_hi": np.zeros(size, dtype=_I32),
+            "remf_lo": np.zeros(size, dtype=np.uint32),
+            "duration": np.zeros(size, dtype=_I64),
+            "t0": np.zeros(size, dtype=_I64),
+            "expire_at": np.zeros(size, dtype=_I64),
+            "burst": np.zeros(size, dtype=_I64),
+            "invalid_at": np.zeros(size, dtype=_I64),
+        }
+        from gubernator_tpu.store import LeakyBucketItem, TokenBucketItem
+
+        for lane, (slot, item) in enumerate(restores):
+            v = item.value
+            rec["slot"][lane] = slot
+            rec["expire_at"][lane] = item.expire_at
+            rec["invalid_at"][lane] = item.invalid_at
+            if isinstance(v, TokenBucketItem):
+                rec["algo"][lane] = int(Algorithm.TOKEN_BUCKET)
+                rec["status"][lane] = v.status
+                rec["limit"][lane] = v.limit
+                rec["remaining"][lane] = v.remaining
+                rec["duration"][lane] = v.duration
+                rec["t0"][lane] = v.created_at
+            elif isinstance(v, LeakyBucketItem):
+                rec["algo"][lane] = int(Algorithm.LEAKY_BUCKET)
+                rec["limit"][lane] = v.limit
+                if v.remaining_words is not None:
+                    rec["remf_hi"][lane] = v.remaining_words[0]
+                    rec["remf_lo"][lane] = np.uint32(v.remaining_words[1])
+                else:
+                    whole = np.floor(v.remaining)
+                    rec["remf_hi"][lane] = int(whole)
+                    rec["remf_lo"][lane] = np.uint32(
+                        min((v.remaining - whole) * (2.0**32), 2.0**32 - 1)
+                    )
+                rec["duration"][lane] = v.duration
+                rec["t0"][lane] = v.updated_at
+                rec["burst"][lane] = v.burst
+        self._state = load_slots(
+            self._state,
+            SlotRecord(**{k: jnp.asarray(a) for k, a in rec.items()}),
+        )
+
+    def _write_through(
+        self,
+        requests: Sequence[RateLimitReq],
+        valid_idx: List[int],
+        greg_dur: np.ndarray,
+        now_ms: int,
+        responses: List[Optional[RateLimitResp]],
+        host_expire: np.ndarray,
+    ) -> None:
+        """Store.OnChange per touched key, values derived from the
+        response (see gubernator_tpu.store docstring for the leaky
+        precision caveat).  reference: algorithms.go:164-169,266-269.
+        """
+        from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
+
+        for j, i in enumerate(valid_idx):
+            r = requests[i]
+            resp = responses[i]
+            if resp is None or resp.error:
+                continue
+            key = r.hash_key()
+            greg = bool(int(r.behavior) & Behavior.DURATION_IS_GREGORIAN)
+            dur = int(greg_dur[i]) if greg else r.duration
+            if int(r.algorithm) == int(Algorithm.TOKEN_BUCKET):
+                if int(r.behavior) & Behavior.RESET_REMAINING:
+                    # reference: algorithms.go:83-97 (remove then recreate).
+                    self.store.remove(key)
+                value = TokenBucketItem(
+                    status=int(resp.status),
+                    limit=resp.limit,
+                    duration=dur,
+                    remaining=resp.remaining,
+                    created_at=now_ms if greg else resp.reset_time - dur,
+                )
+            else:
+                value = LeakyBucketItem(
+                    limit=resp.limit,
+                    duration=dur,
+                    remaining=float(resp.remaining),
+                    updated_at=now_ms,
+                    burst=r.burst,
+                )
+            self.store.on_change(
+                r,
+                CacheItem(
+                    key=key,
+                    value=value,
+                    expire_at=int(host_expire[j]),
+                    algorithm=int(r.algorithm),
+                ),
+            )
+
     def _run_round(
         self,
         requests: Sequence[RateLimitReq],
         valid_idx: List[int],
         members: List[int],
         slots: np.ndarray,
-        cleared: np.ndarray,
         greg_dur: np.ndarray,
         greg_exp: np.ndarray,
         now_ms: int,
@@ -242,20 +472,6 @@ class DecisionEngine:
             else:
                 host_expire[j] = now_ms + r.duration
 
-        # Eviction clears run as a separate tiny scatter so the apply
-        # kernel's compiled shapes never depend on eviction pressure.
-        if len(cleared):
-            csize = _pad_size(len(cleared), floor=16)
-            c = np.arange(
-                self.capacity, self.capacity + csize, dtype=np.int64
-            ).astype(_I32)
-            c[: len(cleared)] = cleared
-            self._state = self._state._replace(
-                occupied=clear_occupied(self._state.occupied, jnp.asarray(c))
-            )
-        b_clear = np.arange(
-            self.capacity, self.capacity + 16, dtype=np.int64
-        ).astype(_I32)
 
         batch = BatchInput(
             slot=jnp.asarray(b_slot),
@@ -269,7 +485,7 @@ class DecisionEngine:
             greg_expire=jnp.asarray(b_gexp),
         )
         self._state, out = apply_batch(
-            self._state, batch, jnp.asarray(b_clear), jnp.asarray(now_ms, dtype=jnp.int64)
+            self._state, batch, self._noop_clear, jnp.asarray(now_ms, dtype=jnp.int64)
         )
 
         o_status = np.asarray(out.status)
@@ -306,6 +522,298 @@ class DecisionEngine:
             freed_slots = np.nonzero(np.asarray(freed))[0]
             self.table.release_slots(freed_slots)
         return int(freed_slots.size)
+
+    # ------------------------------------------------------------------
+    # Columnar fast path: the engine's native request format.
+    #
+    # The dataclass API above exists for wire compatibility; at high QPS
+    # the per-object Python cost dominates the kernel, so batch sources
+    # that can produce columns (the bench harness, a native front-end,
+    # the GLOBAL hit aggregator) call this instead: keys + numpy columns
+    # in, numpy columns out — zero per-item Python in the hot loop.
+
+    def apply_columnar(
+        self,
+        keys: List[bytes],
+        algo: np.ndarray,  # int32 [n]
+        behavior: np.ndarray,  # int32 [n]
+        hits: np.ndarray,  # int64 [n]
+        limit: np.ndarray,  # int64 [n]
+        duration: np.ndarray,  # int64 [n]
+        burst: np.ndarray,  # int64 [n]
+        now_ms: Optional[int] = None,
+        want_async: bool = False,
+    ):
+        """Vectorized decision path; returns (status, limit, remaining,
+        reset_time) int64/int32 numpy arrays in request order — or,
+        with want_async=True, a PendingColumnar whose .get() yields
+        them, letting the caller overlap the device→host readback of
+        this batch with dispatch of the next (double buffering).
+
+        Requires no Store attached (the write-through path needs
+        per-item dataclasses) and handles DURATION_IS_GREGORIAN via a
+        per-item fallback only for the flagged lanes.
+        """
+        if self.store is not None:
+            raise RuntimeError(
+                "apply_columnar does not support a write-through Store; "
+                "use get_rate_limits"
+            )
+        n = len(keys)
+        if now_ms is None:
+            now_ms = self.clock.now_ms()
+        greg_dur = None
+        greg_exp = None
+        greg_mask = (behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+        if greg_mask.any():
+            greg_dur = np.zeros(n, dtype=_I64)
+            greg_exp = np.zeros(n, dtype=_I64)
+            now_dt = dt_from_ms(now_ms)
+            for i in np.nonzero(greg_mask)[0]:
+                # Invalid intervals surface as status=OVER+error in the
+                # dataclass path; columnar callers pre-validate.
+                greg_dur[i] = gregorian_duration(now_dt, int(duration[i]))
+                greg_exp[i] = gregorian_expiration(now_dt, int(duration[i]))
+
+        with self._lock:
+            pending = self._apply_columnar_locked(
+                keys, algo, behavior, hits, limit, duration, burst,
+                greg_dur, greg_exp, greg_mask, now_ms,
+            )
+            self.requests_total += n
+            self.batches_total += 1
+        return pending if want_async else pending.get()
+
+    def _apply_columnar_locked(
+        self, keys, algo, behavior, hits, limit, duration, burst,
+        greg_dur, greg_exp, greg_mask, now_ms,
+    ):
+        n = len(keys)
+        if hasattr(self.table, "schedule"):
+            slots, rounds_arr, evicted, evict_rounds = self.table.schedule(
+                keys, now_ms
+            )
+        else:
+            slots = np.empty(n, dtype=_I32)
+            rounds_arr = np.empty(n, dtype=_I32)
+            seq: dict[int, int] = {}
+            ev_list: List[int] = []
+            ev_rounds: List[int] = []
+            for j, key in enumerate(keys):
+                cleared: List[int] = []
+                slot = self.table.intern(key.decode(), now_ms, cleared)
+                for es in cleared:
+                    ev_list.append(es)
+                    ev_rounds.append(seq.get(es, 0))
+                k = seq.get(slot, 0)
+                seq[slot] = k + 1
+                slots[j] = slot
+                rounds_arr[j] = k
+            evicted = np.asarray(ev_list, dtype=_I32)
+            evict_rounds = np.asarray(ev_rounds, dtype=_I32)
+
+        if greg_dur is None:
+            greg_dur = _ZEROS_CACHE.get(n)
+            greg_exp = greg_dur
+
+        max_round = int(rounds_arr.max()) if n else 0
+        if max_round == 0:
+            round_members = [(0, None)]  # None = all lanes, no gather
+        else:
+            order = np.argsort(rounds_arr, kind="stable")
+            sorted_rounds = rounds_arr[order]
+            uniq, starts = np.unique(sorted_rounds, return_index=True)
+            bounds = list(starts) + [n]
+            round_members = [
+                (int(k), order[bounds[i] : bounds[i + 1]])
+                for i, k in enumerate(uniq)
+            ]
+
+        clear_by_round: dict[int, List[int]] = {}
+        for es, k in zip(evicted.tolist(), evict_rounds.tolist()):
+            clear_by_round.setdefault(k, []).append(es)
+
+        # Dispatch: host presorts each chunk by slot (the sort the
+        # device kernel would otherwise pay a sorting network for),
+        # sends it through the sort-free kernel, and starts an async
+        # copy of the packed outputs.  Materialization happens in
+        # PendingColumnar.get(), so the caller can overlap this batch's
+        # readback with the next batch's dispatch.
+        pieces: List[tuple] = []
+        now_dev = jnp.asarray(now_ms, dtype=jnp.int64)
+        for k, members in round_members:
+            cleared = clear_by_round.get(k)
+            if cleared:
+                self._apply_clears(np.asarray(cleared, dtype=_I32))
+            if members is None:
+                c_slot = slots
+                cols = (algo, behavior, hits, limit, duration, burst,
+                        greg_dur, greg_exp)
+            else:
+                c_slot = slots[members]
+                cols = tuple(
+                    a[members]
+                    for a in (algo, behavior, hits, limit, duration, burst,
+                              greg_dur, greg_exp)
+                )
+            m_total = len(c_slot)
+            for lo in range(0, m_total, self.max_kernel_width):
+                hi = min(lo + self.max_kernel_width, m_total)
+                m = hi - lo
+                size = _pad_size(m)
+                pad = size - m
+                sort_idx = np.argsort(c_slot[lo:hi], kind="stable")
+
+                def col(arr, dtype):
+                    sorted_vals = arr[lo:hi][sort_idx]
+                    if pad == 0:
+                        return np.ascontiguousarray(sorted_vals, dtype=dtype)
+                    out = np.zeros(size, dtype=dtype)
+                    out[:m] = sorted_vals
+                    return out
+
+                p_slot = col(c_slot, _I32)
+                if pad:
+                    p_slot[m:] = np.arange(
+                        self.capacity, self.capacity + pad, dtype=np.int64
+                    ).astype(_I32)
+                batch = BatchInput(
+                    slot=jnp.asarray(p_slot),
+                    algo=jnp.asarray(col(cols[0], _I32)),
+                    behavior=jnp.asarray(col(cols[1], _I32)),
+                    hits=jnp.asarray(col(cols[2], _I64)),
+                    limit=jnp.asarray(col(cols[3], _I64)),
+                    duration=jnp.asarray(col(cols[4], _I64)),
+                    burst=jnp.asarray(col(cols[5], _I64)),
+                    greg_duration=jnp.asarray(col(cols[6], _I64)),
+                    greg_expire=jnp.asarray(col(cols[7], _I64)),
+                )
+                self._state, packed = apply_batch_sorted(
+                    self._state, batch, now_dev
+                )
+                packed.copy_to_host_async()
+                self.rounds_total += 1
+                # Request indices of the sorted lanes, for unpermuting.
+                if members is None:
+                    dst_idx = sort_idx + lo if lo else sort_idx
+                else:
+                    dst_idx = members[lo:hi][sort_idx]
+                pieces.append((packed, dst_idx, m, size))
+
+        expires = np.where(greg_mask, greg_exp, now_ms + duration)
+        self.table.set_expiry(slots, expires.astype(_I64))
+        return PendingColumnar(self, pieces, limit, n)
+
+    # ------------------------------------------------------------------
+    # Bulk persistence (reference: store.go:69-78 Loader; the pool-level
+    # drivers are gubernator_pool.go:341-531 Load/Store)
+
+    def load(self, loader) -> int:
+        """Stream CacheItems in before serving; returns count restored.
+
+        reference: gubernator.go:146-152 → gubernator_pool.go:341-427.
+        """
+        count = 0
+        batch: List[tuple] = []
+        pending_slots: set = set()
+        now_ms = self.clock.now_ms()
+
+        def flush():
+            nonlocal batch
+            if batch:
+                self._apply_restores(batch)
+                self.table.set_expiry(
+                    np.asarray([s for s, _ in batch], dtype=_I32),
+                    np.asarray([it.expire_at for _, it in batch], dtype=_I64),
+                )
+                batch = []
+                pending_slots.clear()
+
+        with self._lock:
+            for item in loader.load():
+                if item.value is None or not item.key:
+                    continue
+                evicted: List[int] = []
+                slot = self.table.intern(item.key, now_ms, evicted)
+                # A re-used slot (eviction, or a loader emitting the
+                # same key twice) must not appear twice in one restore
+                # scatter, and its clear must not run after a pending
+                # restore of the same slot — flush first.
+                if slot in pending_slots or any(
+                    e in pending_slots for e in evicted
+                ):
+                    flush()
+                if evicted:
+                    self._apply_clears(np.asarray(evicted, dtype=_I32))
+                batch.append((slot, item))
+                pending_slots.add(slot)
+                count += 1
+                if len(batch) >= 4096:
+                    flush()
+            flush()
+        return count
+
+    def export_items(self):
+        """Full-fidelity device→host snapshot as CacheItems.
+
+        reference: gubernator_pool.go:468-531 (Store → Loader.Save).
+        """
+        from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
+
+        with self._lock:
+            s = self._state
+            occ = np.asarray(s.occupied)
+            algo = np.asarray(s.algo)
+            status = np.asarray(s.status)
+
+            def c64(hi, lo):
+                return (
+                    np.asarray(hi).astype(np.int64) << 32
+                ) | np.asarray(lo).astype(np.int64)
+
+            limit = c64(s.limit_hi, s.limit_lo)
+            remaining = c64(s.remaining_hi, s.remaining_lo)
+            remf_hi = np.asarray(s.remf_hi)
+            remf_lo = np.asarray(s.remf_lo)
+            duration = c64(s.duration_hi, s.duration_lo)
+            t0 = c64(s.t0_hi, s.t0_lo)
+            expire = c64(s.expire_hi, s.expire_lo)
+            burst = c64(s.burst_hi, s.burst_lo)
+            invalid = c64(s.invalid_hi, s.invalid_lo)
+            slots = np.nonzero(occ)[0]
+            keys = [self.table.key_for_slot(int(sl)) for sl in slots]
+        for sl, key in zip(slots, keys):
+            if key is None:
+                continue
+            if algo[sl] == int(Algorithm.TOKEN_BUCKET):
+                value = TokenBucketItem(
+                    status=int(status[sl]),
+                    limit=int(limit[sl]),
+                    duration=int(duration[sl]),
+                    remaining=int(remaining[sl]),
+                    created_at=int(t0[sl]),
+                )
+            else:
+                value = LeakyBucketItem(
+                    limit=int(limit[sl]),
+                    duration=int(duration[sl]),
+                    remaining=float(remf_hi[sl]) + float(remf_lo[sl]) * 2.0**-32,
+                    updated_at=int(t0[sl]),
+                    burst=int(burst[sl]),
+                    # Exact words: the float mirror rounds at ≥2^21.
+                    remaining_words=(int(remf_hi[sl]), int(remf_lo[sl])),
+                )
+            yield CacheItem(
+                key=key,
+                value=value,
+                expire_at=int(expire[sl]),
+                algorithm=int(algo[sl]),
+                invalid_at=int(invalid[sl]),
+            )
+
+    def save(self, loader) -> None:
+        """Stream the cache out at shutdown (reference: Loader.Save)."""
+        loader.save(self.export_items())
 
     def warmup(self, max_width: int = 1024) -> None:
         """Pre-compile the kernel for every padded batch width up to
@@ -350,9 +858,18 @@ class DecisionEngine:
             self.requests_total,
             self.batches_total,
             self.rounds_total,
-            self.table.hits,
-            self.table.misses,
+            saved_hits,
+            saved_misses,
         ) = saved
+        if hasattr(self.table, "discount_stats"):
+            # The native table mirrors cumulative C++ counters on every
+            # schedule(); plain attribute restore would be overwritten
+            # by the next mirror, so register discounts instead.
+            self.table.discount_stats(
+                self.table.hits - saved_hits, self.table.misses - saved_misses
+            )
+        else:
+            self.table.hits, self.table.misses = saved_hits, saved_misses
 
     def cache_size(self) -> int:
         return len(self.table)

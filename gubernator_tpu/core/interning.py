@@ -48,6 +48,9 @@ class InternTable:
     def __len__(self) -> int:
         return len(self._map)
 
+    def contains(self, key: str) -> bool:
+        return key in self._map
+
     def intern(self, key: str, now_ms: int, cleared: list[int]) -> int:
         """Return the slot for `key`, allocating (and possibly evicting)
         if unknown.  Evicted slots are appended to `cleared` so the

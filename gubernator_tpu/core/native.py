@@ -1,0 +1,199 @@
+"""ctypes wrapper over the native interning table.
+
+`NativeInternTable` is API-compatible with `core.interning.InternTable`
+plus the batch `schedule()` fast path the engine prefers: one FFI call
+interns the whole batch, assigns serialization rounds, and returns
+eviction clears — replacing the per-key Python dict walk on the host
+hot path (SURVEY.md §7.3 hard part #1).  Equivalence with the Python
+table is fuzz-tested (tests/test_native_table.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.core.native_build import ensure_built
+
+_lib = None
+
+
+def load_library():
+    """Load (building if needed) the shared object; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = ensure_built()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(str(so))
+    lib.git_new.restype = ctypes.c_void_p
+    lib.git_new.argtypes = [ctypes.c_int64]
+    lib.git_free.argtypes = [ctypes.c_void_p]
+    lib.git_len.restype = ctypes.c_int64
+    lib.git_len.argtypes = [ctypes.c_void_p]
+    lib.git_schedule.restype = ctypes.c_int64
+    lib.git_schedule.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,  # buf
+        ctypes.c_void_p,  # offsets
+        ctypes.c_int64,  # n
+        ctypes.c_int64,  # now_ms
+        ctypes.c_void_p,  # out_slots
+        ctypes.c_void_p,  # out_rounds
+        ctypes.c_void_p,  # out_evicted
+        ctypes.c_void_p,  # out_evict_rounds
+        ctypes.c_void_p,  # stats_out
+    ]
+    lib.git_set_expiry.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.git_remove.restype = ctypes.c_int32
+    lib.git_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.git_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.git_key_for_slot.restype = ctypes.c_int64
+    lib.git_key_for_slot.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.git_contains.restype = ctypes.c_int64
+    lib.git_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeInternTable:
+    """Drop-in InternTable backed by the C++ table."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native intern table unavailable")
+        self._lib = lib
+        self.capacity = capacity
+        self._t = lib.git_new(capacity)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.unexpired_evictions = 0
+        # Discounts subtracted from the C++ cumulative counters when
+        # mirroring (warmup traffic exclusion — engine.warmup).
+        self._stat_off = [0, 0, 0, 0]
+
+    def __del__(self):
+        t = getattr(self, "_t", None)
+        if t:
+            self._lib.git_free(t)
+            self._t = None
+
+    def __len__(self) -> int:
+        return int(self._lib.git_len(self._t))
+
+    # -- batch fast path ----------------------------------------------
+
+    def schedule(
+        self, keys: List[bytes], now_ms: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Intern a batch: returns (slots, rounds, evicted_slots,
+        evict_rounds) — one FFI call for the whole batch."""
+        n = len(keys)
+        buf = b"".join(keys)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        buf_arr = np.frombuffer(buf, dtype=np.uint8) if buf else np.zeros(1, np.uint8)
+        slots = np.empty(n, dtype=np.int32)
+        rounds = np.empty(n, dtype=np.int32)
+        evicted = np.empty(n if n else 1, dtype=np.int32)
+        evict_rounds = np.empty(n if n else 1, dtype=np.int32)
+        stats = np.zeros(4, dtype=np.int64)
+        n_ev = self._lib.git_schedule(
+            self._t,
+            _ptr(buf_arr),
+            _ptr(offsets),
+            n,
+            now_ms,
+            _ptr(slots),
+            _ptr(rounds),
+            _ptr(evicted),
+            _ptr(evict_rounds),
+            _ptr(stats),
+        )
+        off = self._stat_off
+        self.hits, self.misses, self.evictions, self.unexpired_evictions = (
+            int(stats[0]) - off[0],
+            int(stats[1]) - off[1],
+            int(stats[2]) - off[2],
+            int(stats[3]) - off[3],
+        )
+        return slots, rounds, evicted[:n_ev], evict_rounds[:n_ev]
+
+    def discount_stats(self, hits: int, misses: int, evictions: int = 0,
+                       unexpired: int = 0) -> None:
+        """Exclude (warmup) traffic from the mirrored metrics."""
+        self._stat_off[0] += hits
+        self._stat_off[1] += misses
+        self._stat_off[2] += evictions
+        self._stat_off[3] += unexpired
+        self.hits -= hits
+        self.misses -= misses
+        self.evictions -= evictions
+        self.unexpired_evictions -= unexpired
+
+    # -- InternTable-compatible API -----------------------------------
+
+    def intern(self, key: str, now_ms: int, cleared: list) -> int:
+        slots, _rounds, evicted, _er = self.schedule([key.encode()], now_ms)
+        cleared.extend(evicted.tolist())
+        return int(slots[0])
+
+    def contains(self, key: str) -> bool:
+        k = key.encode()
+        return bool(self._lib.git_contains(self._t, k, len(k)))
+
+    def set_expiry(self, slots: np.ndarray, expires: np.ndarray) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        expires = np.ascontiguousarray(expires, dtype=np.int64)
+        self._lib.git_set_expiry(self._t, _ptr(slots), _ptr(expires), len(slots))
+
+    def remove(self, key: str) -> Optional[int]:
+        k = key.encode()
+        slot = self._lib.git_remove(self._t, k, len(k))
+        return None if slot < 0 else int(slot)
+
+    def release_slots(self, slots: np.ndarray) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        self._lib.git_release(self._t, _ptr(slots), len(slots))
+
+    def key_for_slot(self, slot: int) -> Optional[str]:
+        cap = 256
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            ln = self._lib.git_key_for_slot(self._t, slot, out, cap)
+            if ln < 0:
+                return None
+            if ln <= cap:
+                return out.raw[:ln].decode()
+            cap = int(ln)
+
+
+def make_intern_table(capacity: int):
+    """Native table when buildable, Python fallback otherwise."""
+    try:
+        return NativeInternTable(capacity)
+    except (RuntimeError, OSError):
+        from gubernator_tpu.core.interning import InternTable
+
+        return InternTable(capacity)

@@ -1,0 +1,63 @@
+"""Build the native interning table (g++ → shared object).
+
+No pybind11/cffi-compile step: plain C ABI + ctypes.  The .so is built
+on demand next to the source and cached by source hash, so a fresh
+checkout self-builds on first use (~1s) and rebuilds only when the
+source changes.  Set GUBERNATOR_TPU_NATIVE=0 to skip native entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("gubernator_tpu.native")
+
+_SRC = Path(__file__).parent / "native" / "intern_table.cpp"
+_BUILD_DIR = Path(__file__).parent / "native" / "build"
+
+
+def _source_tag() -> str:
+    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+
+
+def ensure_built() -> Optional[Path]:
+    """Compile if needed; returns the .so path or None on failure."""
+    if os.environ.get("GUBERNATOR_TPU_NATIVE", "1") == "0":
+        return None
+    so = _BUILD_DIR / f"intern_table-{_source_tag()}.so"
+    if so.exists():
+        return so
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = so.with_suffix(".so.tmp")
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        str(tmp),
+        str(_SRC),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
+        detail = getattr(e, "stderr", b"")
+        log.warning(
+            "native intern table build failed (falling back to Python): %s %s",
+            e,
+            detail.decode(errors="replace") if detail else "",
+        )
+        return None
+    os.replace(tmp, so)
+    # Drop stale builds of older source versions.
+    for old in _BUILD_DIR.glob("intern_table-*.so"):
+        if old != so:
+            old.unlink(missing_ok=True)
+    return so

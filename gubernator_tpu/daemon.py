@@ -45,10 +45,14 @@ class Daemon:
         *,
         clock: Clock = SYSTEM_CLOCK,
         engine=None,
+        store=None,  # write-through Store (reference: config.go Store field)
+        loader=None,  # bulk Loader (reference: config.go Loader field)
     ):
         self.conf = conf
         self.clock = clock
         self._engine = engine
+        self._store = store
+        self._loader = loader
         self.instance: Optional[V1Instance] = None
         self.grpc_server: Optional[grpc.Server] = None
         self.gateway: Optional[Gateway] = None
@@ -70,6 +74,12 @@ class Daemon:
         devices = jax.devices()
         n = self.conf.device_count or len(devices)
         if n > 1:
+            if self._store is not None:
+                raise ValueError(
+                    "a write-through Store requires a single-device "
+                    "engine (set GUBER_DEVICE_COUNT=1); the sharded "
+                    "engine supports bulk Loader persistence only"
+                )
             from gubernator_tpu.parallel.mesh import make_mesh
             from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
 
@@ -82,7 +92,10 @@ class Daemon:
         from gubernator_tpu.core.engine import DecisionEngine
 
         return DecisionEngine(
-            capacity=self.conf.cache_size, clock=self.clock, device=devices[0]
+            capacity=self.conf.cache_size,
+            clock=self.clock,
+            device=devices[0],
+            store=self._store,
         )
 
     def start(self) -> None:
@@ -90,6 +103,10 @@ class Daemon:
         conf = self.conf
         engine = self._build_engine()
         self._warmup(engine)
+        if self._loader is not None:
+            # Restore persisted buckets before serving
+            # (reference: gubernator.go:146-152).
+            engine.load(self._loader)
 
         creds = None
         if conf.tls is not None:
@@ -260,17 +277,26 @@ class Daemon:
         if self.grpc_server is not None:
             self.grpc_server.stop(grace=1.0).wait()
         if self.instance is not None:
+            if self._loader is not None:
+                # Persist the cache on shutdown
+                # (reference: gubernator.go:159-192 → Loader.Save).
+                self.instance.engine.save(self._loader)
             self.instance.close()
 
 
 def spawn_daemon(
-    conf: DaemonConfig, *, clock: Clock = SYSTEM_CLOCK, engine=None
+    conf: DaemonConfig,
+    *,
+    clock: Clock = SYSTEM_CLOCK,
+    engine=None,
+    store=None,
+    loader=None,
 ) -> Daemon:
     """Start a daemon and wait for readiness.
 
     reference: daemon.go:66-80 (SpawnDaemon).
     """
-    d = Daemon(conf, clock=clock, engine=engine)
+    d = Daemon(conf, clock=clock, engine=engine, store=store, loader=loader)
     d.start()
     d.wait_for_connect()
     return d

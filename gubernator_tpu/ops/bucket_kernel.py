@@ -234,14 +234,51 @@ def _apply_batch_impl(
         ),
         num_keys=1,
     )
-    mask = slot < cap
-
     # Host-side eviction: mark reclaimed slots unoccupied before applying
     # the batch (the reference evicts inline in the LRU; here eviction is
     # a host decision executed on device, SURVEY.md §7.3 item 6).
     occupied = state.occupied.at[jnp.sort(clear_slots)].set(
         False, mode="drop", indices_are_sorted=True, unique_indices=True
     )
+
+    new_state, resp_status, resp_rem, resp_reset = _apply_core(
+        state, occupied, slot, r_algo, r_beh, r_hits, r_limit, r_dur,
+        r_burst, r_gdur, r_gexp, now,
+    )
+
+    # Un-sort: restore responses to request order via a sort on lane idx.
+    _, o_status, o_limit, o_rem, o_reset = jax.lax.sort(
+        (lane_s, resp_status.astype(_I32), r_limit, resp_rem, resp_reset),
+        num_keys=1,
+    )
+    out = BatchOutput(
+        status=o_status,
+        limit=o_limit,
+        remaining=o_rem,
+        reset_time=o_reset,
+    )
+    return new_state, out
+
+
+def _apply_core(
+    state: BucketState,
+    occupied: jax.Array,
+    slot: jax.Array,  # int32 [B] SORTED ascending, unique; padding = cap+i
+    r_algo: jax.Array,
+    r_beh: jax.Array,
+    r_hits: jax.Array,
+    r_limit: jax.Array,
+    r_dur: jax.Array,
+    r_burst: jax.Array,
+    r_gdur: jax.Array,
+    r_gexp: jax.Array,
+    now: jax.Array,
+):
+    """The branch-free bucket update over slot-sorted lanes: gather →
+    update → scatter.  Returns (new_state, status, remaining,
+    reset_time) with responses in the SORTED lane order."""
+    cap = state.occupied.shape[0]
+    mask = slot < cap
 
     def g(arr):
         return arr.at[slot].get(
@@ -429,18 +466,6 @@ def _apply_batch_impl(
     resp_rem = pick(r_limit, te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem)
     resp_reset = pick(zero64, te_exp, tn_exp, le_reset, ln_reset)
 
-    # Un-sort: restore responses to request order via a sort on lane idx.
-    _, o_status, o_limit, o_rem, o_reset = jax.lax.sort(
-        (lane_s, resp_status.astype(_I32), r_limit, resp_rem, resp_reset),
-        num_keys=1,
-    )
-    out = BatchOutput(
-        status=o_status,
-        limit=o_limit,
-        remaining=o_rem,
-        reset_time=o_reset,
-    )
-
     # ---------------- combine paths → stored state, then scatter
     n_occ = ~p_tok_reset
     n_algo = r_algo
@@ -499,10 +524,116 @@ def _apply_batch_impl(
         invalid_hi=sc(state.invalid_hi, zero32),
         invalid_lo=sc(state.invalid_lo, zero32),
     )
-    return new_state, out
+    return new_state, resp_status, resp_rem, resp_reset
 
 
 apply_batch = jax.jit(_apply_batch_impl, donate_argnums=(0,))
+
+
+def _apply_batch_sorted_impl(
+    state: BucketState,
+    batch: BatchInput,  # lanes PRE-SORTED by slot ascending (host sorts)
+    now_ms: jax.Array,
+):
+    """Sort-free variant: the host (which assigned the slots) delivers
+    lanes already slot-sorted, so the device runs only gather → update
+    → scatter — no O(B log²B) sorting network to compile or execute.
+    Outputs are packed into ONE flat int64 buffer
+    [status… remaining… reset_time…] so the host pays a single
+    device→host transfer per step.  Responses stay in the sorted lane
+    order; the host unpermutes with the inverse of its own argsort.
+    """
+    new_state, resp_status, resp_rem, resp_reset = _apply_core(
+        state,
+        state.occupied,
+        batch.slot,
+        batch.algo,
+        batch.behavior,
+        batch.hits,
+        batch.limit,
+        batch.duration,
+        batch.burst,
+        batch.greg_duration,
+        batch.greg_expire,
+        now_ms.astype(_I64),
+    )
+    packed = jnp.concatenate(
+        [resp_status.astype(_I64), resp_rem, resp_reset]
+    )
+    return new_state, packed
+
+
+apply_batch_sorted = jax.jit(_apply_batch_sorted_impl, donate_argnums=(0,))
+
+
+class SlotRecord(NamedTuple):
+    """Persisted bucket values for restoring slots (Store.get /
+    Loader.load hydration), shape [C] per field.
+
+    `remf` carries the leaky remaining as 32.32 fixed point words so a
+    Loader snapshot round-trips bit-exactly."""
+
+    slot: jax.Array  # int32; padding = out-of-range ascending
+    algo: jax.Array  # int32
+    status: jax.Array  # int32
+    limit: jax.Array  # int64
+    remaining: jax.Array  # int64   (token)
+    remf_hi: jax.Array  # int32    (leaky whole)
+    remf_lo: jax.Array  # uint32   (leaky fraction)
+    duration: jax.Array  # int64
+    t0: jax.Array  # int64
+    expire_at: jax.Array  # int64
+    burst: jax.Array  # int64
+    invalid_at: jax.Array  # int64
+
+
+def _load_slots_impl(state: BucketState, rec: SlotRecord) -> BucketState:
+    """Hydrate persisted bucket values into their slots.
+
+    The scatter contract matches the apply kernel: `rec.slot` sorted,
+    unique, padding out-of-range (dropped)."""
+
+    def put(arr, vals):
+        return arr.at[rec.slot].set(
+            vals, mode="drop", indices_are_sorted=True, unique_indices=True
+        )
+
+    def put64(hi, lo, v):
+        vh, vl = split_i64(v)
+        return put(hi, vh), put(lo, vl)
+
+    cap = state.occupied.shape[0]
+    limit_hi, limit_lo = put64(state.limit_hi, state.limit_lo, rec.limit)
+    rem_hi, rem_lo = put64(state.remaining_hi, state.remaining_lo, rec.remaining)
+    dur_hi, dur_lo = put64(state.duration_hi, state.duration_lo, rec.duration)
+    t0_hi, t0_lo = put64(state.t0_hi, state.t0_lo, rec.t0)
+    exp_hi, exp_lo = put64(state.expire_hi, state.expire_lo, rec.expire_at)
+    burst_hi, burst_lo = put64(state.burst_hi, state.burst_lo, rec.burst)
+    inv_hi, inv_lo = put64(state.invalid_hi, state.invalid_lo, rec.invalid_at)
+    return state._replace(
+        occupied=put(state.occupied, rec.slot < cap),
+        algo=put(state.algo, rec.algo),
+        status=put(state.status, rec.status),
+        limit_hi=limit_hi,
+        limit_lo=limit_lo,
+        remaining_hi=rem_hi,
+        remaining_lo=rem_lo,
+        remf_hi=put(state.remf_hi, rec.remf_hi),
+        remf_lo=put(state.remf_lo, rec.remf_lo),
+        duration_hi=dur_hi,
+        duration_lo=dur_lo,
+        t0_hi=t0_hi,
+        t0_lo=t0_lo,
+        expire_hi=exp_hi,
+        expire_lo=exp_lo,
+        burst_hi=burst_hi,
+        burst_lo=burst_lo,
+        invalid_hi=inv_hi,
+        invalid_lo=inv_lo,
+    )
+
+
+load_slots = jax.jit(_load_slots_impl, donate_argnums=(0,))
 
 
 def batch_input_from_numpy(

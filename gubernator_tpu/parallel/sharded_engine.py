@@ -433,6 +433,141 @@ class ShardedDecisionEngine:
         for t, (h, m) in zip(self.tables, table_stats):
             t.hits, t.misses = h, m
 
+    # ------------------------------------------------------------------
+    # Bulk persistence (Loader; reference: store.go:69-78).  Load/save
+    # happen at startup/shutdown, so both use one full host↔device
+    # round trip of the sharded state instead of per-item scatters.
+
+    def load(self, loader) -> int:
+        """Restore a CacheItem stream into the sharded state."""
+        from gubernator_tpu.store import LeakyBucketItem, TokenBucketItem
+        from gubernator_tpu.parallel.mesh import keys_sharding
+
+        now_ms = self.clock.now_ms()
+        with self._lock:
+            host = {
+                # np.array (copy): np.asarray of a jax array is a
+                # read-only view.
+                f: np.array(getattr(self._state, f))
+                for f in self._state._fields
+            }
+            count = 0
+            for item in loader.load():
+                v = item.value
+                if v is None or not item.key:
+                    continue
+                sh = self.shard_of(item.key)
+                cleared: List[int] = []
+                slot = self.tables[sh].intern(item.key, now_ms, cleared)
+                for es in cleared:
+                    host["occupied"][sh, es] = False
+                self.tables[sh].set_expiry(
+                    np.asarray([slot], dtype=_I32),
+                    np.asarray([item.expire_at], dtype=_I64),
+                )
+
+                def put64(name, val):
+                    host[name + "_hi"][sh, slot] = np.int64(val) >> 32
+                    host[name + "_lo"][sh, slot] = np.uint32(val & 0xFFFFFFFF)
+
+                host["occupied"][sh, slot] = True
+                host["algo"][sh, slot] = int(item.algorithm)
+                put64("limit", v.limit)
+                put64("duration", v.duration)
+                put64("expire", item.expire_at)
+                put64("invalid", item.invalid_at)
+                if isinstance(v, TokenBucketItem):
+                    host["status"][sh, slot] = v.status
+                    put64("remaining", v.remaining)
+                    host["remf_hi"][sh, slot] = 0
+                    host["remf_lo"][sh, slot] = 0
+                    put64("t0", v.created_at)
+                    put64("burst", 0)
+                elif isinstance(v, LeakyBucketItem):
+                    host["status"][sh, slot] = 0
+                    put64("remaining", 0)
+                    if v.remaining_words is not None:
+                        host["remf_hi"][sh, slot] = v.remaining_words[0]
+                        host["remf_lo"][sh, slot] = np.uint32(v.remaining_words[1])
+                    else:
+                        whole = np.floor(v.remaining)
+                        host["remf_hi"][sh, slot] = int(whole)
+                        host["remf_lo"][sh, slot] = np.uint32(
+                            min((v.remaining - whole) * (2.0**32), 2.0**32 - 1)
+                        )
+                    put64("t0", v.updated_at)
+                    put64("burst", v.burst)
+                count += 1
+            sharding = keys_sharding(self.mesh)
+            self._state = BucketState(
+                **{
+                    f: jax.device_put(a, sharding)
+                    for f, a in host.items()
+                }
+            )
+        return count
+
+    def export_items(self):
+        """Full-fidelity snapshot as CacheItems (all shards)."""
+        from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
+        from gubernator_tpu.types import Algorithm
+
+        with self._lock:
+            s = self._state
+            occ = np.asarray(s.occupied)
+            algo = np.asarray(s.algo)
+            status = np.asarray(s.status)
+
+            def c64(hi, lo):
+                return (
+                    np.asarray(hi).astype(np.int64) << 32
+                ) | np.asarray(lo).astype(np.int64)
+
+            limit = c64(s.limit_hi, s.limit_lo)
+            remaining = c64(s.remaining_hi, s.remaining_lo)
+            remf_hi = np.asarray(s.remf_hi)
+            remf_lo = np.asarray(s.remf_lo)
+            duration = c64(s.duration_hi, s.duration_lo)
+            t0 = c64(s.t0_hi, s.t0_lo)
+            expire = c64(s.expire_hi, s.expire_lo)
+            burst = c64(s.burst_hi, s.burst_lo)
+            invalid = c64(s.invalid_hi, s.invalid_lo)
+            located = [
+                (sh, int(sl), self.tables[sh].key_for_slot(int(sl)))
+                for sh, sl in zip(*np.nonzero(occ))
+            ]
+        for sh, sl, key in located:
+            if key is None:
+                continue
+            if algo[sh, sl] == int(Algorithm.TOKEN_BUCKET):
+                value = TokenBucketItem(
+                    status=int(status[sh, sl]),
+                    limit=int(limit[sh, sl]),
+                    duration=int(duration[sh, sl]),
+                    remaining=int(remaining[sh, sl]),
+                    created_at=int(t0[sh, sl]),
+                )
+            else:
+                value = LeakyBucketItem(
+                    limit=int(limit[sh, sl]),
+                    duration=int(duration[sh, sl]),
+                    remaining=float(remf_hi[sh, sl])
+                    + float(remf_lo[sh, sl]) * 2.0**-32,
+                    updated_at=int(t0[sh, sl]),
+                    burst=int(burst[sh, sl]),
+                    remaining_words=(int(remf_hi[sh, sl]), int(remf_lo[sh, sl])),
+                )
+            yield CacheItem(
+                key=key,
+                value=value,
+                expire_at=int(expire[sh, sl]),
+                algorithm=int(algo[sh, sl]),
+                invalid_at=int(invalid[sh, sl]),
+            )
+
+    def save(self, loader) -> None:
+        loader.save(self.export_items())
+
     def cache_size(self) -> int:
         return sum(len(t) for t in self.tables)
 

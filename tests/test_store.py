@@ -1,0 +1,228 @@
+"""Persistence tests: write-through Store, bulk Loader, checkpoints.
+
+Mirrors the reference's store tests (reference: store_test.go —
+TestLoader:76 startup/shutdown persistence, TestStore:127 read-through
+and write-through including expiry) against the TPU engine.
+"""
+
+import os
+
+import pytest
+
+from gubernator_tpu.checkpoint import NpzFileLoader
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core.engine import DecisionEngine
+from gubernator_tpu.store import (
+    CacheItem,
+    LeakyBucketItem,
+    MemoryLoader,
+    MemoryStore,
+    TokenBucketItem,
+)
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
+
+
+def req(key="k1", hits=1, limit=10, duration=60_000, **kw):
+    return RateLimitReq(
+        name="test_store", unique_key=key, hits=hits, limit=limit,
+        duration=duration, **kw,
+    )
+
+
+def test_store_write_through(frozen_clock):
+    store = MemoryStore()
+    eng = DecisionEngine(capacity=100, clock=frozen_clock, store=store)
+    r = eng.get_rate_limits([req()])[0]
+    assert r.remaining == 9
+    assert store.on_change_calls == 1
+    item = store.data["test_store_k1"]
+    assert isinstance(item.value, TokenBucketItem)
+    assert item.value.remaining == 9
+    assert item.value.limit == 10
+    assert item.expire_at == frozen_clock.now_ms() + 60_000
+    # Second hit updates the stored value.
+    eng.get_rate_limits([req()])
+    assert store.data["test_store_k1"].value.remaining == 8
+
+
+def test_store_read_through_restores_bucket(frozen_clock):
+    """A new engine with a primed Store continues the persisted bucket
+    instead of starting fresh (reference: TestStore read-through)."""
+    now = frozen_clock.now_ms()
+    store = MemoryStore()
+    store.data["test_store_k1"] = CacheItem(
+        key="test_store_k1",
+        value=TokenBucketItem(
+            status=Status.UNDER_LIMIT, limit=10, duration=60_000,
+            remaining=3, created_at=now - 1_000,
+        ),
+        expire_at=now + 59_000,
+        algorithm=Algorithm.TOKEN_BUCKET,
+    )
+    eng = DecisionEngine(capacity=100, clock=frozen_clock, store=store)
+    r = eng.get_rate_limits([req()])[0]
+    assert store.get_calls == 1
+    assert r.remaining == 2  # 3 persisted - 1 hit
+    assert r.reset_time == now - 1_000 + 60_000
+
+
+def test_store_read_through_leaky(frozen_clock):
+    now = frozen_clock.now_ms()
+    store = MemoryStore()
+    store.data["test_store_lk"] = CacheItem(
+        key="test_store_lk",
+        value=LeakyBucketItem(
+            limit=10, duration=60_000, remaining=5.0, updated_at=now, burst=10,
+        ),
+        expire_at=now + 60_000,
+        algorithm=Algorithm.LEAKY_BUCKET,
+    )
+    eng = DecisionEngine(capacity=100, clock=frozen_clock, store=store)
+    r = eng.get_rate_limits(
+        [req(key="lk", algorithm=Algorithm.LEAKY_BUCKET, burst=10)]
+    )[0]
+    assert r.remaining == 4
+
+
+def test_store_remove_on_reset_remaining(frozen_clock):
+    store = MemoryStore()
+    eng = DecisionEngine(capacity=100, clock=frozen_clock, store=store)
+    eng.get_rate_limits([req(hits=5)])
+    assert store.data["test_store_k1"].value.remaining == 5
+    r = eng.get_rate_limits(
+        [req(hits=0, behavior=Behavior.RESET_REMAINING)]
+    )[0]
+    assert store.remove_calls == 1
+    assert r.remaining == 10
+
+
+def test_loader_round_trip(frozen_clock):
+    """Save at shutdown, restore at startup, bucket continues.
+
+    reference: store_test.go TestLoader:76.
+    """
+    eng1 = DecisionEngine(capacity=100, clock=frozen_clock)
+    eng1.get_rate_limits(
+        [
+            req(key="a", hits=4),
+            req(key="b", hits=2, algorithm=Algorithm.LEAKY_BUCKET, burst=10),
+        ]
+    )
+    loader = MemoryLoader()
+    eng1.save(loader)
+    assert loader.save_calls == 1
+    assert len(loader.items) == 2
+
+    eng2 = DecisionEngine(capacity=100, clock=frozen_clock)
+    assert eng2.load(loader) == 2
+    assert eng2.cache_size() == 2
+    ra = eng2.get_rate_limits([req(key="a", hits=0)])[0]
+    assert ra.remaining == 6  # 10 - 4, continued exactly
+    rb = eng2.get_rate_limits(
+        [req(key="b", hits=0, algorithm=Algorithm.LEAKY_BUCKET, burst=10)]
+    )[0]
+    assert rb.remaining == 8
+
+
+def test_leaky_fraction_survives_loader(frozen_clock):
+    """The leaky sub-integer remainder round-trips bit-exactly through
+    the Loader (fixed-point words are snapshotted, not the int floor)."""
+    eng1 = DecisionEngine(capacity=100, clock=frozen_clock)
+    # limit 3 / duration 1000ms → rate 333.33ms per unit; advancing
+    # 500ms leaks 1.5 units: fraction lands in the bucket state.
+    r = eng1.get_rate_limits(
+        [req(key="f", hits=3, limit=3, duration=1000,
+             algorithm=Algorithm.LEAKY_BUCKET, burst=3)]
+    )[0]
+    assert r.remaining == 0
+    frozen_clock.advance(ms=500)
+    loader = MemoryLoader()
+    eng1.save(loader)
+
+    eng2 = DecisionEngine(capacity=100, clock=frozen_clock)
+    eng2.load(loader)
+    r1 = eng1.get_rate_limits(
+        [req(key="f", hits=1, limit=3, duration=1000,
+             algorithm=Algorithm.LEAKY_BUCKET, burst=3)]
+    )[0]
+    r2 = eng2.get_rate_limits(
+        [req(key="f", hits=1, limit=3, duration=1000,
+             algorithm=Algorithm.LEAKY_BUCKET, burst=3)]
+    )[0]
+    assert (r1.status, r1.remaining, r1.reset_time) == (
+        r2.status, r2.remaining, r2.reset_time,
+    )
+
+
+def test_npz_checkpoint(tmp_path, frozen_clock):
+    path = os.fspath(tmp_path / "ckpt.npz")
+    eng1 = DecisionEngine(capacity=100, clock=frozen_clock)
+    eng1.get_rate_limits([req(key=f"k{i}", hits=i % 5) for i in range(50)])
+    ckpt = NpzFileLoader(path)
+    eng1.save(ckpt)
+    assert os.path.exists(path)
+
+    eng2 = DecisionEngine(capacity=100, clock=frozen_clock)
+    assert eng2.load(ckpt) == 50
+    r = eng2.get_rate_limits([req(key="k4", hits=0)])[0]
+    assert r.remaining == 10 - 4
+
+
+def test_sharded_loader_round_trip(frozen_clock):
+    """Sharded-engine Loader save/restore continues buckets exactly."""
+    from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+
+    eng1 = ShardedDecisionEngine(shard_capacity=64, clock=frozen_clock)
+    eng1.get_rate_limits(
+        [req(key=f"s{i}", hits=i % 4) for i in range(40)]
+        + [
+            req(key=f"l{i}", hits=2, algorithm=Algorithm.LEAKY_BUCKET, burst=10)
+            for i in range(10)
+        ]
+    )
+    loader = MemoryLoader()
+    eng1.save(loader)
+    assert len(loader.items) == 50
+
+    eng2 = ShardedDecisionEngine(shard_capacity=64, clock=frozen_clock)
+    assert eng2.load(loader) == 50
+    assert eng2.cache_size() == 50
+    r = eng2.get_rate_limits([req(key="s3", hits=0)])[0]
+    assert r.remaining == 10 - 3
+    rl = eng2.get_rate_limits(
+        [req(key="l0", hits=0, algorithm=Algorithm.LEAKY_BUCKET, burst=10)]
+    )[0]
+    assert rl.remaining == 8
+
+
+def test_daemon_loader_integration(tmp_path, frozen_clock):
+    """Daemon restores at start and persists at close."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from gubernator_tpu.cluster.harness import test_behaviors
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.client import V1Client
+
+    path = os.fspath(tmp_path / "daemon.npz")
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=test_behaviors(),
+        cache_size=1000,
+        device_count=1,
+    )
+    d1 = spawn_daemon(conf, clock=frozen_clock, loader=NpzFileLoader(path))
+    with V1Client(d1.grpc_address) as c:
+        c.get_rate_limits([req(key="persist", hits=7)], timeout=10)
+    d1.close()
+    assert os.path.exists(path)
+
+    d2 = spawn_daemon(conf, clock=frozen_clock, loader=NpzFileLoader(path))
+    try:
+        with V1Client(d2.grpc_address) as c:
+            r = c.get_rate_limits([req(key="persist", hits=0)], timeout=10)[0]
+            assert r.remaining == 3
+    finally:
+        d2.close()

@@ -1,0 +1,156 @@
+"""TLS subsystem tests.
+
+reference: tls_test.go — SetupTLS variants (:73-233), a full TLS
+cluster with mTLS client auth (:235-289), HTTPS gateway (:291+).
+"""
+
+import json
+import ssl
+import urllib.request
+
+import grpc
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster.harness import test_behaviors
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.net.tls import (
+    TLSConfig,
+    generate_self_ca,
+    generate_server_cert,
+)
+from gubernator_tpu.types import RateLimitReq
+
+
+def test_generate_self_ca_and_cert():
+    ca, ca_key = generate_self_ca()
+    assert b"BEGIN CERTIFICATE" in ca
+    assert b"PRIVATE KEY" in ca_key
+    cert, key = generate_server_cert(ca, ca_key, ["example.test"])
+    assert b"BEGIN CERTIFICATE" in cert
+    # The cert chains to the CA.
+    from cryptography import x509
+
+    ca_obj = x509.load_pem_x509_certificate(ca)
+    crt = x509.load_pem_x509_certificate(cert)
+    assert crt.issuer == ca_obj.subject
+    sans = crt.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName
+    ).value
+    assert "example.test" in sans.get_values_for_type(x509.DNSName)
+
+
+def test_setup_auto_tls():
+    bundle = TLSConfig(auto_tls=True).setup()
+    assert bundle.ca_pem and bundle.server_cert_pem and bundle.server_key_pem
+    assert bundle.server_credentials() is not None
+    assert bundle.client_credentials() is not None
+
+
+def test_setup_requires_material():
+    with pytest.raises(ValueError):
+        TLSConfig().setup()
+
+
+@pytest.fixture(scope="module")
+def tls_daemon():
+    """A daemon serving gRPC+HTTPS with AutoTLS (reference:
+    tls_test.go:235 TestSetupTLSWithCluster analog, single node)."""
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=test_behaviors(),
+        cache_size=1000,
+        device_count=1,
+        tls=TLSConfig(auto_tls=True, auto_tls_hosts=["127.0.0.1"]),
+    )
+    d = spawn_daemon(conf)
+    yield d
+    d.close()
+
+
+def test_tls_grpc_round_trip(tls_daemon):
+    creds = tls_daemon._tls_bundle.client_credentials()
+    with V1Client(tls_daemon.grpc_address, credentials=creds) as c:
+        r = c.get_rate_limits(
+            [RateLimitReq(name="tls", unique_key="k", hits=1, limit=5, duration=60_000)],
+            timeout=10,
+        )[0]
+        assert r.error == "" and r.remaining == 4
+
+
+def test_tls_grpc_rejects_plaintext(tls_daemon):
+    with V1Client(tls_daemon.grpc_address) as c:  # no credentials
+        with pytest.raises(grpc.RpcError):
+            c.health_check(timeout=3)
+
+
+def test_https_gateway(tls_daemon):
+    ctx = ssl.create_default_context()
+    ctx.load_verify_locations(
+        cadata=tls_daemon._tls_bundle.ca_pem.decode()
+    )
+    ctx.check_hostname = False
+    body = urllib.request.urlopen(
+        f"https://{tls_daemon.http_address}/v1/HealthCheck",
+        context=ctx,
+        timeout=5,
+    ).read()
+    assert json.loads(body)["status"] == "healthy"
+
+
+def test_mtls_cluster():
+    """Two daemons with required client auth forward between each
+    other over mTLS (reference: tls_test.go:235-289)."""
+    ca, ca_key = generate_self_ca()
+    server_cert, server_key = generate_server_cert(ca, ca_key, ["127.0.0.1"])
+    client_cert, client_key = generate_server_cert(ca, ca_key, ["127.0.0.1"])
+
+    def conf():
+        return DaemonConfig(
+            grpc_listen_address="127.0.0.1:0",
+            http_listen_address="127.0.0.1:0",
+            behaviors=test_behaviors(),
+            cache_size=1000,
+            device_count=1,
+            tls=TLSConfig(
+                ca_pem=ca,
+                cert_pem=server_cert,
+                key_pem=server_key,
+                client_auth="require-and-verify",
+                client_auth_cert_pem=client_cert,
+                client_auth_key_pem=client_key,
+            ),
+        )
+
+    d1 = spawn_daemon(conf())
+    d2 = spawn_daemon(conf())
+    try:
+        peers = [d1.peer_info(), d2.peer_info()]
+        d1.set_peers(peers)
+        d2.set_peers(peers)
+
+        # Find a key owned by d2, ask d1 → peer-to-peer mTLS forward.
+        from gubernator_tpu.client import random_string
+
+        for i in range(200):
+            req = RateLimitReq(
+                name="mtls",
+                unique_key=random_string(prefix=f"k{i}_"),
+                hits=1,
+                limit=5,
+                duration=60_000,
+            )
+            owner = d1.instance.get_peer(req.hash_key())
+            if not owner.info.is_owner:
+                break
+        assert not owner.info.is_owner
+        creds = d1._tls_bundle.client_credentials()
+        with V1Client(d1.grpc_address, credentials=creds) as c:
+            r = c.get_rate_limits([req], timeout=10)[0]
+            assert r.error == "" and r.remaining == 4
+            assert r.metadata.get("owner") == d2.peer_info().grpc_address
+    finally:
+        d1.close()
+        d2.close()
