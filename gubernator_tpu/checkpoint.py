@@ -64,8 +64,15 @@ class NpzFileLoader:
                 remaining_i.append(0)
                 remaining_f.append(v.remaining)
                 # Exact 32.32 words when present — the float64 mirror
-                # rounds once whole parts exceed 2^21.
-                w = v.remaining_words or (0, 0)
+                # rounds once whole parts exceed 2^21.  Items built from
+                # the float field only derive their words from it.
+                from gubernator_tpu.store import words_from_float
+
+                w = (
+                    v.remaining_words
+                    if v.remaining_words is not None
+                    else words_from_float(v.remaining)
+                )
                 remf_hi.append(w[0])
                 remf_lo.append(w[1])
                 duration.append(v.duration)

@@ -74,8 +74,9 @@ def main(argv=None) -> int:
                     for r in resps:
                         if r.status == Status.OVER_LIMIT:
                             stats["over"] += 1
-                        if r.error and not args.quiet:
-                            print("error:", r.error, file=sys.stderr)
+                        if r.error:
+                            if not args.quiet:
+                                print("error:", r.error, file=sys.stderr)
                             stats["errors"] += 1
                 if interval:
                     time.sleep(interval)

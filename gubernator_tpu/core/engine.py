@@ -357,15 +357,15 @@ class DecisionEngine:
             elif isinstance(v, LeakyBucketItem):
                 rec["algo"][lane] = int(Algorithm.LEAKY_BUCKET)
                 rec["limit"][lane] = v.limit
-                if v.remaining_words is not None:
-                    rec["remf_hi"][lane] = v.remaining_words[0]
-                    rec["remf_lo"][lane] = np.uint32(v.remaining_words[1])
-                else:
-                    whole = np.floor(v.remaining)
-                    rec["remf_hi"][lane] = int(whole)
-                    rec["remf_lo"][lane] = np.uint32(
-                        min((v.remaining - whole) * (2.0**32), 2.0**32 - 1)
-                    )
+                from gubernator_tpu.store import words_from_float
+
+                w = (
+                    v.remaining_words
+                    if v.remaining_words is not None
+                    else words_from_float(v.remaining)
+                )
+                rec["remf_hi"][lane] = w[0]
+                rec["remf_lo"][lane] = np.uint32(w[1])
                 rec["duration"][lane] = v.duration
                 rec["t0"][lane] = v.updated_at
                 rec["burst"][lane] = v.burst
@@ -782,32 +782,23 @@ class DecisionEngine:
             invalid = c64(s.invalid_hi, s.invalid_lo)
             slots = np.nonzero(occ)[0]
             keys = [self.table.key_for_slot(int(sl)) for sl in slots]
+        from gubernator_tpu.store import item_from_record
+
         for sl, key in zip(slots, keys):
             if key is None:
                 continue
-            if algo[sl] == int(Algorithm.TOKEN_BUCKET):
-                value = TokenBucketItem(
-                    status=int(status[sl]),
-                    limit=int(limit[sl]),
-                    duration=int(duration[sl]),
-                    remaining=int(remaining[sl]),
-                    created_at=int(t0[sl]),
-                )
-            else:
-                value = LeakyBucketItem(
-                    limit=int(limit[sl]),
-                    duration=int(duration[sl]),
-                    remaining=float(remf_hi[sl]) + float(remf_lo[sl]) * 2.0**-32,
-                    updated_at=int(t0[sl]),
-                    burst=int(burst[sl]),
-                    # Exact words: the float mirror rounds at ≥2^21.
-                    remaining_words=(int(remf_hi[sl]), int(remf_lo[sl])),
-                )
-            yield CacheItem(
+            yield item_from_record(
                 key=key,
-                value=value,
-                expire_at=int(expire[sl]),
                 algorithm=int(algo[sl]),
+                status=int(status[sl]),
+                limit=int(limit[sl]),
+                remaining=int(remaining[sl]),
+                remf_hi=int(remf_hi[sl]),
+                remf_lo=int(remf_lo[sl]),
+                duration=int(duration[sl]),
+                t0=int(t0[sl]),
+                expire_at=int(expire[sl]),
+                burst=int(burst[sl]),
                 invalid_at=int(invalid[sl]),
             )
 
@@ -828,6 +819,9 @@ class DecisionEngine:
             self.table.hits,
             self.table.misses,
         )
+        # Warmup traffic must not reach a write-through Store (it would
+        # persist junk __warmup__ keys and pay external round-trips).
+        saved_store, self.store = self.store, None
         now = self.clock.now_ms()
         width = 64
         while width <= max_width:
@@ -870,6 +864,7 @@ class DecisionEngine:
             )
         else:
             self.table.hits, self.table.misses = saved_hits, saved_misses
+        self.store = saved_store
 
     def cache_size(self) -> int:
         return len(self.table)

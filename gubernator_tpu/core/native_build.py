@@ -34,10 +34,11 @@ def ensure_built() -> Optional[Path]:
         return so
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     tmp = so.with_suffix(".so.tmp")
+    # No -march=native: the .so is cached on disk and a copy built on a
+    # newer CPU would SIGILL elsewhere (ctypes can't catch signals).
     cmd = [
         "g++",
         "-O3",
-        "-march=native",
         "-std=c++17",
         "-shared",
         "-fPIC",

@@ -12,8 +12,12 @@ it bumps its own heartbeat and sends its full map (JSON, one datagram)
 to `fanout` random members plus any configured seed.  `last_seen` only
 refreshes when a member's (incarnation, heartbeat) RISES — second-hand
 gossip cannot keep a dead member alive — so members whose heartbeat
-stalls for `suspect_after` are dropped.  Incarnations (startup
-timestamps) resolve restarts: the higher incarnation wins.  Full-map gossip converges in
+stalls for `suspect_after` are dropped.  A drop leaves a *death
+certificate* (tombstone at the dead incarnation) that is itself
+gossiped; without it, peers that haven't expired the member yet would
+re-introduce it and the pool would oscillate.  Incarnations (startup
+timestamps) resolve restarts: a restarted node's fresh incarnation
+exceeds its tombstone and rejoins cleanly.  Full-map gossip converges in
 O(log N) rounds and a datagram holds ~hundreds of members — the
 intended deployment sizes for the host tier (the data plane scales via
 the device mesh, not host count).
@@ -73,6 +77,9 @@ class MemberListPool(DiscoveryBase):
         self.incarnation = time.time_ns()
         self.heartbeat = 0
         self._members: Dict[str, _Member] = {}
+        # Death certificates: addr -> (incarnation, recorded_at).
+        self._dead: Dict[str, Tuple[int, float]] = {}
+        self._dead_ttl = max(suspect_after * 4, 30.0)
         self._lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._recv_loop, name="guber-gossip-rx", daemon=True),
@@ -107,6 +114,18 @@ class MemberListPool(DiscoveryBase):
             "http": me.http_address,
             "dc": me.datacenter,
         }
+        now = time.monotonic()
+        with self._lock:
+            for addr, (inc, recorded_at) in self._dead.items():
+                if addr not in out:
+                    # Certificates carry their age so every node's TTL
+                    # clock agrees and retirement converges cluster-wide
+                    # (re-learning a cert must not reset its age).
+                    out[addr] = {
+                        "inc": inc,
+                        "dead": True,
+                        "age": round(now - recorded_at, 3),
+                    }
         return out
 
     def _merge(self, payload: Dict[str, dict]) -> bool:
@@ -115,10 +134,35 @@ class MemberListPool(DiscoveryBase):
         now = time.monotonic()
         with self._lock:
             for addr, meta in payload.items():
+                inc = int(meta.get("inc", 0))
                 if addr == self.gossip_address:
+                    # Refutation (the SWIM alive-message analog): if the
+                    # cluster certified US dead (e.g. after a long GC
+                    # pause), adopt a fresh incarnation — it exceeds the
+                    # tombstone, so the next gossip round rejoins us.
+                    if meta.get("dead") and inc >= self.incarnation:
+                        self.incarnation = time.time_ns()
+                        self.heartbeat = 0
                     continue
                 cur = self._members.get(addr)
-                inc = int(meta.get("inc", 0))
+                if meta.get("dead"):
+                    # Death certificate: kills any entry at or below
+                    # the certified incarnation.
+                    if cur is not None and cur.incarnation <= inc:
+                        del self._members[addr]
+                        changed = True
+                    recorded_at = now - float(meta.get("age", 0.0))
+                    prev = self._dead.get(addr)
+                    if prev is None or prev[0] < inc:
+                        self._dead[addr] = (inc, recorded_at)
+                    elif prev[0] == inc and recorded_at < prev[1]:
+                        # Same certificate, older clock — keep the older
+                        # age so TTL retirement converges.
+                        self._dead[addr] = (inc, recorded_at)
+                    continue
+                tomb = self._dead.get(addr)
+                if tomb is not None and inc <= tomb[0]:
+                    continue  # certified dead at this incarnation
                 hb = int(meta.get("hb", 0))
                 if cur is None or (inc, hb) > (cur.incarnation, cur.heartbeat):
                     self._members[addr] = _Member(
@@ -135,11 +179,20 @@ class MemberListPool(DiscoveryBase):
         return changed
 
     def _expire(self) -> bool:
-        cutoff = time.monotonic() - self.suspect_after
+        now = time.monotonic()
+        cutoff = now - self.suspect_after
         with self._lock:
             dead = [a for a, m in self._members.items() if m.last_seen < cutoff]
             for a in dead:
+                self._dead[a] = (self._members[a].incarnation, now)
                 del self._members[a]
+            # Retire old certificates so the map stays bounded.
+            for a in [
+                a
+                for a, (_, t) in self._dead.items()
+                if t < now - self._dead_ttl
+            ]:
+                del self._dead[a]
         return bool(dead)
 
     def _push_peers(self) -> None:

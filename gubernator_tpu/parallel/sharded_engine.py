@@ -486,15 +486,15 @@ class ShardedDecisionEngine:
                 elif isinstance(v, LeakyBucketItem):
                     host["status"][sh, slot] = 0
                     put64("remaining", 0)
-                    if v.remaining_words is not None:
-                        host["remf_hi"][sh, slot] = v.remaining_words[0]
-                        host["remf_lo"][sh, slot] = np.uint32(v.remaining_words[1])
-                    else:
-                        whole = np.floor(v.remaining)
-                        host["remf_hi"][sh, slot] = int(whole)
-                        host["remf_lo"][sh, slot] = np.uint32(
-                            min((v.remaining - whole) * (2.0**32), 2.0**32 - 1)
-                        )
+                    from gubernator_tpu.store import words_from_float
+
+                    w = (
+                        v.remaining_words
+                        if v.remaining_words is not None
+                        else words_from_float(v.remaining)
+                    )
+                    host["remf_hi"][sh, slot] = w[0]
+                    host["remf_lo"][sh, slot] = np.uint32(w[1])
                     put64("t0", v.updated_at)
                     put64("burst", v.burst)
                 count += 1
@@ -536,32 +536,23 @@ class ShardedDecisionEngine:
                 (sh, int(sl), self.tables[sh].key_for_slot(int(sl)))
                 for sh, sl in zip(*np.nonzero(occ))
             ]
+        from gubernator_tpu.store import item_from_record
+
         for sh, sl, key in located:
             if key is None:
                 continue
-            if algo[sh, sl] == int(Algorithm.TOKEN_BUCKET):
-                value = TokenBucketItem(
-                    status=int(status[sh, sl]),
-                    limit=int(limit[sh, sl]),
-                    duration=int(duration[sh, sl]),
-                    remaining=int(remaining[sh, sl]),
-                    created_at=int(t0[sh, sl]),
-                )
-            else:
-                value = LeakyBucketItem(
-                    limit=int(limit[sh, sl]),
-                    duration=int(duration[sh, sl]),
-                    remaining=float(remf_hi[sh, sl])
-                    + float(remf_lo[sh, sl]) * 2.0**-32,
-                    updated_at=int(t0[sh, sl]),
-                    burst=int(burst[sh, sl]),
-                    remaining_words=(int(remf_hi[sh, sl]), int(remf_lo[sh, sl])),
-                )
-            yield CacheItem(
+            yield item_from_record(
                 key=key,
-                value=value,
-                expire_at=int(expire[sh, sl]),
                 algorithm=int(algo[sh, sl]),
+                status=int(status[sh, sl]),
+                limit=int(limit[sh, sl]),
+                remaining=int(remaining[sh, sl]),
+                remf_hi=int(remf_hi[sh, sl]),
+                remf_lo=int(remf_lo[sh, sl]),
+                duration=int(duration[sh, sl]),
+                t0=int(t0[sh, sl]),
+                expire_at=int(expire[sh, sl]),
+                burst=int(burst[sh, sl]),
                 invalid_at=int(invalid[sh, sl]),
             )
 

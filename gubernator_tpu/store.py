@@ -66,6 +66,59 @@ class CacheItem:
     invalid_at: int = 0
 
 
+def words_from_float(v: float) -> tuple:
+    """float remaining → exact-as-possible 32.32 fixed-point words."""
+    import math
+
+    whole = math.floor(v)
+    frac = min((v - whole) * (2.0**32), 2.0**32 - 1)
+    return (int(whole), int(frac))
+
+
+def item_from_record(
+    key: str,
+    algorithm: int,
+    status: int,
+    limit: int,
+    remaining: int,
+    remf_hi: int,
+    remf_lo: int,
+    duration: int,
+    t0: int,
+    expire_at: int,
+    burst: int,
+    invalid_at: int,
+) -> CacheItem:
+    """Build a CacheItem from raw engine-state words — the ONE place
+    that knows how snapshot columns map onto bucket value structs
+    (used by both engines' export_items)."""
+    if algorithm == int(Algorithm.TOKEN_BUCKET):
+        value: Union[TokenBucketItem, LeakyBucketItem] = TokenBucketItem(
+            status=status,
+            limit=limit,
+            duration=duration,
+            remaining=remaining,
+            created_at=t0,
+        )
+    else:
+        value = LeakyBucketItem(
+            limit=limit,
+            duration=duration,
+            # Float mirror rounds at whole ≥ 2^21; words are exact.
+            remaining=float(remf_hi) + float(remf_lo) * 2.0**-32,
+            updated_at=t0,
+            burst=burst,
+            remaining_words=(remf_hi, remf_lo),
+        )
+    return CacheItem(
+        key=key,
+        value=value,
+        expire_at=expire_at,
+        algorithm=algorithm,
+        invalid_at=invalid_at,
+    )
+
+
 class Store(Protocol):
     """Write-through hooks, called by the engine per touched key.
 

@@ -68,10 +68,29 @@ def test_memberlist_gossip_convergence():
             assert rs[0].error == ""
             assert rs[0].remaining == 4
 
-        # Kill one daemon; the survivors drop it from membership.
+        # Kill one daemon; the survivors drop it from membership (death
+        # certificates prevent second-hand gossip resurrecting it).
         daemons[2].close()
         assert _until(
-            lambda: daemons[0].instance.local_picker.size() == 2, timeout=15
+            lambda: all(
+                d.instance.local_picker.size() == 2 for d in daemons[:2]
+            ),
+            timeout=20,
+        )
+        # ...and it STAYS dropped (no resurrection oscillation).
+        import time as _time
+
+        _time.sleep(3)
+        assert all(d.instance.local_picker.size() == 2 for d in daemons[:2])
+
+        # A new daemon (fresh incarnation) still joins cleanly.
+        daemons.append(spawn_daemon(_daemon_conf([seed])))
+        assert _until(
+            lambda: all(
+                d.instance.local_picker.size() == 3
+                for d in (daemons[0], daemons[1], daemons[3])
+            ),
+            timeout=20,
         )
     finally:
         for d in daemons:
