@@ -20,11 +20,14 @@ import time
 
 BASELINE_DECISIONS_PER_SEC = 2000.0  # reference README.md:97-100
 
-BATCH = 8192
-N_KEYS = 100_000
+import os
+
+BATCH = int(os.environ.get("BENCH_BATCH", 8192))
+N_KEYS = int(os.environ.get("BENCH_KEYS", 100_000))
 CAPACITY = 1 << 17  # 131072 slots
 WARMUP_BATCHES = 3
-MEASURE_SECONDS = 5.0
+MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", 5.0))
+PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE", 3))
 
 
 def main() -> None:
@@ -33,7 +36,7 @@ def main() -> None:
     from gubernator_tpu import Algorithm
     from gubernator_tpu.core.engine import DecisionEngine
 
-    engine = DecisionEngine(capacity=CAPACITY)
+    engine = DecisionEngine(capacity=CAPACITY, max_kernel_width=max(8192, BATCH))
 
     # Pre-build columnar batches (client-side cost, not engine cost) —
     # the engine's native request format (DecisionEngine.apply_columnar);
@@ -64,16 +67,29 @@ def main() -> None:
     for i in range(WARMUP_BATCHES):
         engine.apply_columnar(**batches[i % len(batches)])
 
+    # Pipelined: keep a few batches in flight so device→host readback
+    # of batch i overlaps dispatch of batch i+1 (PendingColumnar).
+    from collections import deque
+
+    pending = deque()
     n_done = 0
     start = time.perf_counter()
     i = 0
     while True:
-        engine.apply_columnar(**batches[i % len(batches)])
-        n_done += BATCH
+        pending.append(
+            engine.apply_columnar(**batches[i % len(batches)], want_async=True)
+        )
         i += 1
+        if len(pending) > PIPELINE_DEPTH:
+            pending.popleft().get()
+            n_done += BATCH
         elapsed = time.perf_counter() - start
         if elapsed >= MEASURE_SECONDS:
             break
+    while pending:
+        pending.popleft().get()
+        n_done += BATCH
+    elapsed = time.perf_counter() - start
 
     rate = n_done / elapsed
     print(

@@ -33,7 +33,9 @@ def main(argv=None) -> int:
 
     from gubernator_tpu.config import setup_daemon_config
     from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.utils.tracing import init_tracing, shutdown_tracing
 
+    init_tracing()
     conf = setup_daemon_config(args.config or None)
     daemon = spawn_daemon(conf)
     log = logging.getLogger("gubernator_tpu")
@@ -54,6 +56,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _shutdown)
     stop.wait()
     daemon.close()
+    shutdown_tracing()
     return 0
 
 

@@ -106,10 +106,22 @@ class PendingColumnar:
         o_remaining = np.empty(n, dtype=_I64)
         o_reset = np.empty(n, dtype=_I64)
         for packed, dst_idx, m, size in self._pieces:
-            arr = np.asarray(packed)  # one transfer: [3*size] int64
-            o_status[dst_idx] = arr[:m]
-            o_remaining[dst_idx] = arr[size : size + m]
-            o_reset[dst_idx] = arr[2 * size : 2 * size + m]
+            arr = np.asarray(packed)  # one transfer per piece
+            if isinstance(dst_idx, list):
+                # Sharded piece: arr is [n_shards, 3*size]; dst_idx/m
+                # are per-shard request-index rows / lane counts.
+                for sh, idxs in enumerate(dst_idx):
+                    mm = m[sh]
+                    if mm == 0:
+                        continue
+                    row = arr[sh]
+                    o_status[idxs] = row[:mm]
+                    o_remaining[idxs] = row[size : size + mm]
+                    o_reset[idxs] = row[2 * size : 2 * size + mm]
+            else:
+                o_status[dst_idx] = arr[:m]
+                o_remaining[dst_idx] = arr[size : size + m]
+                o_reset[dst_idx] = arr[2 * size : 2 * size + m]
         over = int(np.sum(o_status == int(Status.OVER_LIMIT)))
         with self._engine._lock:
             # Counted at materialization; a dropped PendingColumnar

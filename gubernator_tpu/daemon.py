@@ -122,12 +122,18 @@ class Daemon:
         )
         self.instance = V1Instance(service_conf, engine)
         self.registry = build_registry(self.instance)
+        # gRPC request counts/durations (reference: grpc_stats.go).
+        from gubernator_tpu.utils.grpc_stats import GrpcStats
+
+        grpc_stats = GrpcStats()
+        self.registry.register(grpc_stats)
 
         # gRPC server (both services on one listener; the reference's
         # second loopback server exists only for grpc-gateway's dial,
         # which our native gateway doesn't need).
         self.grpc_server = grpc.server(
             ThreadPoolExecutor(max_workers=32, thread_name_prefix="guber-grpc"),
+            interceptors=[grpc_stats],
             options=[
                 ("grpc.max_receive_message_length", 1024 * 1024),  # daemon.go:103
                 ("grpc.max_connection_age_ms", 120_000),  # daemon.go:110-115

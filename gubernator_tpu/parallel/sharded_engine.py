@@ -31,14 +31,15 @@ from gubernator_tpu.gregorian import (
     gregorian_duration,
     gregorian_expiration,
 )
-from gubernator_tpu.hashing import fnv1a_64
+from gubernator_tpu.hashing import fnv1a_64, fnv1a_64_batch, pack_keys
 from gubernator_tpu.ops.bucket_kernel import (
     BatchInput,
     BucketState,
     _apply_batch_impl,
+    _apply_core,
     make_state,
 )
-from gubernator_tpu.core.interning import InternTable
+from gubernator_tpu.core.native import make_intern_table
 from gubernator_tpu.parallel.mesh import KEYS_AXIS, keys_sharding, make_mesh
 from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
 
@@ -81,7 +82,10 @@ class ShardedDecisionEngine:
         self.capacity = shard_capacity * self.n_shards
         self.clock = clock
         self.max_kernel_width = max_kernel_width
-        self.tables = [InternTable(shard_capacity) for _ in range(self.n_shards)]
+        # Native C++ tables when buildable (batch schedule fast path).
+        self.tables = [
+            make_intern_table(shard_capacity) for _ in range(self.n_shards)
+        ]
         self._lock = threading.Lock()
         self.requests_total = 0
         self.over_limit_total = 0
@@ -148,12 +152,67 @@ class ShardedDecisionEngine:
                 out_specs=pspec,
             )
         )
+
+        def local_sorted(state, batch, now):
+            # Sort-free columnar step: host presorted each shard's lanes
+            # by slot; outputs packed [3*width] per shard so the host
+            # pays one readback for the whole mesh step.
+            state1 = _squeeze(state)
+            batch1 = _squeeze(batch)
+            new_state, st, rem, rst = _apply_core(
+                state1,
+                state1.occupied,
+                batch1.slot,
+                batch1.algo,
+                batch1.behavior,
+                batch1.hits,
+                batch1.limit,
+                batch1.duration,
+                batch1.burst,
+                batch1.greg_duration,
+                batch1.greg_expire,
+                now.astype(jnp.int64),
+            )
+            packed = jnp.concatenate([st.astype(jnp.int64), rem, rst])
+            return _expand(new_state), packed[None]
+
+        state_specs2 = jax.tree.map(lambda _: pspec, make_state(0))
+        batch_specs2 = jax.tree.map(
+            lambda _: pspec, BatchInput(*(0,) * len(BatchInput._fields))
+        )
+        self._step_sorted = jax.jit(
+            jax.shard_map(
+                local_sorted,
+                mesh=mesh,
+                in_specs=(state_specs2, batch_specs2, P()),
+                out_specs=(state_specs2, pspec),
+            ),
+            donate_argnums=(0,),
+        )
         return jax.jit(stepped, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
 
     def shard_of(self, key: str) -> int:
         return fnv1a_64(key.encode()) % self.n_shards
+
+    def _apply_shard_clears(self, clears: List[List[int]]) -> None:
+        """Eviction clears, one padded [n_shards, csize] scatter.
+        `clears[sh]` lists slots to scrub on shard sh."""
+        n_clear = max((len(c) for c in clears), default=0)
+        if not n_clear:
+            return
+        cap = self.shard_capacity
+        csize = _pad_size(n_clear, floor=16)
+        c = np.tile(
+            np.arange(cap, cap + csize, dtype=_I64).astype(_I32),
+            (self.n_shards, 1),
+        )
+        for sh in range(self.n_shards):
+            c[sh, : len(clears[sh])] = clears[sh]
+        self._state = self._state._replace(
+            occupied=self._clear_step(self._state.occupied, jnp.asarray(c))
+        )
 
     def get_rate_limits(
         self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
@@ -258,17 +317,7 @@ class ShardedDecisionEngine:
 
         # Eviction clears run as a separate sharded scatter (own shape
         # ladder, independent of the apply step's batch width).
-        n_clear = max((len(c) for c in clears), default=0)
-        if n_clear:
-            csize = _pad_size(n_clear, floor=16)
-            c = np.tile(
-                np.arange(cap, cap + csize, dtype=_I64).astype(_I32), (n_sh, 1)
-            )
-            for sh in range(n_sh):
-                c[sh, : len(clears[sh])] = clears[sh]
-            self._state = self._state._replace(
-                occupied=self._clear_step(self._state.occupied, jnp.asarray(c))
-            )
+        self._apply_shard_clears(clears)
         csize = 16
 
         # Padding: distinct ascending out-of-range slots per shard.
@@ -431,7 +480,224 @@ class ShardedDecisionEngine:
             table_stats,
         ) = saved
         for t, (h, m) in zip(self.tables, table_stats):
-            t.hits, t.misses = h, m
+            if hasattr(t, "discount_stats"):
+                # Native tables re-mirror cumulative C++ counters on
+                # every schedule(); register discounts instead of
+                # restoring attributes (see DecisionEngine.warmup).
+                t.discount_stats(t.hits - h, t.misses - m)
+            else:
+                t.hits, t.misses = h, m
+
+    # ------------------------------------------------------------------
+    # Columnar fast path over the mesh — the multi-chip counterpart of
+    # DecisionEngine.apply_columnar: vectorized shard routing (one FNV
+    # pass), per-shard native scheduling, host presort per shard, ONE
+    # shard_map step per round, one packed readback for the whole mesh.
+
+    def apply_columnar(
+        self,
+        keys: List[bytes],
+        algo: np.ndarray,
+        behavior: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        burst: np.ndarray,
+        now_ms: Optional[int] = None,
+        want_async: bool = False,
+    ):
+        n = len(keys)
+        if now_ms is None:
+            now_ms = self.clock.now_ms()
+        greg_mask = (behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+        if greg_mask.any():
+            greg_dur = np.zeros(n, dtype=_I64)
+            greg_exp = np.zeros(n, dtype=_I64)
+            now_dt = dt_from_ms(now_ms)
+            for i in np.nonzero(greg_mask)[0]:
+                greg_dur[i] = gregorian_duration(now_dt, int(duration[i]))
+                greg_exp[i] = gregorian_expiration(now_dt, int(duration[i]))
+        else:
+            greg_dur = np.zeros(n, dtype=_I64)
+            greg_exp = greg_dur
+
+        with self._lock:
+            pending = self._apply_columnar_locked(
+                keys, algo, behavior, hits, limit, duration, burst,
+                greg_dur, greg_exp, greg_mask, now_ms,
+            )
+            self.requests_total += n
+            self.batches_total += 1
+        return pending if want_async else pending.get()
+
+    def _apply_columnar_locked(
+        self, keys, algo, behavior, hits, limit, duration, burst,
+        greg_dur, greg_exp, greg_mask, now_ms,
+    ):
+        n_sh = self.n_shards
+        cap = self.shard_capacity
+        n = len(keys)
+
+        # 1. Vectorized shard routing: one FNV-1a pass over the batch.
+        padded, lengths = pack_keys(keys)
+        shards = (fnv1a_64_batch(padded, lengths) % np.uint64(n_sh)).astype(
+            np.int64
+        )
+
+        # 2. Per-shard native scheduling.
+        shard_idx: List[np.ndarray] = []  # request indices per shard
+        shard_slots: List[np.ndarray] = []
+        shard_rounds: List[np.ndarray] = []
+        clear_by_round: Dict[int, List[List[int]]] = {}
+        max_round = 0
+        for sh in range(n_sh):
+            idx = np.nonzero(shards == sh)[0]
+            shard_idx.append(idx)
+            if len(idx) == 0:
+                shard_slots.append(np.empty(0, dtype=_I32))
+                shard_rounds.append(np.empty(0, dtype=_I32))
+                continue
+            table = self.tables[sh]
+            if hasattr(table, "schedule"):
+                slots, rounds, evicted, evict_rounds = table.schedule(
+                    [keys[i] for i in idx], now_ms
+                )
+            else:
+                slots = np.empty(len(idx), dtype=_I32)
+                rounds = np.empty(len(idx), dtype=_I32)
+                seq: Dict[int, int] = {}
+                ev_list: List[int] = []
+                ev_rounds: List[int] = []
+                for j, i in enumerate(idx):
+                    cleared: List[int] = []
+                    slot = table.intern(keys[i].decode(), now_ms, cleared)
+                    for es in cleared:
+                        ev_list.append(es)
+                        ev_rounds.append(seq.get(es, 0))
+                    k = seq.get(slot, 0)
+                    seq[slot] = k + 1
+                    slots[j] = slot
+                    rounds[j] = k
+                evicted = np.asarray(ev_list, dtype=_I32)
+                evict_rounds = np.asarray(ev_rounds, dtype=_I32)
+            shard_slots.append(slots)
+            shard_rounds.append(rounds)
+            if len(rounds):
+                max_round = max(max_round, int(rounds.max()))
+            for es, k in zip(evicted.tolist(), evict_rounds.tolist()):
+                clear_by_round.setdefault(k, [[] for _ in range(n_sh)])[
+                    sh
+                ].append(es)
+
+        # 3. One mesh step per round (chunked by max_kernel_width).
+        pieces: List[tuple] = []
+        now_dev = jnp.asarray(now_ms, dtype=jnp.int64)
+        for k in range(max_round + 1):
+            members = [
+                shard_idx[sh][shard_rounds[sh] == k] if len(shard_idx[sh]) else shard_idx[sh]
+                for sh in range(n_sh)
+            ]
+            m_slots = [
+                shard_slots[sh][shard_rounds[sh] == k]
+                if len(shard_slots[sh])
+                else shard_slots[sh]
+                for sh in range(n_sh)
+            ]
+            if not any(len(m) for m in members) and k not in clear_by_round:
+                continue
+            clears = clear_by_round.get(k)
+            if clears is not None:
+                self._apply_shard_clears(clears)
+            offset = 0
+            while True:
+                chunk_members = [
+                    m[offset : offset + self.max_kernel_width] for m in members
+                ]
+                chunk_slots = [
+                    s[offset : offset + self.max_kernel_width] for s in m_slots
+                ]
+                if offset > 0 and not any(len(m) for m in chunk_members):
+                    break
+                pieces.append(
+                    self._dispatch_sorted_chunk(
+                        chunk_members, chunk_slots,
+                        algo, behavior, hits, limit, duration, burst,
+                        greg_dur, greg_exp, now_dev,
+                    )
+                )
+                self.rounds_total += 1
+                offset += self.max_kernel_width
+                if all(offset >= len(m) for m in members):
+                    break
+
+        # 4. TTL mirror, per shard.
+        expires = np.where(greg_mask, greg_exp, now_ms + duration).astype(_I64)
+        for sh in range(n_sh):
+            if len(shard_idx[sh]):
+                self.tables[sh].set_expiry(
+                    shard_slots[sh], expires[shard_idx[sh]]
+                )
+
+        from gubernator_tpu.core.engine import PendingColumnar
+
+        return PendingColumnar(self, pieces, limit, n)
+
+    def _dispatch_sorted_chunk(
+        self, members, m_slots, algo, behavior, hits, limit, duration,
+        burst, greg_dur, greg_exp, now_dev,
+    ):
+        """Build one [n_sh, width] presorted batch, dispatch the sorted
+        mesh step, start the async readback.  Returns a PendingColumnar
+        piece: (packed, dst_idx rows, m per shard, width)."""
+        n_sh = self.n_shards
+        cap = self.shard_capacity
+        width = _pad_size(max((len(m) for m in members), default=1))
+
+        b = {
+            name: np.zeros((n_sh, width), dtype=dt)
+            for name, dt in (
+                ("algo", _I32), ("behavior", _I32), ("hits", _I64),
+                ("limit", _I64), ("duration", _I64), ("burst", _I64),
+                ("greg_duration", _I64), ("greg_expire", _I64),
+            )
+        }
+        b_slot = np.tile(
+            np.arange(cap, cap + width, dtype=_I64).astype(_I32), (n_sh, 1)
+        )
+        dst_rows = []
+        for sh in range(n_sh):
+            m = len(members[sh])
+            if m == 0:
+                dst_rows.append(np.empty(0, dtype=np.int64))
+                continue
+            order = np.argsort(m_slots[sh], kind="stable")
+            idx_sorted = members[sh][order]
+            b_slot[sh, :m] = m_slots[sh][order]
+            # Padding must stay ascending beyond the real slots.
+            b["algo"][sh, :m] = algo[idx_sorted]
+            b["behavior"][sh, :m] = behavior[idx_sorted]
+            b["hits"][sh, :m] = hits[idx_sorted]
+            b["limit"][sh, :m] = limit[idx_sorted]
+            b["duration"][sh, :m] = duration[idx_sorted]
+            b["burst"][sh, :m] = burst[idx_sorted]
+            b["greg_duration"][sh, :m] = greg_dur[idx_sorted]
+            b["greg_expire"][sh, :m] = greg_exp[idx_sorted]
+            dst_rows.append(idx_sorted)
+
+        batch = BatchInput(
+            slot=jnp.asarray(b_slot),
+            algo=jnp.asarray(b["algo"]),
+            behavior=jnp.asarray(b["behavior"]),
+            hits=jnp.asarray(b["hits"]),
+            limit=jnp.asarray(b["limit"]),
+            duration=jnp.asarray(b["duration"]),
+            burst=jnp.asarray(b["burst"]),
+            greg_duration=jnp.asarray(b["greg_duration"]),
+            greg_expire=jnp.asarray(b["greg_expire"]),
+        )
+        self._state, packed = self._step_sorted(self._state, batch, now_dev)
+        packed.copy_to_host_async()
+        return (packed, dst_rows, [len(m) for m in members], width)
 
     # ------------------------------------------------------------------
     # Bulk persistence (Loader; reference: store.go:69-78).  Load/save

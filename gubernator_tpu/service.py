@@ -153,6 +153,14 @@ class V1Instance:
         self, requests: Sequence[RateLimitReq]
     ) -> List[RateLimitResp]:
         """reference: gubernator.go:197-317 (GetRateLimits)."""
+        from gubernator_tpu.utils.tracing import span
+
+        with span("V1Instance.get_rate_limits", batch=len(requests)):
+            return self._get_rate_limits(requests)
+
+    def _get_rate_limits(
+        self, requests: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
         if len(requests) > MAX_BATCH_SIZE:
             self.counters["check_errors"] += 1
             raise ServiceError(
@@ -333,12 +341,15 @@ class V1Instance:
         a worker pool with an order-restoring collector; here the whole
         batch is one engine call, order preserved by construction.
         """
+        from gubernator_tpu.utils.tracing import span
+
         if len(requests) > MAX_BATCH_SIZE:
             self.counters["check_errors"] += 1
             raise ServiceError(
                 f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'"
             )
-        return self.apply_local_batch(list(requests))
+        with span("V1Instance.get_peer_rate_limits", batch=len(requests)):
+            return self.apply_local_batch(list(requests))
 
     def update_peer_globals(self, globals_: Sequence[UpdatePeerGlobal]) -> None:
         """Owner-broadcast GLOBAL statuses land in the host status cache.

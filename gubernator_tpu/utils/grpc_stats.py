@@ -1,0 +1,84 @@
+"""gRPC server request metrics.
+
+reference: grpc_stats.go:41-131 — a stats.Handler counting requests and
+observing durations per method, exported as
+`gubernator_grpc_request_counts` / `gubernator_grpc_request_duration`.
+Implemented as a grpc.ServerInterceptor feeding a prometheus Collector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List
+
+import grpc
+from prometheus_client.core import CounterMetricFamily, SummaryMetricFamily
+from prometheus_client.registry import Collector
+
+
+class GrpcStats(Collector, grpc.ServerInterceptor):
+    """Counts + duration sums per gRPC method, with a failed counter."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._failed: Dict[str, int] = {}
+        self._dur_sum: Dict[str, float] = {}
+
+    # -- grpc.ServerInterceptor ---------------------------------------
+
+    def intercept_service(self, continuation, handler_call_details):
+        method = handler_call_details.method
+        handler = continuation(handler_call_details)
+        if handler is None or not handler.unary_unary:
+            return handler
+        inner = handler.unary_unary
+
+        def wrapper(request, context):
+            start = time.perf_counter()
+            ok = True
+            try:
+                return inner(request, context)
+            except Exception:
+                ok = False
+                raise
+            finally:
+                dt = time.perf_counter() - start
+                with self._lock:
+                    self._counts[method] = self._counts.get(method, 0) + 1
+                    self._dur_sum[method] = self._dur_sum.get(method, 0.0) + dt
+                    if not ok or context.code() not in (None, grpc.StatusCode.OK):
+                        self._failed[method] = self._failed.get(method, 0) + 1
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapper,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+    # -- prometheus Collector -----------------------------------------
+
+    def collect(self) -> Iterable:
+        with self._lock:
+            counts = dict(self._counts)
+            failed = dict(self._failed)
+            dur = dict(self._dur_sum)
+        c = CounterMetricFamily(
+            "gubernator_grpc_request_counts",
+            "The count of gRPC requests.",
+            labels=["method", "failed"],
+        )
+        for m, n in counts.items():
+            c.add_metric([m, "0"], n - failed.get(m, 0))
+        for m, n in failed.items():
+            c.add_metric([m, "1"], n)
+        yield c
+        s = SummaryMetricFamily(
+            "gubernator_grpc_request_duration",
+            "Duration of gRPC requests in seconds.",
+            labels=["method"],
+        )
+        for m, total in dur.items():
+            s.add_metric([m], counts.get(m, 0), total)
+        yield s

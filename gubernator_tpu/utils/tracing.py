@@ -1,0 +1,81 @@
+"""OpenTelemetry tracing (optional, env-driven).
+
+reference: the reference weaves holster tracing through every function
+(SURVEY.md §5.1 — e.g. gubernator.go:198-202, algorithms.go:32-36) and
+exports via OTEL_* env configuration (cmd/gubernator/main.go:57-69).
+
+Here tracing is opt-in: `init_tracing()` configures a tracer provider
+when OTEL_EXPORTER_OTLP_ENDPOINT or OTEL_TRACES_EXPORTER is set (and
+the exporter package is importable); otherwise every span helper is a
+cheap no-op — the decision hot path never pays for disabled tracing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+log = logging.getLogger("gubernator_tpu.tracing")
+
+_tracer = None
+_initialized = False
+
+
+def init_tracing(service_name: str = "gubernator_tpu") -> bool:
+    """Configure the global tracer from OTEL_* env; returns whether
+    tracing is active.  reference: cmd/gubernator/main.go:57-69."""
+    global _tracer, _initialized
+    if _initialized:
+        return _tracer is not None
+    _initialized = True
+    want = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT") or os.environ.get(
+        "OTEL_TRACES_EXPORTER"
+    )
+    if not want:
+        return False
+    try:
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+    except ImportError as e:
+        log.warning("tracing requested but exporter unavailable: %s", e)
+        return False
+    provider = TracerProvider(
+        resource=Resource.create({"service.name": service_name})
+    )
+    provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
+    trace.set_tracer_provider(provider)
+    _tracer = trace.get_tracer("gubernator_tpu")
+    log.info("OTel tracing active (service=%s)", service_name)
+    return True
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes) -> Iterator[Optional[object]]:
+    """Start a span when tracing is active, else a no-op context."""
+    if _tracer is None:
+        yield None
+        return
+    with _tracer.start_as_current_span(name) as s:
+        for k, v in attributes.items():
+            s.set_attribute(k, v)
+        yield s
+
+
+def shutdown_tracing() -> None:
+    global _tracer, _initialized
+    if _tracer is not None:
+        try:
+            from opentelemetry import trace
+
+            trace.get_tracer_provider().shutdown()  # type: ignore[attr-defined]
+        except Exception:  # noqa: BLE001
+            log.exception("tracing shutdown failed")
+    _tracer = None
+    _initialized = False

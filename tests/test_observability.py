@@ -1,0 +1,42 @@
+"""Observability: gRPC stats metrics, no-op tracing, metric catalog."""
+
+import urllib.request
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster.harness import ClusterHarness
+from gubernator_tpu.types import RateLimitReq
+from gubernator_tpu.utils.tracing import span
+
+
+def test_span_is_noop_without_init():
+    with span("anything", attr=1) as s:
+        assert s is None
+
+
+def test_grpc_stats_and_metric_catalog():
+    h = ClusterHarness().start(1)
+    try:
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            c.get_rate_limits(
+                [RateLimitReq(name="obs", unique_key="k", hits=1, limit=5, duration=60_000)],
+                timeout=10,
+            )
+            c.health_check(timeout=10)
+        body = urllib.request.urlopen(
+            f"http://{h.daemon_at(0).http_address}/metrics", timeout=5
+        ).read().decode()
+        # gRPC request counters per method (reference: grpc_stats.go).
+        assert 'gubernator_grpc_request_counts_total{failed="0",method="/pb.gubernator.V1/GetRateLimits"}' in body
+        assert "gubernator_grpc_request_duration" in body
+        # Engine/service series (reference: prometheus.md:17-36).
+        for name in (
+            "gubernator_check_counter",
+            "gubernator_over_limit_counter",
+            "gubernator_check_error_counter",
+            "gubernator_getratelimit_counter",
+            "gubernator_cache_size",
+            "gubernator_engine_batches",
+        ):
+            assert name in body, name
+    finally:
+        h.stop()
