@@ -187,6 +187,11 @@ class DaemonConfig:
     # Device-mesh shape for the sharded engine; None = all local devices.
     device_count: Optional[int] = None
 
+    # Period of the device expiry sweep that reclaims slots of expired
+    # buckets (the LRU evicts on pressure regardless; the sweep keeps
+    # cache_size metrics honest and slots recycled).  0 disables.
+    sweep_interval: float = 30.0
+
     metric_flags: List[str] = field(default_factory=list)
 
 
@@ -275,6 +280,7 @@ def setup_daemon_config(
         etcd_key_prefix=_env(d, "GUBER_ETCD_KEY_PREFIX", "/gubernator/peers/"),
         tls=tls,
         device_count=device_count,
+        sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),
         metric_flags=[
             f.strip()
             for f in _env(d, "GUBER_METRIC_FLAGS", "").split(",")

@@ -189,6 +189,24 @@ class Daemon:
 
         self._start_discovery()
 
+        # Periodic device expiry sweep reclaiming slots of expired
+        # buckets (the reference's cache drops expired items on read,
+        # lrucache.go:112-138; device-resident state needs an explicit
+        # sweep kernel — SURVEY.md §7.3 item 6).
+        if self.conf.sweep_interval > 0:
+            self._sweep_stop = threading.Event()
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="guber-sweep", daemon=True
+            )
+            self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while not self._sweep_stop.wait(self.conf.sweep_interval):
+            try:
+                self.instance.engine.sweep()
+            except Exception:  # noqa: BLE001 — sweeping must not die
+                log.exception("expiry sweep failed")
+
     @staticmethod
     def _warmup(engine) -> None:
         """Pay the kernel jit compiles before serving, not on the first
@@ -274,6 +292,8 @@ class Daemon:
         if self._closed:
             return
         self._closed = True
+        if getattr(self, "_sweep_stop", None) is not None:
+            self._sweep_stop.set()
         if self._discovery is not None:
             self._discovery.close()
         if self.gateway is not None:

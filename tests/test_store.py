@@ -168,6 +168,38 @@ def test_npz_checkpoint(tmp_path, frozen_clock):
     assert r.remaining == 10 - 4
 
 
+def test_daemon_periodic_sweep(frozen_clock):
+    """The daemon's background sweeper reclaims expired slots."""
+    import time
+
+    from gubernator_tpu.cluster.harness import test_behaviors
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=test_behaviors(),
+        cache_size=1000,
+        device_count=1,
+        sweep_interval=0.2,
+    )
+    d = spawn_daemon(conf, clock=frozen_clock)
+    try:
+        eng = d.instance.engine
+        eng.get_rate_limits(
+            [req(key=f"sw{i}", hits=1, duration=1_000) for i in range(20)]
+        )
+        assert eng.cache_size() == 20
+        frozen_clock.advance(ms=2_000)  # all buckets expire
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and eng.cache_size() > 0:
+            time.sleep(0.1)
+        assert eng.cache_size() == 0
+    finally:
+        d.close()
+
+
 def test_sharded_loader_round_trip(frozen_clock):
     """Sharded-engine Loader save/restore continues buckets exactly."""
     from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
