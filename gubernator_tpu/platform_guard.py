@@ -1,0 +1,43 @@
+"""Force the CPU platform in environments that pre-register a TPU backend.
+
+The build/CI environment force-selects a TPU PJRT plugin via
+`sitecustomize` (`JAX_PLATFORMS=axon`) that can be wedged: round 1's
+driver artifacts recorded both an init error and an init hang from it.
+Merely setting the `JAX_PLATFORMS` env var does NOT override the
+registration — `jax.config.update("jax_platforms", "cpu")` after import
+does.  This helper is the single shared defense used by
+`tests/conftest.py`, `__graft_entry__.dryrun_multichip`, and
+`bench.py`'s CPU fallback; keep the logic here so it cannot drift.
+
+Must be called before any jax backend initializes (first array op /
+`jax.devices()`): `XLA_FLAGS` is read at backend-init time, and the
+platform switch cannot evict an already-initialized backend.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Pin jax to the CPU platform, with ≥ `n_devices` virtual devices.
+
+    Safe to call repeatedly; raises the virtual device count to the max
+    ever requested (a pre-existing smaller count in `XLA_FLAGS` is
+    rewritten, not trusted)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+        if m is None:
+            flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+        elif int(m.group(1)) < n_devices:
+            flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={n_devices}")
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

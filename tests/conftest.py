@@ -5,20 +5,12 @@ virtual CPU mesh exactly as SURVEY.md prescribes.  Must run before the
 first jax import (hence module level, and conftest loads before test
 modules)."""
 
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax
-
 # The environment's sitecustomize may have force-registered a TPU
-# backend before conftest ran; the config update wins over it.
-jax.config.update("jax_platforms", "cpu")
+# backend before conftest ran; the shared guard's config update wins
+# over it and pins ≥8 virtual CPU devices.
+from gubernator_tpu.platform_guard import force_cpu_platform
+
+force_cpu_platform(8)
 
 import pytest
 
