@@ -40,6 +40,9 @@ WARMUP_BATCHES = 3
 MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", 5.0))
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE", 3))
 LATENCY_BATCHES = int(os.environ.get("BENCH_LATENCY_BATCHES", 200))
+# "engine" (headline: columnar engine path) | "wire" (loopback gRPC
+# through a real daemon — VERDICT r1 item 2's served-path evidence).
+MODE = os.environ.get("BENCH_MODE", "engine")
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180.0))
 # Whole-run deadline: if the backend wedges AFTER a healthy probe (it
 # happened transiently in round 1), a watchdog emits the JSON line and
@@ -138,87 +141,10 @@ def main() -> int:
 
             force_cpu_platform()
 
-        from gubernator_tpu import Algorithm
-        from gubernator_tpu.core.engine import DecisionEngine
-
-        engine = DecisionEngine(capacity=CAPACITY, max_kernel_width=max(8192, BATCH))
-
-        # Pre-build columnar batches (client-side cost, not engine cost) —
-        # the engine's native request format (DecisionEngine.apply_columnar);
-        # the dataclass/gRPC tier sits above this.
-        batches = []
-        for b in range((N_KEYS + BATCH - 1) // BATCH):
-            keys = [b"bench_k%d" % ((b * BATCH + i) % N_KEYS) for i in range(BATCH)]
-            algo = np.fromiter(
-                (
-                    int(Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET)
-                    for i in range(BATCH)
-                ),
-                dtype=np.int32,
-                count=BATCH,
-            )
-            batches.append(
-                dict(
-                    keys=keys,
-                    algo=algo,
-                    behavior=np.zeros(BATCH, dtype=np.int32),
-                    hits=np.ones(BATCH, dtype=np.int64),
-                    limit=np.full(BATCH, 1_000_000, dtype=np.int64),
-                    duration=np.full(BATCH, 3_600_000, dtype=np.int64),
-                    burst=np.full(BATCH, 1_000_000, dtype=np.int64),
-                )
-            )
-
-        for i in range(WARMUP_BATCHES):
-            engine.apply_columnar(**batches[i % len(batches)])
-
-        # Latency: synchronous dispatch→readback per batch (what one
-        # 500µs serving window pays end to end).  Target: p99 < 2ms
-        # (BASELINE.md).
-        lat = np.empty(LATENCY_BATCHES, dtype=np.float64)
-        for i in range(LATENCY_BATCHES):
-            t0 = time.perf_counter()
-            engine.apply_columnar(**batches[i % len(batches)])
-            lat[i] = time.perf_counter() - t0
-        p50_ms = float(np.percentile(lat, 50) * 1e3)
-        p99_ms = float(np.percentile(lat, 99) * 1e3)
-
-        # Throughput: pipelined — keep a few batches in flight so
-        # device→host readback of batch i overlaps dispatch of batch
-        # i+1 (PendingColumnar).
-        from collections import deque
-
-        pending = deque()
-        n_done = 0
-        start = time.perf_counter()
-        i = 0
-        while True:
-            pending.append(
-                engine.apply_columnar(**batches[i % len(batches)], want_async=True)
-            )
-            i += 1
-            if len(pending) > PIPELINE_DEPTH:
-                pending.popleft().get()
-                n_done += BATCH
-            elapsed = time.perf_counter() - start
-            if elapsed >= MEASURE_SECONDS:
-                break
-        while pending:
-            pending.popleft().get()
-            n_done += BATCH
-        elapsed = time.perf_counter() - start
-
-        rate = n_done / elapsed
-        result = {
-            "metric": "rate-limit decisions/sec, single chip, end-to-end "
-            f"(batch={BATCH}, {N_KEYS} hot keys)",
-            "value": round(rate, 1),
-            "unit": "decisions/sec",
-            "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
-            "p50_ms": round(p50_ms, 3),
-            "p99_ms": round(p99_ms, 3),
-            "platform": platform,
-        }
+        if MODE == "wire":
+            result = _run_wire(np, platform)
+        else:
+            result = _run_engine(np, platform)
         if backend_error:
             result["backend_error"] = backend_error
         _emit_once(result)
@@ -236,6 +162,201 @@ def main() -> int:
             result["backend_error"] = backend_error
         _emit_once(result)
         return 0
+
+
+def _run_engine(np, platform: str) -> dict:
+    """Engine-level columnar throughput + latency (the headline mode)."""
+    from gubernator_tpu import Algorithm
+    from gubernator_tpu.core.engine import DecisionEngine
+
+    engine = DecisionEngine(capacity=CAPACITY, max_kernel_width=max(8192, BATCH))
+
+    # Pre-build columnar batches (client-side cost, not engine cost) —
+    # the engine's native request format (DecisionEngine.apply_columnar);
+    # the dataclass/gRPC tier sits above this.
+    batches = []
+    for b in range((N_KEYS + BATCH - 1) // BATCH):
+        keys = [b"bench_k%d" % ((b * BATCH + i) % N_KEYS) for i in range(BATCH)]
+        algo = np.fromiter(
+            (
+                int(Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET)
+                for i in range(BATCH)
+            ),
+            dtype=np.int32,
+            count=BATCH,
+        )
+        batches.append(
+            dict(
+                keys=keys,
+                algo=algo,
+                behavior=np.zeros(BATCH, dtype=np.int32),
+                hits=np.ones(BATCH, dtype=np.int64),
+                limit=np.full(BATCH, 1_000_000, dtype=np.int64),
+                duration=np.full(BATCH, 3_600_000, dtype=np.int64),
+                burst=np.full(BATCH, 1_000_000, dtype=np.int64),
+            )
+        )
+
+    for i in range(WARMUP_BATCHES):
+        engine.apply_columnar(**batches[i % len(batches)])
+
+    # Latency: synchronous dispatch→readback per batch (what one
+    # 500µs serving window pays end to end).  Target: p99 < 2ms
+    # (BASELINE.md).
+    lat = np.empty(LATENCY_BATCHES, dtype=np.float64)
+    for i in range(LATENCY_BATCHES):
+        t0 = time.perf_counter()
+        engine.apply_columnar(**batches[i % len(batches)])
+        lat[i] = time.perf_counter() - t0
+    p50_ms = float(np.percentile(lat, 50) * 1e3)
+    p99_ms = float(np.percentile(lat, 99) * 1e3)
+
+    # Throughput: pipelined — keep a few batches in flight so
+    # device→host readback of batch i overlaps dispatch of batch
+    # i+1 (PendingColumnar).
+    from collections import deque
+
+    pending = deque()
+    n_done = 0
+    start = time.perf_counter()
+    i = 0
+    while True:
+        pending.append(
+            engine.apply_columnar(**batches[i % len(batches)], want_async=True)
+        )
+        i += 1
+        if len(pending) > PIPELINE_DEPTH:
+            pending.popleft().get()
+            n_done += BATCH
+        elapsed = time.perf_counter() - start
+        if elapsed >= MEASURE_SECONDS:
+            break
+    while pending:
+        pending.popleft().get()
+        n_done += BATCH
+    elapsed = time.perf_counter() - start
+
+    rate = n_done / elapsed
+    return {
+        "metric": "rate-limit decisions/sec, single chip, end-to-end "
+        f"(batch={BATCH}, {N_KEYS} hot keys)",
+        "value": round(rate, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
+        "p50_ms": round(p50_ms, 3),
+        "p99_ms": round(p99_ms, 3),
+        "platform": platform,
+    }
+
+
+def _run_wire(np, platform: str) -> dict:
+    """Loopback-gRPC serving throughput: real daemon, real wire.
+
+    Measures the SERVED path — pb decode → columnar fast path →
+    engine → pb encode (gubernator_tpu/net/server.py) — which after
+    VERDICT r1 item 2 is the same engine program as `_run_engine`.
+    Client-side encode cost is excluded (payloads pre-serialized);
+    responses are received but not parsed.
+    """
+    import grpc
+
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+    wire_batch = min(BATCH, 1000)  # MAX_BATCH_SIZE on the wire
+    n_threads = int(os.environ.get("BENCH_WIRE_THREADS", 8))
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=CAPACITY,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+    )
+    daemon = spawn_daemon(conf)
+    try:
+        addr = daemon.grpc_address
+        payloads = []
+        for b in range(max(1, min(N_KEYS // wire_batch, 64))):
+            msg = pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="bench",
+                        unique_key="k%d" % ((b * wire_batch + i) % N_KEYS),
+                        hits=1,
+                        limit=1_000_000,
+                        duration=3_600_000,
+                        algorithm=i % 2,
+                        burst=1_000_000,
+                    )
+                    for i in range(wire_batch)
+                ]
+            )
+            payloads.append(msg.SerializeToString())
+
+        barrier = threading.Barrier(n_threads + 1)
+        stop = threading.Event()
+        counts = [0] * n_threads
+        lats: list = [None] * n_threads
+
+        def worker(tid: int) -> None:
+            mylat = []
+            try:
+                ch = grpc.insecure_channel(addr)
+                call = ch.unary_unary(
+                    f"/{V1_SERVICE}/GetRateLimits",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                call(payloads[tid % len(payloads)])  # warmup / connect
+            finally:
+                # A failed warmup must not strand main() on the barrier
+                # (the watchdog would misreport a wedged backend).
+                barrier.wait()
+            i = tid
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                call(payloads[i % len(payloads)])
+                mylat.append(time.perf_counter() - t0)
+                counts[tid] += wire_batch
+                i += n_threads
+            lats[tid] = mylat
+            ch.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        start = time.perf_counter()
+        time.sleep(MEASURE_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        total = sum(counts)
+        all_lat = np.asarray([x for ml in lats if ml for x in ml])
+        rate = total / elapsed
+        return {
+            "metric": "rate-limit decisions/sec, single node, loopback gRPC "
+            f"(batch={wire_batch}, {n_threads} client threads, {N_KEYS} hot keys)",
+            "value": round(rate, 1),
+            "unit": "decisions/sec",
+            "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
+            "p50_ms": round(float(np.percentile(all_lat, 50)) * 1e3, 3)
+            if all_lat.size
+            else None,
+            "p99_ms": round(float(np.percentile(all_lat, 99)) * 1e3, 3)
+            if all_lat.size
+            else None,
+            "platform": platform,
+        }
+    finally:
+        daemon.close()
 
 
 if __name__ == "__main__":

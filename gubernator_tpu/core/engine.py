@@ -849,6 +849,24 @@ class DecisionEngine:
             ]
             self.get_rate_limits(reqs, now_ms=now)
             width *= 2
+        # Columnar-kernel ladder: the wire/bench fast path runs
+        # apply_batch_sorted, a DIFFERENT jitted program than
+        # apply_batch — without this ladder the first served columnar
+        # batch pays an XLA compile that can exceed the peer batch
+        # timeout (seen as "timeout waiting for batched response").
+        width = 64
+        while width <= max_width:
+            self.apply_columnar(
+                [b"__warmup___%d" % i for i in range(width)],
+                np.zeros(width, dtype=_I32),
+                np.zeros(width, dtype=_I32),
+                np.zeros(width, dtype=_I64),  # hits=0: report-only
+                np.ones(width, dtype=_I64),
+                np.ones(width, dtype=_I64),
+                np.zeros(width, dtype=_I64),
+                now_ms=now,
+            )
+            width *= 2
         # Clear-scatter ladder (no-op out-of-range slots).
         csize = 16
         while csize <= max_width:

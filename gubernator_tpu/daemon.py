@@ -211,7 +211,11 @@ class Daemon:
     def _warmup(engine) -> None:
         """Pay the kernel jit compiles before serving, not on the first
         client requests (an XLA compile can exceed the peer batch
-        timeout)."""
+        timeout).  The default ladder (64..1024) covers every width the
+        wire can produce — MAX_BATCH_SIZE=1000 pads to 1024 — for BOTH
+        serving programs (dataclass + columnar); engine-level callers
+        that exceed it (bench harnesses) warm their own widths.
+        tests/test_warmup.py pins zero compile-cache misses."""
         engine.warmup()
 
     # ------------------------------------------------------------------

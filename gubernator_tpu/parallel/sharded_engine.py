@@ -459,6 +459,29 @@ class ShardedDecisionEngine:
             ]
             self.get_rate_limits(reqs, now_ms=now)
             width *= 2
+        # Columnar-kernel ladder (the sorted mesh step is a different
+        # jitted program than the dataclass-path step; see
+        # DecisionEngine.warmup).  Balanced per-shard keys compile the
+        # exact [n_shards, width] padded shapes the wire path produces.
+        width = 64
+        while width <= max_width:
+            keys = [
+                f"__warmup___{k}".encode()
+                for ks in per_shard
+                for k in ks[:width]
+            ]
+            n = len(keys)
+            self.apply_columnar(
+                keys,
+                np.zeros(n, dtype=_I32),
+                np.zeros(n, dtype=_I32),
+                np.zeros(n, dtype=_I64),  # hits=0: report-only
+                np.ones(n, dtype=_I64),
+                np.ones(n, dtype=_I64),
+                np.zeros(n, dtype=_I64),
+                now_ms=now,
+            )
+            width *= 2
         csize = 16
         cap = self.shard_capacity
         while csize <= max_width:

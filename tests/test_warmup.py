@@ -1,0 +1,98 @@
+"""Warmup must cover every program the serving paths run.
+
+VERDICT r1 weak item 4 / next-round item 7: a daemon that warms up but
+then pays an XLA compile on a served batch blows the peer-batch timeout
+(an uncompiled apply_batch_sorted cost 1.1s on the wire path).  These
+tests pin "zero compile-cache misses while serving" for both engines by
+snapshotting the jit caches of every kernel after warmup and asserting
+they do not grow while serving widths up to the warmed max.
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core.engine import DecisionEngine
+from gubernator_tpu.ops import bucket_kernel as bk
+from gubernator_tpu.types import Algorithm, RateLimitReq
+
+_KERNELS = (bk.apply_batch, bk.apply_batch_sorted, bk.clear_occupied)
+
+
+def _cache_sizes():
+    return tuple(k._cache_size() for k in _KERNELS)
+
+
+def _columns(n, start=0, name="serve"):
+    return dict(
+        keys=[b"%s_k%d" % (name.encode(), start + i) for i in range(n)],
+        algo=np.asarray([i % 2 for i in range(n)], dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.ones(n, dtype=np.int64),
+        limit=np.full(n, 100, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.full(n, 100, dtype=np.int64),
+    )
+
+
+def test_single_device_warmup_covers_serving_widths(frozen_clock):
+    engine = DecisionEngine(capacity=4096, clock=frozen_clock, max_kernel_width=1024)
+    engine.warmup(max_width=1024)
+    before = _cache_sizes()
+
+    # Serve every width the wire path can produce (1..MAX_BATCH_SIZE
+    # pads to 64..1024) through BOTH serving programs.
+    for width in (1, 63, 64, 65, 500, 1000, 1024):
+        engine.apply_columnar(**_columns(width, start=width * 2000))
+        reqs = [
+            RateLimitReq(
+                name="serve2",
+                unique_key=f"{width}_{i}",
+                hits=1,
+                limit=100,
+                duration=60_000,
+                algorithm=Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET,
+            )
+            for i in range(width)
+        ]
+        engine.get_rate_limits(reqs)
+
+    assert _cache_sizes() == before, (
+        "serving compiled a new kernel variant after warmup"
+    )
+
+
+def test_sharded_warmup_covers_serving_widths(frozen_clock):
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    from gubernator_tpu.parallel.mesh import make_mesh
+    from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+
+    mesh = make_mesh(jax.devices()[:4])
+    engine = ShardedDecisionEngine(
+        shard_capacity=2048, mesh=mesh, clock=frozen_clock, max_kernel_width=256
+    )
+    engine.warmup(max_width=256)
+    before = tuple(
+        f._cache_size() for f in (engine._step, engine._step_sorted, engine._clear_step)
+    )
+
+    for width in (1, 65, 200, 256 * 4):
+        engine.apply_columnar(**_columns(width, start=width * 3000, name="shserve"))
+        reqs = [
+            RateLimitReq(
+                name="shserve2",
+                unique_key=f"{width}_{i}",
+                hits=1,
+                limit=100,
+                duration=60_000,
+            )
+            for i in range(width)
+        ]
+        engine.get_rate_limits(reqs)
+
+    after = tuple(
+        f._cache_size() for f in (engine._step, engine._step_sorted, engine._clear_step)
+    )
+    assert after == before, "sharded serving compiled a new variant after warmup"
