@@ -41,8 +41,18 @@ MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", 5.0))
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE", 3))
 LATENCY_BATCHES = int(os.environ.get("BENCH_LATENCY_BATCHES", 200))
 # "engine" (headline: columnar engine path) | "wire" (loopback gRPC
-# through a real daemon — VERDICT r1 item 2's served-path evidence).
+# through a real daemon — VERDICT r1 item 2's served-path evidence) |
+# "global" (GLOBAL behavior over an in-process cluster — BASELINE
+# config 3).
 MODE = os.environ.get("BENCH_MODE", "engine")
+# Algorithm mix for engine mode: mixed | token | leaky (config 2).
+ALGO = os.environ.get("BENCH_ALGO", "mixed")
+# Zipf skew exponent for engine-mode key sampling; 0 = round-robin
+# (config 4's skewed 100M-key load uses e.g. BENCH_ZIPF=1.2).
+# numpy's sampler requires alpha > 1.
+ZIPF = float(os.environ.get("BENCH_ZIPF", 0))
+if ZIPF and ZIPF <= 1.0:
+    raise SystemExit("BENCH_ZIPF must be > 1 (numpy zipf sampler) or 0")
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180.0))
 # Whole-run deadline: if the backend wedges AFTER a healthy probe (it
 # happened transiently in round 1), a watchdog emits the JSON line and
@@ -143,6 +153,8 @@ def main() -> int:
 
         if MODE == "wire":
             result = _run_wire(np, platform)
+        elif MODE == "global":
+            result = _run_global(np, platform)
         else:
             result = _run_engine(np, platform)
         if backend_error:
@@ -164,9 +176,43 @@ def main() -> int:
         return 0
 
 
-def _run_engine(np, platform: str) -> dict:
-    """Engine-level columnar throughput + latency (the headline mode)."""
+def _key_indices(np, n_batches: int):
+    """Per-batch key indices: round-robin over N_KEYS, or Zipf-skewed
+    when BENCH_ZIPF=<alpha> is set (BASELINE config 4's skewed load)."""
+    if ZIPF > 0:
+        rng = np.random.default_rng(0)
+        return [
+            (rng.zipf(ZIPF, BATCH) - 1) % N_KEYS for _ in range(n_batches)
+        ]
+    return [
+        (np.arange(BATCH, dtype=np.int64) + b * BATCH) % N_KEYS
+        for b in range(n_batches)
+    ]
+
+
+def _algo_column(np, n: int):
     from gubernator_tpu import Algorithm
+
+    if ALGO == "token":
+        return np.full(n, int(Algorithm.TOKEN_BUCKET), dtype=np.int32)
+    if ALGO == "leaky":
+        return np.full(n, int(Algorithm.LEAKY_BUCKET), dtype=np.int32)
+    return np.fromiter(
+        (
+            int(Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET)
+            for i in range(n)
+        ),
+        dtype=np.int32,
+        count=n,
+    )
+
+
+def _run_engine(np, platform: str) -> dict:
+    """Engine-level columnar throughput + latency (the headline mode).
+
+    BENCH_KEYS/BENCH_CAPACITY/BENCH_ALGO/BENCH_ZIPF parameterize it
+    into BASELINE configs 2 (leaky @ 1M keys) and 4 (mixed Zipf @ 100M
+    keys)."""
     from gubernator_tpu.core.engine import DecisionEngine
 
     engine = DecisionEngine(capacity=CAPACITY, max_kernel_width=max(8192, BATCH))
@@ -174,21 +220,18 @@ def _run_engine(np, platform: str) -> dict:
     # Pre-build columnar batches (client-side cost, not engine cost) —
     # the engine's native request format (DecisionEngine.apply_columnar);
     # the dataclass/gRPC tier sits above this.
+    n_batches = max(1, min((N_KEYS + BATCH - 1) // BATCH, 256))
+    # Round-robin mode can only touch n_batches*BATCH distinct keys
+    # (client-side key materialization is capped); report the honest
+    # working-set size.  Zipf mode samples the full N_KEYS range.
+    distinct = N_KEYS if ZIPF else min(N_KEYS, n_batches * BATCH)
     batches = []
-    for b in range((N_KEYS + BATCH - 1) // BATCH):
-        keys = [b"bench_k%d" % ((b * BATCH + i) % N_KEYS) for i in range(BATCH)]
-        algo = np.fromiter(
-            (
-                int(Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET)
-                for i in range(BATCH)
-            ),
-            dtype=np.int32,
-            count=BATCH,
-        )
+    for idx in _key_indices(np, n_batches):
+        keys = [b"bench_k%d" % i for i in idx.tolist()]
         batches.append(
             dict(
                 keys=keys,
-                algo=algo,
+                algo=_algo_column(np, BATCH),
                 behavior=np.zeros(BATCH, dtype=np.int32),
                 hits=np.ones(BATCH, dtype=np.int64),
                 limit=np.full(BATCH, 1_000_000, dtype=np.int64),
@@ -239,7 +282,9 @@ def _run_engine(np, platform: str) -> dict:
     rate = n_done / elapsed
     return {
         "metric": "rate-limit decisions/sec, single chip, end-to-end "
-        f"(batch={BATCH}, {N_KEYS} hot keys)",
+        f"(batch={BATCH}, {distinct} hot keys"
+        + (f", zipf={ZIPF} over {N_KEYS}" if ZIPF else "")
+        + f", capacity={CAPACITY}, algo={ALGO})",
         "value": round(rate, 1),
         "unit": "decisions/sec",
         "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
@@ -277,86 +322,136 @@ def _run_wire(np, platform: str) -> dict:
     )
     daemon = spawn_daemon(conf)
     try:
-        addr = daemon.grpc_address
-        payloads = []
-        for b in range(max(1, min(N_KEYS // wire_batch, 64))):
-            msg = pb.GetRateLimitsReq(
-                requests=[
-                    pb.RateLimitReq(
-                        name="bench",
-                        unique_key="k%d" % ((b * wire_batch + i) % N_KEYS),
-                        hits=1,
-                        limit=1_000_000,
-                        duration=3_600_000,
-                        algorithm=i % 2,
-                        burst=1_000_000,
-                    )
-                    for i in range(wire_batch)
-                ]
-            )
-            payloads.append(msg.SerializeToString())
-
-        barrier = threading.Barrier(n_threads + 1)
-        stop = threading.Event()
-        counts = [0] * n_threads
-        lats: list = [None] * n_threads
-
-        def worker(tid: int) -> None:
-            mylat = []
-            try:
-                ch = grpc.insecure_channel(addr)
-                call = ch.unary_unary(
-                    f"/{V1_SERVICE}/GetRateLimits",
-                    request_serializer=lambda raw: raw,
-                    response_deserializer=lambda raw: raw,
-                )
-                call(payloads[tid % len(payloads)])  # warmup / connect
-            finally:
-                # A failed warmup must not strand main() on the barrier
-                # (the watchdog would misreport a wedged backend).
-                barrier.wait()
-            i = tid
-            while not stop.is_set():
-                t0 = time.perf_counter()
-                call(payloads[i % len(payloads)])
-                mylat.append(time.perf_counter() - t0)
-                counts[tid] += wire_batch
-                i += n_threads
-            lats[tid] = mylat
-            ch.close()
-
-        threads = [
-            threading.Thread(target=worker, args=(t,), daemon=True)
-            for t in range(n_threads)
-        ]
-        for t in threads:
-            t.start()
-        barrier.wait()
-        start = time.perf_counter()
-        time.sleep(MEASURE_SECONDS)
-        stop.set()
-        for t in threads:
-            t.join()
-        elapsed = time.perf_counter() - start
-        total = sum(counts)
-        all_lat = np.asarray([x for ml in lats if ml for x in ml])
-        rate = total / elapsed
+        payloads = _build_payloads(pb, wire_batch, behavior=0)
+        rate, p50_ms, p99_ms = _drive_grpc(
+            np, [daemon.grpc_address], payloads, n_threads, wire_batch
+        )
         return {
             "metric": "rate-limit decisions/sec, single node, loopback gRPC "
             f"(batch={wire_batch}, {n_threads} client threads, {N_KEYS} hot keys)",
             "value": round(rate, 1),
             "unit": "decisions/sec",
             "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
-            "p50_ms": round(float(np.percentile(all_lat, 50)) * 1e3, 3)
-            if all_lat.size
-            else None,
-            "p99_ms": round(float(np.percentile(all_lat, 99)) * 1e3, 3)
-            if all_lat.size
-            else None,
+            "p50_ms": p50_ms,
+            "p99_ms": p99_ms,
             "platform": platform,
         }
     finally:
         daemon.close()
+
+
+def _build_payloads(pb, wire_batch: int, behavior: int) -> list:
+    """Pre-serialized GetRateLimitsReq payloads cycling the key space."""
+    payloads = []
+    for b in range(max(1, min(N_KEYS // wire_batch, 64))):
+        msg = pb.GetRateLimitsReq(
+            requests=[
+                pb.RateLimitReq(
+                    name="bench",
+                    unique_key="k%d" % ((b * wire_batch + i) % N_KEYS),
+                    hits=1,
+                    limit=1_000_000,
+                    duration=3_600_000,
+                    algorithm=i % 2,
+                    behavior=behavior,
+                    burst=1_000_000,
+                )
+                for i in range(wire_batch)
+            ]
+        )
+        payloads.append(msg.SerializeToString())
+    return payloads
+
+
+def _drive_grpc(np, addrs: list, payloads: list, n_threads: int, items_per_rpc: int):
+    """Closed-loop gRPC load: n_threads workers round-robin over
+    `addrs`, replaying pre-serialized payloads.  Returns
+    (items/sec, p50_ms, p99_ms)."""
+    import grpc
+
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+
+    barrier = threading.Barrier(n_threads + 1)
+    stop = threading.Event()
+    counts = [0] * n_threads
+    lats: list = [None] * n_threads
+
+    def worker(tid: int) -> None:
+        mylat = []
+        try:
+            ch = grpc.insecure_channel(addrs[tid % len(addrs)])
+            call = ch.unary_unary(
+                f"/{V1_SERVICE}/GetRateLimits",
+                request_serializer=lambda raw: raw,
+                response_deserializer=lambda raw: raw,
+            )
+            call(payloads[tid % len(payloads)])  # warmup / connect
+        finally:
+            # A failed warmup must not strand main() on the barrier
+            # (the watchdog would misreport a wedged backend).
+            barrier.wait()
+        i = tid
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            call(payloads[i % len(payloads)])
+            mylat.append(time.perf_counter() - t0)
+            counts[tid] += items_per_rpc
+            i += n_threads
+        lats[tid] = mylat
+        ch.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), daemon=True)
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    start = time.perf_counter()
+    time.sleep(MEASURE_SECONDS)
+    stop.set()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    all_lat = np.asarray([x for ml in lats if ml for x in ml])
+    rate = sum(counts) / elapsed
+    p50 = round(float(np.percentile(all_lat, 50)) * 1e3, 3) if all_lat.size else None
+    p99 = round(float(np.percentile(all_lat, 99)) * 1e3, 3) if all_lat.size else None
+    return rate, p50, p99
+
+
+def _run_global(np, platform: str) -> dict:
+    """BASELINE config 3: GLOBAL behavior over an in-process cluster.
+
+    Every request carries Behavior.GLOBAL; clients spray all nodes, so
+    non-owners answer from the owner-broadcast status cache while hits
+    aggregate asynchronously to owners (reference: global.go;
+    benchmark_test.go:29-148's GLOBAL subtest)."""
+    from gubernator_tpu.cluster.harness import ClusterHarness
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+    from gubernator_tpu.types import Behavior
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    n_threads = int(os.environ.get("BENCH_WIRE_THREADS", 8))
+    wire_batch = min(BATCH, 1000)
+    h = ClusterHarness().start(n_nodes, cache_size=CAPACITY)
+    try:
+        addrs = [h.peer_at(i).grpc_address for i in range(n_nodes)]
+        payloads = _build_payloads(pb, wire_batch, behavior=int(Behavior.GLOBAL))
+        rate, p50_ms, p99_ms = _drive_grpc(np, addrs, payloads, n_threads, wire_batch)
+        return {
+            "metric": f"rate-limit decisions/sec, GLOBAL, {n_nodes}-node "
+            f"in-process cluster (batch={wire_batch}, {n_threads} client "
+            f"threads, {N_KEYS} hot keys)",
+            "value": round(rate, 1),
+            "unit": "decisions/sec",
+            "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
+            "p50_ms": p50_ms,
+            "p99_ms": p99_ms,
+            "platform": platform,
+        }
+    finally:
+        h.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,44 @@
+{{/* Chart name (reference analog: charts/gubernator/templates/_helpers.tpl) */}}
+{{- define "gubernator-tpu.name" -}}
+{{- default .Chart.Name .Values.gubernator.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "gubernator-tpu.fullname" -}}
+{{- if .Values.gubernator.fullnameOverride -}}
+{{- .Values.gubernator.fullnameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- printf "%s-%s" .Release.Name (include "gubernator-tpu.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "gubernator-tpu.labels" -}}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+app.kubernetes.io/name: {{ include "gubernator-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- range $k, $v := .Values.gubernator.labels }}
+{{ $k }}: {{ $v | quote }}
+{{- end }}
+{{- end -}}
+
+{{- define "gubernator-tpu.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "gubernator-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}
+
+{{- define "gubernator-tpu.serviceAccountName" -}}
+{{- if .Values.gubernator.serviceAccount.create -}}
+{{- default (include "gubernator-tpu.fullname" .) .Values.gubernator.serviceAccount.name -}}
+{{- else -}}
+{{- default "default" .Values.gubernator.serviceAccount.name -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "gubernator-tpu.podSelector" -}}
+{{- if .Values.gubernator.discovery.podSelector -}}
+{{- .Values.gubernator.discovery.podSelector -}}
+{{- else -}}
+app.kubernetes.io/name={{ include "gubernator-tpu.name" . }}
+{{- end -}}
+{{- end -}}

@@ -24,7 +24,13 @@ from typing import TYPE_CHECKING, Dict, List
 from gubernator_tpu.cluster.batch_loop import IntervalBatcher
 from gubernator_tpu.cluster.peer_client import PeerError
 from gubernator_tpu.config import BehaviorConfig
-from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, UpdatePeerGlobal
+from gubernator_tpu.types import (
+    MAX_BATCH_SIZE,
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    UpdatePeerGlobal,
+)
 
 if TYPE_CHECKING:
     from gubernator_tpu.service import V1Instance
@@ -72,6 +78,10 @@ class GlobalManager:
         """Queue hits observed by a non-owner. reference: global.go:68-70."""
         self._hits.add(r.hash_key(), r)
 
+    def queue_hits_many(self, reqs) -> None:
+        """Batch variant of queue_hit: one batcher lock per wire batch."""
+        self._hits.add_many((r.hash_key(), r) for r in reqs)
+
     def queue_update(self, r: RateLimitReq) -> None:
         """Mark a key the owner must re-broadcast. reference: global.go:72-74."""
         self._updates.add(r.hash_key(), r)
@@ -103,9 +113,14 @@ class GlobalManager:
                     # ourselves.
                     self.instance.apply_local_batch(reqs)
                 else:
-                    peer.get_peer_rate_limits(
-                        reqs, timeout=self.conf.global_timeout
-                    )
+                    # Under burst load the window can aggregate more
+                    # distinct keys than one RPC may carry; chunk to
+                    # the wire's hard batch limit (gubernator.go:41).
+                    for lo in range(0, len(reqs), MAX_BATCH_SIZE):
+                        peer.get_peer_rate_limits(
+                            reqs[lo : lo + MAX_BATCH_SIZE],
+                            timeout=self.conf.global_timeout,
+                        )
             except PeerError as e:
                 log.error("error sending global hits to '%s': %s", addr, e)
                 continue
@@ -149,7 +164,13 @@ class GlobalManager:
             if peer.info.is_owner:  # exclude ourselves
                 continue
             try:
-                peer.update_peer_globals(globals_, timeout=self.conf.global_timeout)
+                # Chunk: keep each UpdatePeerGlobals under the wire's
+                # batch/message-size limits under burst load.
+                for lo in range(0, len(globals_), MAX_BATCH_SIZE):
+                    peer.update_peer_globals(
+                        globals_[lo : lo + MAX_BATCH_SIZE],
+                        timeout=self.conf.global_timeout,
+                    )
             except PeerError as e:
                 if not e.not_ready:
                     log.error(

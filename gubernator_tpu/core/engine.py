@@ -40,12 +40,13 @@ from gubernator_tpu.ops.bucket_kernel import (
     BucketState,
     SlotRecord,
     apply_batch,
-    apply_batch_sorted,
     clear_occupied,
+    compute_update_sorted,
     load_slots,
     make_state,
+    scatter_store,
 )
-from gubernator_tpu.ops.expiry import sweep_expired
+from gubernator_tpu.ops.expiry import windowed_sweep
 from gubernator_tpu.core.interning import InternTable
 from gubernator_tpu.types import (
     Algorithm,
@@ -175,6 +176,7 @@ class DecisionEngine:
                 np.arange(capacity, capacity + 16, dtype=np.int64).astype(_I32)
             )
         self._lock = threading.Lock()
+        self._sweep_cursor = 0  # next window start for incremental sweep
         # Metrics (reference: gubernator.go:59-113 catalog; wired to
         # prometheus in gubernator_tpu.utils.metrics).
         self.requests_total = 0
@@ -518,22 +520,33 @@ class DecisionEngine:
 
     # ------------------------------------------------------------------
 
-    def sweep(self, now_ms: Optional[int] = None) -> int:
-        """Reclaim slots of expired buckets; returns number freed."""
+    # Fixed sweep window: bounds per-call host transfer (one count
+    # scalar + freed indices) and compiled shapes regardless of
+    # capacity (VERDICT r1 item 4 — the old full-mask readback was
+    # ~100MB per sweep at 100M slots).
+    SWEEP_WINDOW = 1 << 17
+
+    def sweep(
+        self, now_ms: Optional[int] = None, max_windows: Optional[int] = None
+    ) -> int:
+        """Reclaim slots of expired buckets; returns number freed.
+
+        `max_windows` limits this call to that many SWEEP_WINDOW-sized
+        ranges, resuming from a cursor next call — the incremental mode
+        for very large capacities; None sweeps everything.
+        """
         if now_ms is None:
             now_ms = self.clock.now_ms()
+
+        def release(order, count, start) -> int:
+            c = int(count)
+            if c:
+                freed_slots = np.asarray(order[:c]).astype(np.int64) + start
+                self.table.release_slots(freed_slots)
+            return c
+
         with self._lock:
-            new_occ, freed = sweep_expired(
-                self._state.occupied,
-                self._state.expire_hi,
-                self._state.expire_lo,
-                jnp.asarray(now_ms >> 32, dtype=jnp.int32),
-                jnp.asarray(now_ms & 0xFFFFFFFF, dtype=jnp.uint32),
-            )
-            self._state = self._state._replace(occupied=new_occ)
-            freed_slots = np.nonzero(np.asarray(freed))[0]
-            self.table.release_slots(freed_slots)
-        return int(freed_slots.size)
+            return windowed_sweep(self, self.capacity, now_ms, max_windows, release)
 
     # ------------------------------------------------------------------
     # Columnar fast path: the engine's native request format.
@@ -700,9 +713,11 @@ class DecisionEngine:
                     greg_duration=jnp.asarray(col(cols[6], _I64)),
                     greg_expire=jnp.asarray(col(cols[7], _I64)),
                 )
-                self._state, packed = apply_batch_sorted(
-                    self._state, batch, now_dev
-                )
+                # Split kernel: read-only compute, then donated
+                # write-only scatter — in-place, O(batch) not
+                # O(capacity) (see bucket_kernel._scatter_values).
+                vals, packed = compute_update_sorted(self._state, batch, now_dev)
+                self._state = scatter_store(self._state, batch.slot, vals)
                 packed.copy_to_host_async()
                 self.rounds_total += 1
                 # Request indices of the sorted lanes, for unpermuting.

@@ -200,10 +200,19 @@ class Daemon:
             )
             self._sweeper.start()
 
+    # Windows swept per tick: bounds how long each periodic sweep holds
+    # the engine lock (a full pass at 100M slots is ~763 windows of
+    # device round-trips — serving p99 would spike for its whole
+    # duration).  The cursor resumes next tick, so full coverage still
+    # happens, just spread across ticks.
+    SWEEP_WINDOWS_PER_TICK = 16
+
     def _sweep_loop(self) -> None:
         while not self._sweep_stop.wait(self.conf.sweep_interval):
             try:
-                self.instance.engine.sweep()
+                self.instance.engine.sweep(
+                    max_windows=self.SWEEP_WINDOWS_PER_TICK
+                )
             except Exception:  # noqa: BLE001 — sweeping must not die
                 log.exception("expiry sweep failed")
 

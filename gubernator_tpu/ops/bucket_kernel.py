@@ -175,8 +175,7 @@ def split_remf(v: jax.Array) -> tuple[jax.Array, jax.Array]:
     return wc.astype(_I32), ((v - w) * (2.0**32)).astype(_U32)
 
 
-@jax.jit
-def clear_occupied(occupied: jax.Array, slots: jax.Array) -> jax.Array:
+def _clear_occupied_impl(occupied: jax.Array, slots: jax.Array) -> jax.Array:
     """Mark evicted slots unoccupied (host eviction executed on device).
 
     Split out of the apply kernel so the compile cache is one shape per
@@ -187,6 +186,13 @@ def clear_occupied(occupied: jax.Array, slots: jax.Array) -> jax.Array:
     return occupied.at[jnp.sort(slots)].set(
         False, mode="drop", indices_are_sorted=True, unique_indices=True
     )
+
+
+# Donated: write-only scatter, compiles in place (no occupancy-array
+# copy).  Callers must treat the input buffer as consumed.  Inside
+# shard_map/jit tracing use `_clear_occupied_impl` (inner donation has
+# no effect there).
+clear_occupied = jax.jit(_clear_occupied_impl, donate_argnums=(0,))
 
 
 def _apply_batch_impl(
@@ -263,6 +269,23 @@ def _apply_batch_impl(
 def _apply_core(
     state: BucketState,
     occupied: jax.Array,
+    slot: jax.Array,
+    *args,
+):
+    """gather → update → scatter in ONE program (single-call variants).
+
+    Hot paths use the split pair (`_compute_update` + `scatter_store`)
+    instead — see `_scatter_values` for why."""
+    vals, resp_status, resp_rem, resp_reset = _compute_update(
+        state, occupied, slot, *args
+    )
+    new_state = _scatter_values(state._replace(occupied=occupied), slot, vals)
+    return new_state, resp_status, resp_rem, resp_reset
+
+
+def _compute_update(
+    state: BucketState,
+    occupied: jax.Array,
     slot: jax.Array,  # int32 [B] SORTED ascending, unique; padding = cap+i
     r_algo: jax.Array,
     r_beh: jax.Array,
@@ -274,9 +297,9 @@ def _apply_core(
     r_gexp: jax.Array,
     now: jax.Array,
 ):
-    """The branch-free bucket update over slot-sorted lanes: gather →
-    update → scatter.  Returns (new_state, status, remaining,
-    reset_time) with responses in the SORTED lane order."""
+    """The READ-ONLY half of the branch-free bucket update over
+    slot-sorted lanes: gather → update.  Returns (SlotValues, status,
+    remaining, reset_time) with everything in the SORTED lane order."""
     cap = state.occupied.shape[0]
     mask = slot < cap
 
@@ -481,32 +504,77 @@ def _apply_core(
     n_burst = pick(zero64, zero64, zero64, burst_eff, burst_eff)
     n_status = pick(_UNDER, te_status_store, _UNDER, _UNDER, _UNDER)
 
-    # `slot` is sorted with distinct out-of-range padding → flags hold;
-    # out-of-range lanes are dropped.
-    def sc(arr, vals):
+    vals = SlotValues(
+        occ=n_occ,
+        algo=n_algo,
+        status=n_status,
+        limit=n_limit,
+        remaining=n_rem,
+        rem_f=n_rem_f,
+        duration=n_dur,
+        t0=n_t0,
+        expire=n_exp,
+        burst=n_burst,
+    )
+    return vals, resp_status, resp_rem, resp_reset
+
+
+class SlotValues(NamedTuple):
+    """Per-lane values to store after an update — the write half of the
+    split kernel, shape [B] per field (combined int64; split into hi/lo
+    words inside the scatter program)."""
+
+    occ: jax.Array  # bool
+    algo: jax.Array  # int32
+    status: jax.Array  # int32
+    limit: jax.Array  # int64
+    remaining: jax.Array  # int64
+    rem_f: jax.Array  # float64 (leaky 32.32 source)
+    duration: jax.Array  # int64
+    t0: jax.Array  # int64
+    expire: jax.Array  # int64
+    burst: jax.Array  # int64
+
+
+def _scatter_values(
+    state: BucketState, slot: jax.Array, vals: SlotValues
+) -> BucketState:
+    """WRITE-ONLY scatter of computed slot values into the state.
+
+    Kept free of any other read of the state arrays on purpose: when
+    jitted with donated state this compiles to a true in-place update.
+    A program that gathers from and scatters into the same donated
+    buffer forces XLA's copy-insertion to clone every state array —
+    measured 18 full-capacity copies (~41ms at 2M slots, O(capacity)
+    per batch) before the kernel was split into compute + scatter.
+    `slot` is sorted with distinct out-of-range padding → flags hold;
+    out-of-range (padding) lanes are dropped.
+    """
+
+    def sc(arr, v):
         return arr.at[slot].set(
-            vals.astype(arr.dtype),
+            v.astype(arr.dtype),
             mode="drop",
             indices_are_sorted=True,
             unique_indices=True,
         )
 
-    def sc64(hi_arr, lo_arr, vals):
-        hi, lo = split_i64(vals)
+    def sc64(hi_arr, lo_arr, v):
+        hi, lo = split_i64(v)
         return sc(hi_arr, hi), sc(lo_arr, lo)
 
-    n_limit_hi, n_limit_lo = sc64(state.limit_hi, state.limit_lo, n_limit)
-    n_rem_hi, n_rem_lo = sc64(state.remaining_hi, state.remaining_lo, n_rem)
-    remf_hi_v, remf_lo_v = split_remf(n_rem_f)
-    n_dur_hi, n_dur_lo = sc64(state.duration_hi, state.duration_lo, n_dur)
-    n_t0_hi, n_t0_lo = sc64(state.t0_hi, state.t0_lo, n_t0)
-    n_exp_hi, n_exp_lo = sc64(state.expire_hi, state.expire_lo, n_exp)
-    n_burst_hi, n_burst_lo = sc64(state.burst_hi, state.burst_lo, n_burst)
+    n_limit_hi, n_limit_lo = sc64(state.limit_hi, state.limit_lo, vals.limit)
+    n_rem_hi, n_rem_lo = sc64(state.remaining_hi, state.remaining_lo, vals.remaining)
+    remf_hi_v, remf_lo_v = split_remf(vals.rem_f)
+    n_dur_hi, n_dur_lo = sc64(state.duration_hi, state.duration_lo, vals.duration)
+    n_t0_hi, n_t0_lo = sc64(state.t0_hi, state.t0_lo, vals.t0)
+    n_exp_hi, n_exp_lo = sc64(state.expire_hi, state.expire_lo, vals.expire)
+    n_burst_hi, n_burst_lo = sc64(state.burst_hi, state.burst_lo, vals.burst)
     zero32 = jnp.zeros_like(slot)
-    new_state = BucketState(
-        occupied=sc(occupied, n_occ),
-        algo=sc(state.algo, n_algo),
-        status=sc(state.status, n_status),
+    return BucketState(
+        occupied=sc(state.occupied, vals.occ),
+        algo=sc(state.algo, vals.algo),
+        status=sc(state.status, vals.status),
         limit_hi=n_limit_hi,
         limit_lo=n_limit_lo,
         remaining_hi=n_rem_hi,
@@ -524,8 +592,11 @@ def _apply_core(
         invalid_hi=sc(state.invalid_hi, zero32),
         invalid_lo=sc(state.invalid_lo, zero32),
     )
-    return new_state, resp_status, resp_rem, resp_reset
 
+
+# Donated write-only scatter: compiles to a true in-place update (no
+# full-capacity copies) because the program never reads what it writes.
+scatter_store = jax.jit(_scatter_values, donate_argnums=(0,))
 
 apply_batch = jax.jit(_apply_batch_impl, donate_argnums=(0,))
 
@@ -564,6 +635,38 @@ def _apply_batch_sorted_impl(
 
 
 apply_batch_sorted = jax.jit(_apply_batch_sorted_impl, donate_argnums=(0,))
+
+
+def _compute_update_sorted_impl(
+    state: BucketState,
+    batch: BatchInput,  # lanes PRE-SORTED by slot ascending (host sorts)
+    now_ms: jax.Array,
+):
+    """Compute half of the sorted columnar step: gathers + bucket math,
+    NO state writes.  Pair with `scatter_store` (donated) — the split
+    keeps the in-place scatter free of full-capacity copy-insertion
+    (see `_scatter_values`)."""
+    vals, resp_status, resp_rem, resp_reset = _compute_update(
+        state,
+        state.occupied,
+        batch.slot,
+        batch.algo,
+        batch.behavior,
+        batch.hits,
+        batch.limit,
+        batch.duration,
+        batch.burst,
+        batch.greg_duration,
+        batch.greg_expire,
+        now_ms.astype(_I64),
+    )
+    packed = jnp.concatenate(
+        [resp_status.astype(_I64), resp_rem, resp_reset]
+    )
+    return vals, packed
+
+
+compute_update_sorted = jax.jit(_compute_update_sorted_impl)
 
 
 class SlotRecord(NamedTuple):

@@ -6,6 +6,15 @@ expiry is already handled by the kernel's liveness check; this sweep
 reclaims slots of expired buckets in bulk so the host intern table can
 reuse them (SURVEY.md §7.3 item 6).
 
+Scaling (VERDICT r1 item 4): the round-1 sweep returned the full freed
+MASK, forcing an O(capacity) device→host transfer per sweep (~100MB at
+100M slots).  `sweep_expired_window` instead processes a fixed-width
+window and compacts freed indices ON DEVICE (stable argsort puts freed
+lanes first), so the host pulls one count scalar per window and then
+only `count` indices — transfer is O(freed), not O(capacity).  The
+occupied buffer is donated, so the windowed update is in-place: device
+work per call is O(window).
+
 The 64-bit `expire_at < now` compare is done on the stored (hi, lo)
 word pairs directly — combining to int64 would reintroduce the
 O(capacity) x64 boundary shim the split layout exists to avoid
@@ -14,8 +23,97 @@ O(capacity) x64 boundary shim the split layout exists to avoid
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("window",))
+def sweep_window_scan(
+    occupied: jax.Array,  # bool [..., capacity]
+    expire_hi: jax.Array,  # int32 [..., capacity]
+    expire_lo: jax.Array,  # uint32 [..., capacity]
+    now_hi: jax.Array,  # int32 scalar
+    now_lo: jax.Array,  # uint32 scalar
+    start: jax.Array,  # int32 scalar, window start (pre-clamped by host)
+    *,
+    window: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """READ-ONLY scan of `[start, start+window)` along the capacity axis.
+
+    Returns (keep_window, freed_order, count): `keep_window` is the
+    window's new occupancy values; `freed_order[..., :count]` are the
+    window-local indices of freed slots in ascending order (stable
+    argsort compaction); entries beyond `count` are arbitrary non-freed
+    lanes and must be ignored.  Pair with `sweep_window_commit` — the
+    read/write split keeps the donated commit copy-free (the fused
+    slice+update variant forced a full occupancy copy per window).
+    """
+    axis = occupied.ndim - 1
+    occ_w = lax.dynamic_slice_in_dim(occupied, start, window, axis)
+    ehi_w = lax.dynamic_slice_in_dim(expire_hi, start, window, axis)
+    elo_w = lax.dynamic_slice_in_dim(expire_lo, start, window, axis)
+    lt = (ehi_w < now_hi) | ((ehi_w == now_hi) & (elo_w < now_lo))
+    freed = occ_w & lt
+    count = jnp.sum(freed, axis=axis, dtype=jnp.int32)
+    # Compaction: freed lanes (True) sort before kept lanes, stable →
+    # ascending window-local index order.
+    order = jnp.argsort(~freed, axis=axis, stable=True).astype(jnp.int32)
+    return occ_w & ~freed, order, count
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def sweep_window_commit(
+    occupied: jax.Array,  # bool [..., capacity] (donated)
+    keep_window: jax.Array,  # bool [..., window]
+    start: jax.Array,  # int32 scalar
+) -> jax.Array:
+    """WRITE-ONLY in-place commit of a scanned window's occupancy."""
+    return lax.dynamic_update_slice_in_dim(
+        occupied, keep_window, start, occupied.ndim - 1
+    )
+
+
+def windowed_sweep(engine, cap: int, now_ms: int, max_windows, release) -> int:
+    """Drive scan/commit windows over an engine's state.
+
+    Shared by DecisionEngine.sweep and ShardedDecisionEngine.sweep (the
+    clamp/overlap/cursor-wrap logic is subtle enough to exist once).
+    `engine` supplies `_state`, `_sweep_cursor`, `SWEEP_WINDOW`; the
+    caller holds the engine lock.  `release(order, count, start) -> n`
+    frees the compacted slots in the host table(s) and returns how many.
+    """
+    window = min(cap, engine.SWEEP_WINDOW)
+    n_windows = (cap + window - 1) // window
+    if max_windows is not None:
+        n_windows = min(n_windows, max_windows)
+    now_hi = jnp.asarray(now_ms >> 32, dtype=jnp.int32)
+    now_lo = jnp.asarray(now_ms & 0xFFFFFFFF, dtype=jnp.uint32)
+    freed_total = 0
+    for _ in range(n_windows):
+        # Clamp the tail window; overlap is idempotent (slots freed
+        # earlier in this pass are no longer occupied).
+        start = min(engine._sweep_cursor, cap - window)
+        start_dev = jnp.asarray(start, dtype=jnp.int32)
+        keep_w, order, count = sweep_window_scan(
+            engine._state.occupied,
+            engine._state.expire_hi,
+            engine._state.expire_lo,
+            now_hi,
+            now_lo,
+            start_dev,
+            window=window,
+        )
+        engine._state = engine._state._replace(
+            occupied=sweep_window_commit(engine._state.occupied, keep_w, start_dev)
+        )
+        freed_total += release(order, count, start)
+        engine._sweep_cursor += window
+        if engine._sweep_cursor >= cap:
+            engine._sweep_cursor = 0
+    return freed_total
 
 
 @jax.jit
@@ -26,7 +124,10 @@ def sweep_expired(
     now_hi: jax.Array,  # int32 scalar
     now_lo: jax.Array,  # uint32 scalar
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (new_occupied, freed_mask)."""
+    """Full-capacity sweep returning (new_occupied, freed_mask).
+
+    Kept for small-capacity callers and tests; production engines use
+    the windowed compaction above."""
     lt = (expire_hi < now_hi) | ((expire_hi == now_hi) & (expire_lo < now_lo))
     freed = occupied & lt
     return occupied & ~freed, freed

@@ -16,7 +16,17 @@ from gubernator_tpu.core.engine import DecisionEngine
 from gubernator_tpu.ops import bucket_kernel as bk
 from gubernator_tpu.types import Algorithm, RateLimitReq
 
-_KERNELS = (bk.apply_batch, bk.apply_batch_sorted, bk.clear_occupied)
+# The serving programs: dataclass path (apply_batch), columnar path
+# (compute_update_sorted + scatter_store — the split pair), eviction
+# clears.  apply_batch_sorted is the unsplit single-call variant kept
+# for API compat; it is off the serving path but harmless to watch.
+_KERNELS = (
+    bk.apply_batch,
+    bk.compute_update_sorted,
+    bk.scatter_store,
+    bk.apply_batch_sorted,
+    bk.clear_occupied,
+)
 
 
 def _cache_sizes():
