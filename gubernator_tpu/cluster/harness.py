@@ -18,7 +18,7 @@ from gubernator_tpu.daemon import Daemon, spawn_daemon
 from gubernator_tpu.types import PeerInfo
 
 
-def test_behaviors() -> BehaviorConfig:
+def cluster_behaviors() -> BehaviorConfig:
     """Cluster-test knobs (reference: cluster/cluster.go:109-115 tunes
     GlobalSyncWait etc. for fast tests)."""
     return BehaviorConfig(
@@ -38,7 +38,7 @@ class ClusterHarness:
         self.daemons: List[Daemon] = []
         self._datacenters: List[str] = []
         self._clock: Clock = SYSTEM_CLOCK
-        self._behaviors = test_behaviors()
+        self._behaviors = cluster_behaviors()
         self._cache_size = 5_000
 
     # -- startup -------------------------------------------------------

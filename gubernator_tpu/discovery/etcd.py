@@ -26,21 +26,30 @@ LEASE_TTL_S = 30  # reference: etcd.go:35 (etcdTTL)
 
 
 class EtcdPool(DiscoveryBase):
-    def __init__(self, conf: "DaemonConfig", daemon: "Daemon"):
+    def __init__(
+        self,
+        conf: "DaemonConfig",
+        daemon: "Daemon",
+        *,
+        client=None,  # injectable for tests (any etcd3-shaped client)
+        keepalive_interval: float = LEASE_TTL_S / 3,
+    ):
         super().__init__(daemon)
-        try:
-            import etcd3  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "etcd discovery requires the 'etcd3' package, which is "
-                "not installed in this environment; use member-list or "
-                "dns discovery instead"
-            ) from e
-        import etcd3
+        if client is None:
+            try:
+                import etcd3
+            except ImportError as e:
+                raise RuntimeError(
+                    "etcd discovery requires the 'etcd3' package, which is "
+                    "not installed in this environment; use member-list or "
+                    "dns discovery instead"
+                ) from e
 
-        endpoint = (conf.etcd_endpoints or ["localhost:2379"])[0]
-        host, _, port = endpoint.rpartition(":")
-        self._client = etcd3.client(host=host or "localhost", port=int(port or 2379))
+            endpoint = (conf.etcd_endpoints or ["localhost:2379"])[0]
+            host, _, port = endpoint.rpartition(":")
+            client = etcd3.client(host=host or "localhost", port=int(port or 2379))
+        self._client = client
+        self.keepalive_interval = keepalive_interval
         self.key_prefix = conf.etcd_key_prefix
         self._lease = None
         self._watch_id = None
@@ -69,7 +78,7 @@ class EtcdPool(DiscoveryBase):
         )
 
     def _keepalive_loop(self) -> None:
-        while not self._closed.wait(LEASE_TTL_S / 3):
+        while not self._closed.wait(self.keepalive_interval):
             try:
                 if self._lease is not None:
                     self._lease.refresh()

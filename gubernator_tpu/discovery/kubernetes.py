@@ -23,22 +23,43 @@ if TYPE_CHECKING:
 
 
 class K8sPool(DiscoveryBase):
-    def __init__(self, conf: "DaemonConfig", daemon: "Daemon"):
+    def __init__(
+        self,
+        conf: "DaemonConfig",
+        daemon: "Daemon",
+        *,
+        core_api=None,  # injectable for tests (CoreV1Api-shaped)
+        watch_factory=None,  # injectable for tests (kubernetes.watch.Watch-shaped)
+    ):
         super().__init__(daemon)
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "k8s discovery requires the 'kubernetes' package, which "
-                "is not installed in this environment; use member-list "
-                "or dns discovery instead"
-            ) from e
+        if core_api is None:
+            try:
+                import kubernetes  # noqa: F401
+            except ImportError as e:
+                raise RuntimeError(
+                    "k8s discovery requires the 'kubernetes' package, which "
+                    "is not installed in this environment; use member-list "
+                    "or dns discovery instead"
+                ) from e
+            from kubernetes import client, config as k8s_config
+
+            k8s_config.load_incluster_config()
+            core_api = client.CoreV1Api()
+        if watch_factory is None:
+            # Resolve here, not in the watch thread — an ImportError
+            # there would kill the loop silently with no peer pushes.
+            try:
+                from kubernetes import watch as k8s_watch
+            except ImportError as e:
+                raise RuntimeError(
+                    "k8s discovery requires the 'kubernetes' package "
+                    "(watch); inject watch_factory= for tests"
+                ) from e
+            watch_factory = k8s_watch.Watch
         import os
 
-        from kubernetes import client, config as k8s_config
-
-        k8s_config.load_incluster_config()
-        self._core = client.CoreV1Api()
+        self._core = core_api
+        self._watch_factory = watch_factory
         self.namespace = os.environ.get("GUBER_K8S_NAMESPACE", "default")
         self.selector = os.environ.get("GUBER_K8S_POD_SELECTOR", "app=gubernator")
         self.grpc_port = daemon.grpc_address.rpartition(":")[2]
@@ -70,12 +91,10 @@ class K8sPool(DiscoveryBase):
         return peers
 
     def _watch_loop(self) -> None:
-        from kubernetes import watch
-
         while not self._closed.is_set():
             try:
                 self.on_update(self._list_peers())
-                w = watch.Watch()
+                w = self._watch_factory()
                 for _ in w.stream(
                     self._core.list_namespaced_pod,
                     self.namespace,

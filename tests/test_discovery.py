@@ -10,7 +10,7 @@ import time
 import pytest
 
 from gubernator_tpu.client import V1Client
-from gubernator_tpu.cluster.harness import test_behaviors
+from gubernator_tpu.cluster.harness import cluster_behaviors
 from gubernator_tpu.config import DaemonConfig
 from gubernator_tpu.daemon import spawn_daemon
 from gubernator_tpu.types import RateLimitReq
@@ -29,7 +29,7 @@ def _daemon_conf(known_hosts):
     return DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
-        behaviors=test_behaviors(),
+        behaviors=cluster_behaviors(),
         cache_size=2_000,
         peer_discovery_type="member-list",
         member_list_address="127.0.0.1:0",

@@ -172,14 +172,14 @@ def test_daemon_periodic_sweep(frozen_clock):
     """The daemon's background sweeper reclaims expired slots."""
     import time
 
-    from gubernator_tpu.cluster.harness import test_behaviors
+    from gubernator_tpu.cluster.harness import cluster_behaviors
     from gubernator_tpu.config import DaemonConfig
     from gubernator_tpu.daemon import spawn_daemon
 
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
-        behaviors=test_behaviors(),
+        behaviors=cluster_behaviors(),
         cache_size=1000,
         device_count=1,
         sweep_interval=0.2,
@@ -232,7 +232,7 @@ def test_daemon_loader_integration(tmp_path, frozen_clock):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    from gubernator_tpu.cluster.harness import test_behaviors
+    from gubernator_tpu.cluster.harness import cluster_behaviors
     from gubernator_tpu.config import DaemonConfig
     from gubernator_tpu.daemon import spawn_daemon
     from gubernator_tpu.client import V1Client
@@ -241,7 +241,7 @@ def test_daemon_loader_integration(tmp_path, frozen_clock):
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
-        behaviors=test_behaviors(),
+        behaviors=cluster_behaviors(),
         cache_size=1000,
         device_count=1,
     )

@@ -12,7 +12,7 @@ import grpc
 import pytest
 
 from gubernator_tpu.client import V1Client
-from gubernator_tpu.cluster.harness import test_behaviors
+from gubernator_tpu.cluster.harness import cluster_behaviors
 from gubernator_tpu.config import DaemonConfig
 from gubernator_tpu.daemon import spawn_daemon
 from gubernator_tpu.net.tls import (
@@ -60,7 +60,7 @@ def tls_daemon():
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
-        behaviors=test_behaviors(),
+        behaviors=cluster_behaviors(),
         cache_size=1000,
         device_count=1,
         tls=TLSConfig(auto_tls=True, auto_tls_hosts=["127.0.0.1"]),
@@ -111,7 +111,7 @@ def test_mtls_cluster():
         return DaemonConfig(
             grpc_listen_address="127.0.0.1:0",
             http_listen_address="127.0.0.1:0",
-            behaviors=test_behaviors(),
+            behaviors=cluster_behaviors(),
             cache_size=1000,
             device_count=1,
             tls=TLSConfig(
