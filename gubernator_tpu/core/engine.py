@@ -41,9 +41,12 @@ from gubernator_tpu.ops.bucket_kernel import (
     SlotRecord,
     apply_batch,
     clear_occupied,
-    compute_update_sorted,
+    fused_step,
+    fused_step_ok,
     load_slots,
     make_state,
+    pack_batch_host,
+    packed_compute,
     scatter_store,
 )
 from gubernator_tpu.ops.expiry import windowed_sweep
@@ -102,27 +105,31 @@ class PendingColumnar:
     def get(self):
         if self._result is not None:
             return self._result
+        from gubernator_tpu.ops.bucket_kernel import unpack_out_host
+
         n = self._n
         o_status = np.empty(n, dtype=np.int32)
         o_remaining = np.empty(n, dtype=_I64)
         o_reset = np.empty(n, dtype=_I64)
-        for packed, dst_idx, m, size in self._pieces:
+        for packed, dst_idx, m, _size in self._pieces:
             arr = np.asarray(packed)  # one transfer per piece
             if isinstance(dst_idx, list):
-                # Sharded piece: arr is [n_shards, 3*size]; dst_idx/m
-                # are per-shard request-index rows / lane counts.
+                # Sharded piece: arr is [n_shards, PACKED_OUT_ROWS,
+                # width]; dst_idx/m are per-shard request-index rows /
+                # lane counts.
                 for sh, idxs in enumerate(dst_idx):
                     mm = m[sh]
                     if mm == 0:
                         continue
-                    row = arr[sh]
-                    o_status[idxs] = row[:mm]
-                    o_remaining[idxs] = row[size : size + mm]
-                    o_reset[idxs] = row[2 * size : 2 * size + mm]
+                    st, rem, rst = unpack_out_host(arr[sh], mm)
+                    o_status[idxs] = st
+                    o_remaining[idxs] = rem
+                    o_reset[idxs] = rst
             else:
-                o_status[dst_idx] = arr[:m]
-                o_remaining[dst_idx] = arr[size : size + m]
-                o_reset[dst_idx] = arr[2 * size : 2 * size + m]
+                st, rem, rst = unpack_out_host(arr, m)
+                o_status[dst_idx] = st
+                o_remaining[dst_idx] = rem
+                o_reset[dst_idx] = rst
         over = int(np.sum(o_status == int(Status.OVER_LIMIT)))
         with self._engine._lock:
             # Counted at materialization; a dropped PendingColumnar
@@ -133,6 +140,116 @@ class PendingColumnar:
         self._result = (o_status, self._limit, o_remaining, o_reset)
         self._pieces = ()
         return self._result
+
+
+def write_through_store(
+    store,
+    requests: Sequence[RateLimitReq],
+    valid_idx: List[int],
+    greg_dur: np.ndarray,
+    now_ms: int,
+    responses: List[Optional[RateLimitResp]],
+    expire_of: dict,
+) -> None:
+    """Store.OnChange per touched key, values derived from the response
+    (see gubernator_tpu.store docstring for the leaky precision
+    caveat).  Shared by both engines.
+    reference: algorithms.go:164-169,266-269.
+    """
+    from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
+
+    for i in valid_idx:
+        r = requests[i]
+        resp = responses[i]
+        if resp is None or resp.error:
+            continue
+        key = r.hash_key()
+        greg = bool(int(r.behavior) & Behavior.DURATION_IS_GREGORIAN)
+        dur = int(greg_dur[i]) if greg else r.duration
+        if int(r.algorithm) == int(Algorithm.TOKEN_BUCKET):
+            if int(r.behavior) & Behavior.RESET_REMAINING:
+                # reference: algorithms.go:83-97 (remove then recreate).
+                store.remove(key)
+            value = TokenBucketItem(
+                status=int(resp.status),
+                limit=resp.limit,
+                duration=dur,
+                remaining=resp.remaining,
+                created_at=now_ms if greg else resp.reset_time - dur,
+            )
+        else:
+            value = LeakyBucketItem(
+                limit=resp.limit,
+                duration=dur,
+                remaining=float(resp.remaining),
+                updated_at=now_ms,
+                burst=r.burst,
+            )
+        store.on_change(
+            r,
+            CacheItem(
+                key=key,
+                value=value,
+                expire_at=int(expire_of[i]),
+                algorithm=int(r.algorithm),
+            ),
+        )
+
+
+def build_restore_record(
+    restores: List[tuple], capacity: int, size: Optional[int] = None
+) -> dict:
+    """Build SlotRecord columns hydrating store-provided CacheItems
+    into fresh slots; `restores` = [(slot, CacheItem)], slots unique.
+    Returns the dict of [size] numpy columns (padding lanes carry
+    distinct ascending out-of-range slots: capacity + lane).
+    reference: the Store.Get read-through of algorithms.go:46-54."""
+    from gubernator_tpu.store import LeakyBucketItem, TokenBucketItem, words_from_float
+
+    restores = sorted(restores, key=lambda r: r[0])
+    n = len(restores)
+    if size is None:
+        size = _pad_size(n, floor=16)
+    rec = {
+        "slot": np.arange(capacity, capacity + size, dtype=np.int64).astype(_I32),
+        "algo": np.zeros(size, dtype=_I32),
+        "status": np.zeros(size, dtype=_I32),
+        "limit": np.zeros(size, dtype=_I64),
+        "remaining": np.zeros(size, dtype=_I64),
+        "remf_hi": np.zeros(size, dtype=_I32),
+        "remf_lo": np.zeros(size, dtype=np.uint32),
+        "duration": np.zeros(size, dtype=_I64),
+        "t0": np.zeros(size, dtype=_I64),
+        "expire_at": np.zeros(size, dtype=_I64),
+        "burst": np.zeros(size, dtype=_I64),
+        "invalid_at": np.zeros(size, dtype=_I64),
+    }
+    for lane, (slot, item) in enumerate(restores):
+        v = item.value
+        rec["slot"][lane] = slot
+        rec["expire_at"][lane] = item.expire_at
+        rec["invalid_at"][lane] = item.invalid_at
+        if isinstance(v, TokenBucketItem):
+            rec["algo"][lane] = int(Algorithm.TOKEN_BUCKET)
+            rec["status"][lane] = v.status
+            rec["limit"][lane] = v.limit
+            rec["remaining"][lane] = v.remaining
+            rec["duration"][lane] = v.duration
+            rec["t0"][lane] = v.created_at
+        elif isinstance(v, LeakyBucketItem):
+            rec["algo"][lane] = int(Algorithm.LEAKY_BUCKET)
+            rec["limit"][lane] = v.limit
+            w = (
+                v.remaining_words
+                if v.remaining_words is not None
+                else words_from_float(v.remaining)
+            )
+            rec["remf_hi"][lane] = w[0]
+            rec["remf_lo"][lane] = np.uint32(w[1])
+            rec["duration"][lane] = v.duration
+            rec["t0"][lane] = v.updated_at
+            rec["burst"][lane] = v.burst
+    return rec
 
 
 class DecisionEngine:
@@ -177,6 +294,11 @@ class DecisionEngine:
             )
         self._lock = threading.Lock()
         self._sweep_cursor = 0  # next window start for incremental sweep
+        # ONE device op per round when XLA compiles the donated
+        # gather→update→scatter in place; otherwise the split pair
+        # (packed_compute + scatter_store, two ops) — probed once per
+        # capacity via XLA's memory analysis (see fused_step_ok).
+        self._fused = fused_step_ok(capacity)
         # Metrics (reference: gubernator.go:59-113 catalog; wired to
         # prometheus in gubernator_tpu.utils.metrics).
         self.requests_total = 0
@@ -318,6 +440,17 @@ class DecisionEngine:
                 requests, valid_idx, greg_dur, now_ms, responses, host_expire
             )
 
+    def _dispatch_packed(self, buf: np.ndarray):
+        """Run one packed round on device; returns the packed output
+        (device array, caller starts the async readback)."""
+        pin = jnp.asarray(buf)  # the round's single h2d transfer
+        if self._fused:
+            self._state, pout = fused_step(self._state, pin)
+        else:
+            slot_dev, vals, pout = packed_compute(self._state, pin)
+            self._state = scatter_store(self._state, slot_dev, vals)
+        return pout
+
     def _apply_clears(self, cleared: np.ndarray) -> None:
         """Eviction clears: a separate tiny scatter so the apply
         kernel's compiled shapes never depend on eviction pressure."""
@@ -331,58 +464,9 @@ class DecisionEngine:
         )
 
     def _apply_restores(self, restores: List[tuple]) -> None:
-        """Hydrate store-provided bucket values into fresh slots.
-
-        reference: the Store.Get read-through path of
-        algorithms.go:46-54 — here it is one batched device scatter."""
-        restores = sorted(restores, key=lambda r: r[0])
-        n = len(restores)
-        size = _pad_size(n, floor=16)
-        rec = {
-            "slot": np.arange(
-                self.capacity, self.capacity + size, dtype=np.int64
-            ).astype(_I32),
-            "algo": np.zeros(size, dtype=_I32),
-            "status": np.zeros(size, dtype=_I32),
-            "limit": np.zeros(size, dtype=_I64),
-            "remaining": np.zeros(size, dtype=_I64),
-            "remf_hi": np.zeros(size, dtype=_I32),
-            "remf_lo": np.zeros(size, dtype=np.uint32),
-            "duration": np.zeros(size, dtype=_I64),
-            "t0": np.zeros(size, dtype=_I64),
-            "expire_at": np.zeros(size, dtype=_I64),
-            "burst": np.zeros(size, dtype=_I64),
-            "invalid_at": np.zeros(size, dtype=_I64),
-        }
-        from gubernator_tpu.store import LeakyBucketItem, TokenBucketItem
-
-        for lane, (slot, item) in enumerate(restores):
-            v = item.value
-            rec["slot"][lane] = slot
-            rec["expire_at"][lane] = item.expire_at
-            rec["invalid_at"][lane] = item.invalid_at
-            if isinstance(v, TokenBucketItem):
-                rec["algo"][lane] = int(Algorithm.TOKEN_BUCKET)
-                rec["status"][lane] = v.status
-                rec["limit"][lane] = v.limit
-                rec["remaining"][lane] = v.remaining
-                rec["duration"][lane] = v.duration
-                rec["t0"][lane] = v.created_at
-            elif isinstance(v, LeakyBucketItem):
-                rec["algo"][lane] = int(Algorithm.LEAKY_BUCKET)
-                rec["limit"][lane] = v.limit
-                from gubernator_tpu.store import words_from_float
-
-                w = (
-                    v.remaining_words
-                    if v.remaining_words is not None
-                    else words_from_float(v.remaining)
-                )
-                rec["remf_hi"][lane] = w[0]
-                rec["remf_lo"][lane] = np.uint32(w[1])
-                rec["duration"][lane] = v.duration
-                rec["t0"][lane] = v.updated_at
-                rec["burst"][lane] = v.burst
+        """Hydrate store-provided bucket values into fresh slots —
+        one batched device scatter (see build_restore_record)."""
+        rec = build_restore_record(restores, self.capacity)
         self._state = load_slots(
             self._state,
             SlotRecord(**{k: jnp.asarray(a) for k, a in rec.items()}),
@@ -397,48 +481,15 @@ class DecisionEngine:
         responses: List[Optional[RateLimitResp]],
         host_expire: np.ndarray,
     ) -> None:
-        """Store.OnChange per touched key, values derived from the
-        response (see gubernator_tpu.store docstring for the leaky
-        precision caveat).  reference: algorithms.go:164-169,266-269.
-        """
-        from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
-
-        for j, i in enumerate(valid_idx):
-            r = requests[i]
-            resp = responses[i]
-            if resp is None or resp.error:
-                continue
-            key = r.hash_key()
-            greg = bool(int(r.behavior) & Behavior.DURATION_IS_GREGORIAN)
-            dur = int(greg_dur[i]) if greg else r.duration
-            if int(r.algorithm) == int(Algorithm.TOKEN_BUCKET):
-                if int(r.behavior) & Behavior.RESET_REMAINING:
-                    # reference: algorithms.go:83-97 (remove then recreate).
-                    self.store.remove(key)
-                value = TokenBucketItem(
-                    status=int(resp.status),
-                    limit=resp.limit,
-                    duration=dur,
-                    remaining=resp.remaining,
-                    created_at=now_ms if greg else resp.reset_time - dur,
-                )
-            else:
-                value = LeakyBucketItem(
-                    limit=resp.limit,
-                    duration=dur,
-                    remaining=float(resp.remaining),
-                    updated_at=now_ms,
-                    burst=r.burst,
-                )
-            self.store.on_change(
-                r,
-                CacheItem(
-                    key=key,
-                    value=value,
-                    expire_at=int(host_expire[j]),
-                    algorithm=int(r.algorithm),
-                ),
-            )
+        write_through_store(
+            self.store,
+            requests,
+            valid_idx,
+            greg_dur,
+            now_ms,
+            responses,
+            {i: int(host_expire[j]) for j, i in enumerate(valid_idx)},
+        )
 
     def _run_round(
         self,
@@ -660,12 +711,13 @@ class DecisionEngine:
 
         # Dispatch: host presorts each chunk by slot (the sort the
         # device kernel would otherwise pay a sorting network for),
-        # sends it through the sort-free kernel, and starts an async
-        # copy of the packed outputs.  Materialization happens in
+        # packs the whole round into ONE int32 buffer (one h2d op on a
+        # dispatch-bound backend — see bucket_kernel PACKED_IN_ROWS),
+        # runs the fused (or split) kernel, and starts an async copy of
+        # the packed outputs.  Materialization happens in
         # PendingColumnar.get(), so the caller can overlap this batch's
         # readback with the next batch's dispatch.
         pieces: List[tuple] = []
-        now_dev = jnp.asarray(now_ms, dtype=jnp.int64)
         for k, members in round_members:
             cleared = clear_by_round.get(k)
             if cleared:
@@ -686,46 +738,23 @@ class DecisionEngine:
                 hi = min(lo + self.max_kernel_width, m_total)
                 m = hi - lo
                 size = _pad_size(m)
-                pad = size - m
                 sort_idx = np.argsort(c_slot[lo:hi], kind="stable")
-
-                def col(arr, dtype):
-                    sorted_vals = arr[lo:hi][sort_idx]
-                    if pad == 0:
-                        return np.ascontiguousarray(sorted_vals, dtype=dtype)
-                    out = np.zeros(size, dtype=dtype)
-                    out[:m] = sorted_vals
-                    return out
-
-                p_slot = col(c_slot, _I32)
-                if pad:
-                    p_slot[m:] = np.arange(
-                        self.capacity, self.capacity + pad, dtype=np.int64
-                    ).astype(_I32)
-                batch = BatchInput(
-                    slot=jnp.asarray(p_slot),
-                    algo=jnp.asarray(col(cols[0], _I32)),
-                    behavior=jnp.asarray(col(cols[1], _I32)),
-                    hits=jnp.asarray(col(cols[2], _I64)),
-                    limit=jnp.asarray(col(cols[3], _I64)),
-                    duration=jnp.asarray(col(cols[4], _I64)),
-                    burst=jnp.asarray(col(cols[5], _I64)),
-                    greg_duration=jnp.asarray(col(cols[6], _I64)),
-                    greg_expire=jnp.asarray(col(cols[7], _I64)),
+                buf = pack_batch_host(
+                    size,
+                    now_ms,
+                    self.capacity,
+                    np.ascontiguousarray(c_slot[lo:hi][sort_idx], dtype=_I32),
+                    *(a[lo:hi][sort_idx] for a in cols),
                 )
-                # Split kernel: read-only compute, then donated
-                # write-only scatter — in-place, O(batch) not
-                # O(capacity) (see bucket_kernel._scatter_values).
-                vals, packed = compute_update_sorted(self._state, batch, now_dev)
-                self._state = scatter_store(self._state, batch.slot, vals)
-                packed.copy_to_host_async()
+                pout = self._dispatch_packed(buf)
+                pout.copy_to_host_async()
                 self.rounds_total += 1
                 # Request indices of the sorted lanes, for unpermuting.
                 if members is None:
                     dst_idx = sort_idx + lo if lo else sort_idx
                 else:
                     dst_idx = members[lo:hi][sort_idx]
-                pieces.append((packed, dst_idx, m, size))
+                pieces.append((pout, dst_idx, m, size))
 
         expires = np.where(greg_mask, greg_exp, now_ms + duration)
         self.table.set_expiry(slots, expires.astype(_I64))
@@ -849,67 +878,73 @@ class DecisionEngine:
         # Warmup traffic must not reach a write-through Store (it would
         # persist junk __warmup__ keys and pay external round-trips).
         saved_store, self.store = self.store, None
-        now = self.clock.now_ms()
-        width = 64
-        while width <= max_width:
-            reqs = [
-                RateLimitReq(
-                    name="__warmup__",
-                    unique_key=str(i),
-                    hits=0,
-                    limit=1,
-                    duration=1,
+        try:
+            now = self.clock.now_ms()
+            width = 64
+            while width <= max_width:
+                reqs = [
+                    RateLimitReq(
+                        name="__warmup__",
+                        unique_key=str(i),
+                        hits=0,
+                        limit=1,
+                        duration=1,
+                    )
+                    for i in range(width)
+                ]
+                self.get_rate_limits(reqs, now_ms=now)
+                width *= 2
+            # Columnar-kernel ladder: the wire/bench fast path runs the
+            # packed columnar step, a DIFFERENT jitted program than
+            # apply_batch — without this ladder the first served
+            # columnar batch pays an XLA compile that can exceed the
+            # peer batch timeout ("timeout waiting for batched
+            # response").
+            width = 64
+            while width <= max_width:
+                self.apply_columnar(
+                    [b"__warmup___%d" % i for i in range(width)],
+                    np.zeros(width, dtype=_I32),
+                    np.zeros(width, dtype=_I32),
+                    np.zeros(width, dtype=_I64),  # hits=0: report-only
+                    np.ones(width, dtype=_I64),
+                    np.ones(width, dtype=_I64),
+                    np.zeros(width, dtype=_I64),
+                    now_ms=now,
                 )
-                for i in range(width)
-            ]
-            self.get_rate_limits(reqs, now_ms=now)
-            width *= 2
-        # Columnar-kernel ladder: the wire/bench fast path runs
-        # apply_batch_sorted, a DIFFERENT jitted program than
-        # apply_batch — without this ladder the first served columnar
-        # batch pays an XLA compile that can exceed the peer batch
-        # timeout (seen as "timeout waiting for batched response").
-        width = 64
-        while width <= max_width:
-            self.apply_columnar(
-                [b"__warmup___%d" % i for i in range(width)],
-                np.zeros(width, dtype=_I32),
-                np.zeros(width, dtype=_I32),
-                np.zeros(width, dtype=_I64),  # hits=0: report-only
-                np.ones(width, dtype=_I64),
-                np.ones(width, dtype=_I64),
-                np.zeros(width, dtype=_I64),
-                now_ms=now,
-            )
-            width *= 2
-        # Clear-scatter ladder (no-op out-of-range slots).
-        csize = 16
-        while csize <= max_width:
-            dummy = jnp.asarray(
-                np.arange(self.capacity, self.capacity + csize, dtype=np.int64).astype(_I32)
-            )
-            self._state = self._state._replace(
-                occupied=clear_occupied(self._state.occupied, dummy)
-            )
-            csize *= 2
-        self.sweep(now_ms=now + 2)
-        (
-            self.requests_total,
-            self.batches_total,
-            self.rounds_total,
-            saved_hits,
-            saved_misses,
-        ) = saved
-        if hasattr(self.table, "discount_stats"):
-            # The native table mirrors cumulative C++ counters on every
-            # schedule(); plain attribute restore would be overwritten
-            # by the next mirror, so register discounts instead.
-            self.table.discount_stats(
-                self.table.hits - saved_hits, self.table.misses - saved_misses
-            )
-        else:
-            self.table.hits, self.table.misses = saved_hits, saved_misses
-        self.store = saved_store
+                width *= 2
+            # Clear-scatter ladder (no-op out-of-range slots).
+            csize = 16
+            while csize <= max_width:
+                dummy = jnp.asarray(
+                    np.arange(self.capacity, self.capacity + csize, dtype=np.int64).astype(_I32)
+                )
+                self._state = self._state._replace(
+                    occupied=clear_occupied(self._state.occupied, dummy)
+                )
+                csize *= 2
+            self.sweep(now_ms=now + 2)
+            (
+                self.requests_total,
+                self.batches_total,
+                self.rounds_total,
+                saved_hits,
+                saved_misses,
+            ) = saved
+            if hasattr(self.table, "discount_stats"):
+                # The native table mirrors cumulative C++ counters on
+                # every schedule(); plain attribute restore would be
+                # overwritten by the next mirror, so register discounts
+                # instead.
+                self.table.discount_stats(
+                    self.table.hits - saved_hits, self.table.misses - saved_misses
+                )
+            else:
+                self.table.hits, self.table.misses = saved_hits, saved_misses
+        finally:
+            # Exception-safety: a failed warmup (wedged backend,
+            # compile error) must not leave persistence disabled.
+            self.store = saved_store
 
     def cache_size(self) -> int:
         return len(self.table)

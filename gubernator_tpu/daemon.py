@@ -74,12 +74,6 @@ class Daemon:
         devices = jax.devices()
         n = self.conf.device_count or len(devices)
         if n > 1:
-            if self._store is not None:
-                raise ValueError(
-                    "a write-through Store requires a single-device "
-                    "engine (set GUBER_DEVICE_COUNT=1); the sharded "
-                    "engine supports bulk Loader persistence only"
-                )
             from gubernator_tpu.parallel.mesh import make_mesh
             from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
 
@@ -88,6 +82,7 @@ class Daemon:
                 shard_capacity=max(1, self.conf.cache_size // n),
                 mesh=mesh,
                 clock=self.clock,
+                store=self._store,
             )
         from gubernator_tpu.core.engine import DecisionEngine
 

@@ -669,6 +669,209 @@ def _compute_update_sorted_impl(
 compute_update_sorted = jax.jit(_compute_update_sorted_impl)
 
 
+# ---------------------------------------------------------------------------
+# Packed single-transfer step — the serving fast path.
+#
+# Measured on the tunneled TPU backend (scripts/profile_dispatch.py,
+# PERF.md): every device operation — transfer or kernel, any size —
+# costs a near-constant dispatch overhead that dwarfs the actual
+# HBM/compute time of an 8k-lane step.  The columnar path therefore
+# packs the WHOLE request round into ONE int32 [PACKED_IN_ROWS, B]
+# host buffer (one h2d op), runs ONE (or two, see below) kernels, and
+# reads back ONE int32 [PACKED_OUT_ROWS, B] buffer.  Layout:
+#
+#   row 0      header: [now_hi, now_lo, 0, ...]   (now_ms int64 words)
+#   row 1      slot    (int32; sorted ascending; padding = cap + lane)
+#   row 2      algo    row 3   behavior
+#   rows 4-5   hits    rows 6-7   limit     rows 8-9  duration
+#   rows 10-11 burst   rows 12-13 greg_dur  rows 14-15 greg_exp
+#   (64-bit fields as (hi, lo) int32 word rows)
+#
+# Output rows: 0 status, 1-2 remaining (hi, lo), 3-4 reset_time.
+# The request `limit` is echoed host-side (the kernel's limit output
+# is always the request limit), so it is not read back.
+
+PACKED_IN_ROWS = 16
+PACKED_OUT_ROWS = 5
+
+
+def _row64(pin: jax.Array, hi_row: int, lo_row: int) -> jax.Array:
+    """Recombine (hi, lo) int32 word rows into int64 (two's complement)."""
+    return (pin[hi_row].astype(_I64) << 32) | (pin[lo_row].astype(_I64) & 0xFFFFFFFF)
+
+
+def _unpack_in(pin: jax.Array) -> tuple[BatchInput, jax.Array]:
+    batch = BatchInput(
+        slot=pin[1],
+        algo=pin[2],
+        behavior=pin[3],
+        hits=_row64(pin, 4, 5),
+        limit=_row64(pin, 6, 7),
+        duration=_row64(pin, 8, 9),
+        burst=_row64(pin, 10, 11),
+        greg_duration=_row64(pin, 12, 13),
+        greg_expire=_row64(pin, 14, 15),
+    )
+    now = (pin[0, 0].astype(_I64) << 32) | (pin[0, 1].astype(_I64) & 0xFFFFFFFF)
+    return batch, now
+
+
+def _pack_out(status: jax.Array, rem: jax.Array, reset: jax.Array) -> jax.Array:
+    # int64→int32 astype truncates to the low word (numpy/XLA C-cast
+    # semantics) — exactly the bit split the host recombines.
+    return jnp.stack(
+        [
+            status.astype(_I32),
+            (rem >> 32).astype(_I32),
+            rem.astype(_I32),
+            (reset >> 32).astype(_I32),
+            reset.astype(_I32),
+        ]
+    )
+
+
+def pack_batch_host(
+    size: int,
+    now_ms: int,
+    capacity: int,
+    slot_sorted: np.ndarray,  # int32 [m] sorted ascending
+    algo: np.ndarray,
+    behavior: np.ndarray,
+    hits: np.ndarray,
+    limit: np.ndarray,
+    duration: np.ndarray,
+    burst: np.ndarray,
+    greg_duration: np.ndarray,
+    greg_expire: np.ndarray,
+    out: np.ndarray | None = None,  # reusable [PACKED_IN_ROWS, size] int32
+) -> np.ndarray:
+    """Build the packed input buffer on the host (vectorized numpy).
+
+    Lanes beyond `len(slot_sorted)` are padding: distinct ascending
+    out-of-range slots, zero fields."""
+    m = len(slot_sorted)
+    if out is None:
+        out = np.zeros((PACKED_IN_ROWS, size), dtype=np.int32)
+    else:
+        out[:, m:] = 0
+    out[0, 0] = (np.int64(now_ms) >> 32).astype(np.int32)
+    out[0, 1] = np.int64(now_ms).astype(np.int32)  # low-word bit pattern
+    out[1, :m] = slot_sorted
+    if size > m:
+        out[1, m:] = (
+            np.arange(capacity, capacity + (size - m), dtype=np.int64)
+            .astype(np.int32)
+        )
+    out[2, :m] = algo
+    out[3, :m] = behavior
+
+    def w64(hi_row, lo_row, col):
+        c = col.astype(np.int64, copy=False)
+        out[hi_row, :m] = (c >> 32).astype(np.int32)
+        out[lo_row, :m] = c.astype(np.int32)  # low-word bit pattern
+
+    w64(4, 5, hits)
+    w64(6, 7, limit)
+    w64(8, 9, duration)
+    w64(10, 11, burst)
+    w64(12, 13, greg_duration)
+    w64(14, 15, greg_expire)
+    return out
+
+
+def unpack_out_host(arr: np.ndarray, m: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Packed output rows → (status int32[m], remaining i64[m], reset i64[m])."""
+    status = arr[0, :m]
+    rem = (arr[1, :m].astype(np.int64) << 32) | (
+        arr[2, :m].astype(np.int64) & 0xFFFFFFFF
+    )
+    reset = (arr[3, :m].astype(np.int64) << 32) | (
+        arr[4, :m].astype(np.int64) & 0xFFFFFFFF
+    )
+    return status, rem, reset
+
+
+def _fused_step_core(state: BucketState, pin: jax.Array):
+    batch, now = _unpack_in(pin)
+    new_state, resp_status, resp_rem, resp_reset = _apply_core(
+        state,
+        state.occupied,
+        batch.slot,
+        batch.algo,
+        batch.behavior,
+        batch.hits,
+        batch.limit,
+        batch.duration,
+        batch.burst,
+        batch.greg_duration,
+        batch.greg_expire,
+        now,
+    )
+    return new_state, _pack_out(resp_status, resp_rem, resp_reset)
+
+
+# Fused gather→update→scatter with donated state: ONE device op per
+# round.  Whether XLA compiles the in-place RMW without cloning the
+# state is platform-dependent — callers MUST check `fused_step_ok()`
+# (memory_analysis probe) and fall back to the split pair below.
+fused_step = jax.jit(_fused_step_core, donate_argnums=(0,))
+
+
+def _packed_compute_core(state: BucketState, pin: jax.Array):
+    batch, now = _unpack_in(pin)
+    vals, resp_status, resp_rem, resp_reset = _compute_update(
+        state,
+        state.occupied,
+        batch.slot,
+        batch.algo,
+        batch.behavior,
+        batch.hits,
+        batch.limit,
+        batch.duration,
+        batch.burst,
+        batch.greg_duration,
+        batch.greg_expire,
+        now,
+    )
+    # `slot` is returned as a device output so the follow-up
+    # scatter_store needs no second host transfer.
+    return batch.slot, vals, _pack_out(resp_status, resp_rem, resp_reset)
+
+
+# Split pair: read-only compute (no donation) + donated write-only
+# scatter_store — two device ops, guaranteed copy-free everywhere.
+packed_compute = jax.jit(_packed_compute_core)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def fused_step_ok(capacity: int, width: int = 64) -> bool:
+    """Probe whether `fused_step` compiles to a true in-place update.
+
+    Compiles the fused program at this capacity (tiny width) and reads
+    XLA's memory analysis: if temp allocations are a fraction of the
+    state size, donation aliased the buffers and no O(capacity) copy
+    was inserted.  On backends where copy-insertion clones the state
+    (measured 18 full-capacity copies in round 1 of this build), temp
+    ≈ state size and callers must use the split pair instead."""
+    try:
+        state_sds = jax.eval_shape(lambda: make_state(capacity))
+        pin_sds = jax.ShapeDtypeStruct((PACKED_IN_ROWS, width), jnp.int32)
+        compiled = fused_step.lower(state_sds, pin_sds).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return False
+        state_bytes = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree.leaves(state_sds)
+        )
+        return int(ma.temp_size_in_bytes) < max(state_bytes // 4, 1 << 20)
+    except Exception:
+        return False
+
+
 class SlotRecord(NamedTuple):
     """Persisted bucket values for restoring slots (Store.get /
     Loader.load hydration), shape [C] per field.

@@ -73,9 +73,11 @@ class ShardedDecisionEngine:
         mesh: Optional[Mesh] = None,
         clock: Clock = SYSTEM_CLOCK,
         max_kernel_width: int = 8192,
+        store=None,  # gubernator_tpu.store.Store (write-through hooks)
     ):
         if not jax.config.jax_enable_x64:
             raise RuntimeError("gubernator_tpu requires jax x64")
+        self.store = store
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.shape[KEYS_AXIS]
         self.shard_capacity = shard_capacity
@@ -156,35 +158,24 @@ class ShardedDecisionEngine:
 
         from gubernator_tpu.ops.bucket_kernel import (
             SlotValues,
-            _compute_update,
+            _fused_step_core,
+            _packed_compute_core,
             _scatter_values,
+            fused_step_ok,
         )
 
-        def local_sorted_compute(state, batch, now):
-            # READ-ONLY half of the sort-free columnar step: host
-            # presorted each shard's lanes by slot; outputs packed
-            # [3*width] per shard so the host pays one readback for the
-            # whole mesh step.  Paired with local_scatter below — the
-            # split keeps the donated scatter free of full-capacity
-            # copy-insertion (see bucket_kernel._scatter_values).
-            state1 = _squeeze(state)
-            batch1 = _squeeze(batch)
-            vals, st, rem, rst = _compute_update(
-                state1,
-                state1.occupied,
-                batch1.slot,
-                batch1.algo,
-                batch1.behavior,
-                batch1.hits,
-                batch1.limit,
-                batch1.duration,
-                batch1.burst,
-                batch1.greg_duration,
-                batch1.greg_expire,
-                now.astype(jnp.int64),
-            )
-            packed = jnp.concatenate([st.astype(jnp.int64), rem, rst])
-            return _expand(vals), packed[None]
+        # Packed columnar mesh step (see bucket_kernel PACKED_IN_ROWS):
+        # the whole round crosses the host↔device boundary as ONE
+        # int32 [n_shards, 16, width] buffer in and ONE
+        # [n_shards, 5, width] buffer out — on a dispatch-bound backend
+        # transfer count, not bytes, is what the step pays for.
+        def local_packed_fused(state, pin):
+            new_state, pout = _fused_step_core(_squeeze(state), pin[0])
+            return _expand(new_state), pout[None]
+
+        def local_packed_compute(state, pin):
+            slot, vals, pout = _packed_compute_core(_squeeze(state), pin[0])
+            return slot[None], _expand(vals), pout[None]
 
         def local_scatter(state, slot, vals):
             return _expand(
@@ -192,18 +183,24 @@ class ShardedDecisionEngine:
             )
 
         state_specs2 = jax.tree.map(lambda _: pspec, make_state(0))
-        batch_specs2 = jax.tree.map(
-            lambda _: pspec, BatchInput(*(0,) * len(BatchInput._fields))
-        )
         vals_specs = jax.tree.map(
             lambda _: pspec, SlotValues(*(0,) * len(SlotValues._fields))
         )
-        self._step_sorted = jax.jit(
+        self._packed_fused = jax.jit(
             jax.shard_map(
-                local_sorted_compute,
+                local_packed_fused,
                 mesh=mesh,
-                in_specs=(state_specs2, batch_specs2, P()),
-                out_specs=(vals_specs, pspec),
+                in_specs=(state_specs2, pspec),
+                out_specs=(state_specs2, pspec),
+            ),
+            donate_argnums=(0,),
+        )
+        self._packed_compute = jax.jit(
+            jax.shard_map(
+                local_packed_compute,
+                mesh=mesh,
+                in_specs=(state_specs2, pspec),
+                out_specs=(pspec, vals_specs, pspec),
             )
         )
         self._step_scatter = jax.jit(
@@ -215,6 +212,29 @@ class ShardedDecisionEngine:
             ),
             donate_argnums=(0,),
         )
+        # Store read-through hydration: sharded counterpart of
+        # core.engine load_slots (one batched scatter per round).
+        from gubernator_tpu.ops.bucket_kernel import SlotRecord, _load_slots_impl
+
+        def local_load(state, rec):
+            return _expand(_load_slots_impl(_squeeze(state), _squeeze(rec)))
+
+        rec_specs = jax.tree.map(
+            lambda _: pspec, SlotRecord(*(0,) * len(SlotRecord._fields))
+        )
+        self._load_step = jax.jit(
+            jax.shard_map(
+                local_load,
+                mesh=mesh,
+                in_specs=(state_specs2, rec_specs),
+                out_specs=state_specs2,
+            ),
+            donate_argnums=(0,),
+        )
+        # The per-shard program is the same computation as the
+        # single-device fused step, so its copy-insertion behavior
+        # probes identically at shard capacity.
+        self._fused = fused_step_ok(self.shard_capacity)
         return jax.jit(stepped, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
@@ -239,6 +259,29 @@ class ShardedDecisionEngine:
         self._state = self._state._replace(
             occupied=self._clear_step(self._state.occupied, jnp.asarray(c))
         )
+
+    def _apply_shard_restores(self, restores: List[List[tuple]]) -> None:
+        """Hydrate store-provided bucket values into fresh slots on
+        every shard: ONE sharded load scatter (padded to the widest
+        shard's restore count).  reference: algorithms.go:46-54."""
+        from gubernator_tpu.core.engine import build_restore_record
+        from gubernator_tpu.ops.bucket_kernel import SlotRecord
+
+        n_sh = self.n_shards
+        cap = self.shard_capacity
+        size = _pad_size(max(len(r) for r in restores), floor=16)
+        cols: Dict[str, List[np.ndarray]] = {}
+        for sh in range(n_sh):
+            rec = build_restore_record(restores[sh], cap, size=size)
+            for name, arr in rec.items():
+                cols.setdefault(name, []).append(arr)
+        rec_stacked = SlotRecord(
+            **{
+                name: jnp.asarray(np.stack(arrs))
+                for name, arrs in cols.items()
+            }
+        )
+        self._state = self._load_step(self._state, rec_stacked)
 
     def get_rate_limits(
         self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
@@ -290,10 +333,12 @@ class ShardedDecisionEngine:
         seqs: List[Dict[int, int]] = [dict() for _ in range(n_sh)]
         rounds: Dict[int, List[List[Tuple[int, int]]]] = {}
         clear_rounds: Dict[int, List[List[int]]] = {}
+        restore_rounds: Dict[int, List[List[tuple]]] = {}
         slot_of: Dict[int, Tuple[int, int]] = {}
         for i in valid:
             key = requests[i].hash_key()
             sh = self.shard_of(key)
+            is_new = self.store is not None and not self.tables[sh].contains(key)
             evicted: List[int] = []
             slot = self.tables[sh].intern(key, now_ms, evicted)
             for es in evicted:
@@ -303,10 +348,19 @@ class ShardedDecisionEngine:
             seqs[sh][slot] = k + 1
             rounds.setdefault(k, [[] for _ in range(n_sh)])[sh].append((i, slot))
             slot_of[i] = (sh, slot)
+            if is_new:
+                # Read-through (reference: algorithms.go:46-54).
+                item = self.store.get(requests[i])
+                if item is not None and item.value is not None:
+                    restore_rounds.setdefault(k, [[] for _ in range(n_sh)])[
+                        sh
+                    ].append((slot, item))
 
+        expire_of: Dict[int, int] = {}
         for k in sorted(set(rounds) | set(clear_rounds)):
             members = rounds.get(k, [[] for _ in range(n_sh)])
             clears = clear_rounds.get(k, [[] for _ in range(n_sh)])
+            restores = restore_rounds.get(k)
             # Chunk wide rounds to bound compiled shapes.
             offset = 0
             while True:
@@ -321,11 +375,21 @@ class ShardedDecisionEngine:
                     now_ms,
                     requests,
                     responses,
+                    restores=restores if offset == 0 else None,
+                    expire_of=expire_of,
                 )
                 self.rounds_total += 1
                 offset += self.max_kernel_width
                 if all(offset >= len(m) for m in members):
                     break
+
+        if self.store is not None:
+            from gubernator_tpu.core.engine import write_through_store
+
+            write_through_store(
+                self.store, requests, valid, greg_dur, now_ms, responses,
+                expire_of,
+            )
 
     def _run_round(
         self,
@@ -336,6 +400,8 @@ class ShardedDecisionEngine:
         now_ms: int,
         requests: Sequence[RateLimitReq],
         responses: List[Optional[RateLimitResp]],
+        restores: Optional[List[List[tuple]]] = None,
+        expire_of: Optional[Dict[int, int]] = None,
     ) -> None:
         n_sh = self.n_shards
         cap = self.shard_capacity
@@ -344,6 +410,8 @@ class ShardedDecisionEngine:
         # Eviction clears run as a separate sharded scatter (own shape
         # ladder, independent of the apply step's batch width).
         self._apply_shard_clears(clears)
+        if restores is not None and any(restores):
+            self._apply_shard_restores(restores)
         csize = 16
 
         # Padding: distinct ascending out-of-range slots per shard.
@@ -384,6 +452,8 @@ class ShardedDecisionEngine:
                 )
                 host_expire[sh][0].append(slot)
                 host_expire[sh][1].append(exp)
+                if expire_of is not None:
+                    expire_of[i] = int(exp)
 
         batch = BatchInput(
             slot=jnp.asarray(b_slot),
@@ -466,83 +536,91 @@ class ShardedDecisionEngine:
             self.rounds_total,
             [(t.hits, t.misses) for t in self.tables],
         )
-        # Pre-assign keys per shard by rejection sampling once, at the
-        # largest width; smaller widths use prefixes.
-        per_shard: List[List[str]] = [[] for _ in range(self.n_shards)]
-        i = 0
-        while any(len(ks) < max_width for ks in per_shard):
-            req = RateLimitReq(name="__warmup__", unique_key=f"{i}")
-            sh = self.shard_of(req.hash_key())
-            if len(per_shard[sh]) < max_width:
-                per_shard[sh].append(req.unique_key)
-            i += 1
-        now = self.clock.now_ms()
-        width = 64
-        while width <= max_width:
-            reqs = [
-                RateLimitReq(
-                    name="__warmup__",
-                    unique_key=k,
-                    hits=0,
-                    limit=1,
-                    duration=1,
+        # Warmup traffic must not reach a write-through Store (it would
+        # persist junk __warmup__ keys and pay external round-trips).
+        saved_store, self.store = self.store, None
+        try:
+            # Pre-assign keys per shard by rejection sampling once, at the
+            # largest width; smaller widths use prefixes.
+            per_shard: List[List[str]] = [[] for _ in range(self.n_shards)]
+            i = 0
+            while any(len(ks) < max_width for ks in per_shard):
+                req = RateLimitReq(name="__warmup__", unique_key=f"{i}")
+                sh = self.shard_of(req.hash_key())
+                if len(per_shard[sh]) < max_width:
+                    per_shard[sh].append(req.unique_key)
+                i += 1
+            now = self.clock.now_ms()
+            width = 64
+            while width <= max_width:
+                reqs = [
+                    RateLimitReq(
+                        name="__warmup__",
+                        unique_key=k,
+                        hits=0,
+                        limit=1,
+                        duration=1,
+                    )
+                    for ks in per_shard
+                    for k in ks[:width]
+                ]
+                self.get_rate_limits(reqs, now_ms=now)
+                width *= 2
+            # Columnar-kernel ladder (the sorted mesh step is a different
+            # jitted program than the dataclass-path step; see
+            # DecisionEngine.warmup).  Balanced per-shard keys compile the
+            # exact [n_shards, width] padded shapes the wire path produces.
+            width = 64
+            while width <= max_width:
+                keys = [
+                    f"__warmup___{k}".encode()
+                    for ks in per_shard
+                    for k in ks[:width]
+                ]
+                n = len(keys)
+                self.apply_columnar(
+                    keys,
+                    np.zeros(n, dtype=_I32),
+                    np.zeros(n, dtype=_I32),
+                    np.zeros(n, dtype=_I64),  # hits=0: report-only
+                    np.ones(n, dtype=_I64),
+                    np.ones(n, dtype=_I64),
+                    np.zeros(n, dtype=_I64),
+                    now_ms=now,
                 )
-                for ks in per_shard
-                for k in ks[:width]
-            ]
-            self.get_rate_limits(reqs, now_ms=now)
-            width *= 2
-        # Columnar-kernel ladder (the sorted mesh step is a different
-        # jitted program than the dataclass-path step; see
-        # DecisionEngine.warmup).  Balanced per-shard keys compile the
-        # exact [n_shards, width] padded shapes the wire path produces.
-        width = 64
-        while width <= max_width:
-            keys = [
-                f"__warmup___{k}".encode()
-                for ks in per_shard
-                for k in ks[:width]
-            ]
-            n = len(keys)
-            self.apply_columnar(
-                keys,
-                np.zeros(n, dtype=_I32),
-                np.zeros(n, dtype=_I32),
-                np.zeros(n, dtype=_I64),  # hits=0: report-only
-                np.ones(n, dtype=_I64),
-                np.ones(n, dtype=_I64),
-                np.zeros(n, dtype=_I64),
-                now_ms=now,
-            )
-            width *= 2
-        csize = 16
-        cap = self.shard_capacity
-        while csize <= max_width:
-            dummy = jnp.asarray(
-                np.tile(
-                    np.arange(cap, cap + csize, dtype=_I64).astype(_I32),
-                    (self.n_shards, 1),
+                width *= 2
+            csize = 16
+            cap = self.shard_capacity
+            while csize <= max_width:
+                dummy = jnp.asarray(
+                    np.tile(
+                        np.arange(cap, cap + csize, dtype=_I64).astype(_I32),
+                        (self.n_shards, 1),
+                    )
                 )
-            )
-            self._state = self._state._replace(
-                occupied=self._clear_step(self._state.occupied, dummy)
-            )
-            csize *= 2
-        self.sweep(now_ms=now + 2)
-        (
-            self.requests_total,
-            self.batches_total,
-            self.rounds_total,
-            table_stats,
-        ) = saved
-        for t, (h, m) in zip(self.tables, table_stats):
-            if hasattr(t, "discount_stats"):
-                # Native tables re-mirror cumulative C++ counters on
-                # every schedule(); register discounts instead of
-                # restoring attributes (see DecisionEngine.warmup).
-                t.discount_stats(t.hits - h, t.misses - m)
-            else:
-                t.hits, t.misses = h, m
+                self._state = self._state._replace(
+                    occupied=self._clear_step(self._state.occupied, dummy)
+                )
+                csize *= 2
+            self.sweep(now_ms=now + 2)
+            (
+                self.requests_total,
+                self.batches_total,
+                self.rounds_total,
+                table_stats,
+            ) = saved
+            for t, (h, m) in zip(self.tables, table_stats):
+                if hasattr(t, "discount_stats"):
+                    # Native tables re-mirror cumulative C++ counters on
+                    # every schedule(); register discounts instead of
+                    # restoring attributes (see DecisionEngine.warmup).
+                    t.discount_stats(t.hits - h, t.misses - m)
+                else:
+                    t.hits, t.misses = h, m
+        finally:
+            # Exception-safety: a failed warmup must not leave
+            # persistence disabled (see DecisionEngine.warmup).
+            self.store = saved_store
 
     # ------------------------------------------------------------------
     # Columnar fast path over the mesh — the multi-chip counterpart of
@@ -562,6 +640,11 @@ class ShardedDecisionEngine:
         now_ms: Optional[int] = None,
         want_async: bool = False,
     ):
+        if self.store is not None:
+            raise RuntimeError(
+                "apply_columnar does not support a write-through Store; "
+                "use get_rate_limits"
+            )
         n = len(keys)
         if now_ms is None:
             now_ms = self.clock.now_ms()
@@ -647,7 +730,6 @@ class ShardedDecisionEngine:
 
         # 3. One mesh step per round (chunked by max_kernel_width).
         pieces: List[tuple] = []
-        now_dev = jnp.asarray(now_ms, dtype=jnp.int64)
         for k in range(max_round + 1):
             members = [
                 shard_idx[sh][shard_rounds[sh] == k] if len(shard_idx[sh]) else shard_idx[sh]
@@ -678,7 +760,7 @@ class ShardedDecisionEngine:
                     self._dispatch_sorted_chunk(
                         chunk_members, chunk_slots,
                         algo, behavior, hits, limit, duration, burst,
-                        greg_dur, greg_exp, now_dev,
+                        greg_dur, greg_exp, now_ms,
                     )
                 )
                 self.rounds_total += 1
@@ -700,63 +782,63 @@ class ShardedDecisionEngine:
 
     def _dispatch_sorted_chunk(
         self, members, m_slots, algo, behavior, hits, limit, duration,
-        burst, greg_dur, greg_exp, now_dev,
+        burst, greg_dur, greg_exp, now_ms,
     ):
-        """Build one [n_sh, width] presorted batch, dispatch the sorted
-        mesh step, start the async readback.  Returns a PendingColumnar
-        piece: (packed, dst_idx rows, m per shard, width)."""
+        """Pack one presorted [n_sh, PACKED_IN_ROWS, width] round
+        buffer, dispatch the packed mesh step (one h2d + one or two
+        kernels + one async d2h for the WHOLE mesh), start the async
+        readback.  Returns a PendingColumnar piece:
+        (packed, dst_idx rows, m per shard, width)."""
+        from gubernator_tpu.ops.bucket_kernel import (
+            PACKED_IN_ROWS,
+            pack_batch_host,
+        )
+
         n_sh = self.n_shards
         cap = self.shard_capacity
         width = _pad_size(max((len(m) for m in members), default=1))
 
-        b = {
-            name: np.zeros((n_sh, width), dtype=dt)
-            for name, dt in (
-                ("algo", _I32), ("behavior", _I32), ("hits", _I64),
-                ("limit", _I64), ("duration", _I64), ("burst", _I64),
-                ("greg_duration", _I64), ("greg_expire", _I64),
-            )
-        }
-        b_slot = np.tile(
-            np.arange(cap, cap + width, dtype=_I64).astype(_I32), (n_sh, 1)
-        )
+        buf = np.zeros((n_sh, PACKED_IN_ROWS, width), dtype=_I32)
         dst_rows = []
+        empty_cols = np.empty(0, dtype=_I64)
         for sh in range(n_sh):
             m = len(members[sh])
             if m == 0:
                 dst_rows.append(np.empty(0, dtype=np.int64))
+                pack_batch_host(
+                    width, now_ms, cap, np.empty(0, dtype=_I32),
+                    empty_cols, empty_cols, empty_cols, empty_cols,
+                    empty_cols, empty_cols, empty_cols, empty_cols,
+                    out=buf[sh],
+                )
                 continue
             order = np.argsort(m_slots[sh], kind="stable")
             idx_sorted = members[sh][order]
-            b_slot[sh, :m] = m_slots[sh][order]
-            # Padding must stay ascending beyond the real slots.
-            b["algo"][sh, :m] = algo[idx_sorted]
-            b["behavior"][sh, :m] = behavior[idx_sorted]
-            b["hits"][sh, :m] = hits[idx_sorted]
-            b["limit"][sh, :m] = limit[idx_sorted]
-            b["duration"][sh, :m] = duration[idx_sorted]
-            b["burst"][sh, :m] = burst[idx_sorted]
-            b["greg_duration"][sh, :m] = greg_dur[idx_sorted]
-            b["greg_expire"][sh, :m] = greg_exp[idx_sorted]
+            pack_batch_host(
+                width,
+                now_ms,
+                cap,
+                np.ascontiguousarray(m_slots[sh][order], dtype=_I32),
+                algo[idx_sorted],
+                behavior[idx_sorted],
+                hits[idx_sorted],
+                limit[idx_sorted],
+                duration[idx_sorted],
+                burst[idx_sorted],
+                greg_dur[idx_sorted],
+                greg_exp[idx_sorted],
+                out=buf[sh],
+            )
             dst_rows.append(idx_sorted)
 
-        batch = BatchInput(
-            slot=jnp.asarray(b_slot),
-            algo=jnp.asarray(b["algo"]),
-            behavior=jnp.asarray(b["behavior"]),
-            hits=jnp.asarray(b["hits"]),
-            limit=jnp.asarray(b["limit"]),
-            duration=jnp.asarray(b["duration"]),
-            burst=jnp.asarray(b["burst"]),
-            greg_duration=jnp.asarray(b["greg_duration"]),
-            greg_expire=jnp.asarray(b["greg_expire"]),
-        )
-        # Split mesh step: read-only compute, then donated write-only
-        # scatter (see bucket_kernel._scatter_values for why).
-        vals, packed = self._step_sorted(self._state, batch, now_dev)
-        self._state = self._step_scatter(self._state, batch.slot, vals)
-        packed.copy_to_host_async()
-        return (packed, dst_rows, [len(m) for m in members], width)
+        pin = jnp.asarray(buf)
+        if self._fused:
+            self._state, pout = self._packed_fused(self._state, pin)
+        else:
+            slot_dev, vals, pout = self._packed_compute(self._state, pin)
+            self._state = self._step_scatter(self._state, slot_dev, vals)
+        pout.copy_to_host_async()
+        return (pout, dst_rows, [len(m) for m in members], width)
 
     # ------------------------------------------------------------------
     # Bulk persistence (Loader; reference: store.go:69-78).  Load/save

@@ -16,15 +16,14 @@ from gubernator_tpu.core.engine import DecisionEngine
 from gubernator_tpu.ops import bucket_kernel as bk
 from gubernator_tpu.types import Algorithm, RateLimitReq
 
-# The serving programs: dataclass path (apply_batch), columnar path
-# (compute_update_sorted + scatter_store — the split pair), eviction
-# clears.  apply_batch_sorted is the unsplit single-call variant kept
-# for API compat; it is off the serving path but harmless to watch.
+# The serving programs: dataclass path (apply_batch), packed columnar
+# path (fused_step when in-place donation compiles, else
+# packed_compute + scatter_store), eviction clears.
 _KERNELS = (
     bk.apply_batch,
-    bk.compute_update_sorted,
+    bk.fused_step,
+    bk.packed_compute,
     bk.scatter_store,
-    bk.apply_batch_sorted,
     bk.clear_occupied,
 )
 
@@ -85,7 +84,14 @@ def test_sharded_warmup_covers_serving_widths(frozen_clock):
     )
     engine.warmup(max_width=256)
     before = tuple(
-        f._cache_size() for f in (engine._step, engine._step_sorted, engine._clear_step)
+        f._cache_size()
+        for f in (
+            engine._step,
+            engine._packed_fused,
+            engine._packed_compute,
+            engine._step_scatter,
+            engine._clear_step,
+        )
     )
 
     for width in (1, 65, 200, 256 * 4):
@@ -103,6 +109,13 @@ def test_sharded_warmup_covers_serving_widths(frozen_clock):
         engine.get_rate_limits(reqs)
 
     after = tuple(
-        f._cache_size() for f in (engine._step, engine._step_sorted, engine._clear_step)
+        f._cache_size()
+        for f in (
+            engine._step,
+            engine._packed_fused,
+            engine._packed_compute,
+            engine._step_scatter,
+            engine._clear_step,
+        )
     )
     assert after == before, "sharded serving compiled a new variant after warmup"
