@@ -53,6 +53,11 @@ class IntervalBatcher(Generic[K, V]):
             self._items[key] = self._combine(self._items.get(key), item)
             self._cv.notify()
 
+    def pending(self) -> int:
+        """Items currently queued for the next flush (metrics gauge)."""
+        with self._lock:
+            return len(self._items)
+
     def add_many(self, pairs) -> None:
         """Batch enqueue under ONE lock acquisition — a 1000-item wire
         batch must not pay 1000 lock round-trips (VERDICT r1 weak 8)."""

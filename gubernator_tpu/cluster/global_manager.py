@@ -56,9 +56,15 @@ class GlobalManager:
     def __init__(self, conf: BehaviorConfig, instance: "V1Instance"):
         self.conf = conf
         self.instance = instance
+        from gubernator_tpu.utils.metrics import DurationStat
+
         # Metrics counters (scraped via utils.metrics).
         self.async_sends = 0
         self.broadcasts = 0
+        # reference: guber_async_durations / guber_broadcast_durations
+        # (global.go:41-57).
+        self.hits_duration = DurationStat()
+        self.broadcast_duration = DurationStat()
         self._hits = IntervalBatcher(
             conf.global_sync_wait,
             conf.global_batch_limit,
@@ -93,6 +99,16 @@ class GlobalManager:
 
         reference: global.go:124-164 (sendHits).
         """
+        import time
+
+        from gubernator_tpu.utils.tracing import span
+
+        t0 = time.monotonic()
+        with span("global.hits_window", keys=len(hits)):
+            self._send_hits_traced(hits)
+        self.hits_duration.observe(time.monotonic() - t0)
+
+    def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
         by_peer: Dict[str, List[RateLimitReq]] = {}
         clients = {}
         for key, r in hits.items():
@@ -131,6 +147,16 @@ class GlobalManager:
 
         reference: global.go:205-250 (broadcastPeers).
         """
+        import time
+
+        from gubernator_tpu.utils.tracing import span
+
+        t0 = time.monotonic()
+        with span("global.broadcast", keys=len(updates)):
+            self._broadcast_peers_traced(updates)
+        self.broadcast_duration.observe(time.monotonic() - t0)
+
+    def _broadcast_peers_traced(self, updates: Dict[str, RateLimitReq]) -> None:
         # Clear GLOBAL (so the re-read doesn't requeue a broadcast) and
         # zero the hits (status query), then one engine batch.
         reqs = [

@@ -75,10 +75,12 @@ class PeerClient:
         behaviors: Optional[BehaviorConfig] = None,
         *,
         credentials: Optional[grpc.ChannelCredentials] = None,
+        flush_stat=None,  # utils.metrics.DurationStat (shared, optional)
     ):
         self.info = info
         self.behaviors = behaviors or BehaviorConfig()
         self._credentials = credentials
+        self._flush_stat = flush_stat
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._lock = threading.Lock()
@@ -152,6 +154,16 @@ class PeerClient:
         self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
     ) -> List[RateLimitResp]:
         """Unary batch RPC. reference: peer_client.go:208-246."""
+        from gubernator_tpu.utils.tracing import span
+
+        with span(
+            "peer.batch_rpc", peer=self.info.grpc_address, batch=len(reqs)
+        ):
+            return self._get_peer_rate_limits_traced(reqs, timeout)
+
+    def _get_peer_rate_limits_traced(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
         stub = self._connect()
         msg = peers_pb.GetPeerRateLimitsReq(
             requests=[serde.rate_limit_req_to_pb(r) for r in reqs]
@@ -265,6 +277,17 @@ class PeerClient:
 
         reference: peer_client.go:457-516.
         """
+        from gubernator_tpu.utils.tracing import span
+
+        t0 = time.monotonic()
+        with span(
+            "peer.flush", peer=self.info.grpc_address, batch=len(batch)
+        ):
+            self._send_queue_traced(batch)
+        if self._flush_stat is not None:
+            self._flush_stat.observe(time.monotonic() - t0)
+
+    def _send_queue_traced(self, batch: List[_Pending]) -> None:
         try:
             msg = peers_pb.GetPeerRateLimitsReq(
                 requests=[serde.rate_limit_req_to_pb(p.req) for p in batch]

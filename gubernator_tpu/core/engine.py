@@ -51,6 +51,7 @@ from gubernator_tpu.ops.bucket_kernel import (
 )
 from gubernator_tpu.ops.expiry import windowed_sweep
 from gubernator_tpu.core.interning import InternTable
+from gubernator_tpu.utils.tracing import span
 from gubernator_tpu.types import (
     Algorithm,
     Behavior,
@@ -305,6 +306,9 @@ class DecisionEngine:
         self.over_limit_total = 0
         self.batches_total = 0
         self.rounds_total = 0
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        self.round_duration = DurationStat()
 
     # ------------------------------------------------------------------
 
@@ -408,29 +412,35 @@ class DecisionEngine:
                         restore_rounds.setdefault(k, []).append((slot, item))
 
         host_expire = np.zeros(len(valid_idx), dtype=_I64)
-        for k in sorted(rounds):
-            members = rounds[k]
-            cleared = clear_rounds.get(k)
-            if cleared:
-                self._apply_clears(np.asarray(cleared, dtype=_I32))
-            restores = restore_rounds.get(k)
-            if restores:
-                self._apply_restores(restores)
-            # Bound device shapes: chunk wide rounds so one oversized
-            # client batch can't force unbounded XLA recompiles.
-            for lo in range(0, len(members), self.max_kernel_width):
-                self._run_round(
-                    requests,
-                    valid_idx,
-                    members[lo : lo + self.max_kernel_width],
-                    slots,
-                    greg_dur,
-                    greg_exp,
-                    now_ms,
-                    responses,
-                    host_expire,
-                )
-                self.rounds_total += 1
+        with span(
+            "engine.batch", batch=len(valid_idx), rounds=len(rounds)
+        ):
+            for k in sorted(rounds):
+                members = rounds[k]
+                cleared = clear_rounds.get(k)
+                if cleared:
+                    self._apply_clears(np.asarray(cleared, dtype=_I32))
+                restores = restore_rounds.get(k)
+                if restores:
+                    self._apply_restores(restores)
+                # Bound device shapes: chunk wide rounds so one
+                # oversized client batch can't force unbounded XLA
+                # recompiles.
+                for lo in range(0, len(members), self.max_kernel_width):
+                    chunk = members[lo : lo + self.max_kernel_width]
+                    with span("engine.round", round=k, width=len(chunk)):
+                        self._run_round(
+                            requests,
+                            valid_idx,
+                            chunk,
+                            slots,
+                            greg_dur,
+                            greg_exp,
+                            now_ms,
+                            responses,
+                            host_expire,
+                        )
+                    self.rounds_total += 1
 
         # Refresh the host TTL mirror for eviction ordering.
         self.table.set_expiry(slots, host_expire)
@@ -443,12 +453,16 @@ class DecisionEngine:
     def _dispatch_packed(self, buf: np.ndarray):
         """Run one packed round on device; returns the packed output
         (device array, caller starts the async readback)."""
+        import time as _time
+
+        t0 = _time.monotonic()
         pin = jnp.asarray(buf)  # the round's single h2d transfer
         if self._fused:
             self._state, pout = fused_step(self._state, pin)
         else:
             slot_dev, vals, pout = packed_compute(self._state, pin)
             self._state = scatter_store(self._state, slot_dev, vals)
+        self.round_duration.observe(_time.monotonic() - t0)
         return pout
 
     def _apply_clears(self, cleared: np.ndarray) -> None:
@@ -538,6 +552,9 @@ class DecisionEngine:
                 host_expire[j] = now_ms + r.duration
 
 
+        import time as _time
+
+        t0 = _time.monotonic()
         batch = BatchInput(
             slot=jnp.asarray(b_slot),
             algo=jnp.asarray(b_algo),
@@ -552,6 +569,7 @@ class DecisionEngine:
         self._state, out = apply_batch(
             self._state, batch, self._noop_clear, jnp.asarray(now_ms, dtype=jnp.int64)
         )
+        self.round_duration.observe(_time.monotonic() - t0)
 
         o_status = np.asarray(out.status)
         o_limit = np.asarray(out.limit)
@@ -596,8 +614,11 @@ class DecisionEngine:
                 self.table.release_slots(freed_slots)
             return c
 
-        with self._lock:
-            return windowed_sweep(self, self.capacity, now_ms, max_windows, release)
+        with self._lock, span("engine.sweep") as s:
+            freed = windowed_sweep(self, self.capacity, now_ms, max_windows, release)
+            if s is not None:
+                s.set_attribute("freed", freed)
+            return freed
 
     # ------------------------------------------------------------------
     # Columnar fast path: the engine's native request format.
@@ -651,7 +672,7 @@ class DecisionEngine:
                 greg_dur[i] = gregorian_duration(now_dt, int(duration[i]))
                 greg_exp[i] = gregorian_expiration(now_dt, int(duration[i]))
 
-        with self._lock:
+        with self._lock, span("engine.columnar", batch=n):
             pending = self._apply_columnar_locked(
                 keys, algo, behavior, hits, limit, duration, burst,
                 greg_dur, greg_exp, greg_mask, now_ms,

@@ -116,7 +116,9 @@ class Daemon:
             peer_credentials=creds,
         )
         self.instance = V1Instance(service_conf, engine)
-        self.registry = build_registry(self.instance)
+        self.registry = build_registry(
+            self.instance, metric_flags=conf.metric_flags
+        )
         # gRPC request counts/durations (reference: grpc_stats.go).
         from gubernator_tpu.utils.grpc_stats import GrpcStats
 

@@ -94,6 +94,9 @@ class ShardedDecisionEngine:
         self.over_limit_total = 0
         self.batches_total = 0
         self.rounds_total = 0
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        self.round_duration = DurationStat()
 
         state_spec = jax.tree.map(lambda _: keys_sharding(self.mesh), make_state(0))
         # Allocate the sharded state: [n_shards, shard_capacity] blocks.
@@ -356,32 +359,40 @@ class ShardedDecisionEngine:
                         sh
                     ].append((slot, item))
 
+        from gubernator_tpu.utils.tracing import span
+
         expire_of: Dict[int, int] = {}
-        for k in sorted(set(rounds) | set(clear_rounds)):
-            members = rounds.get(k, [[] for _ in range(n_sh)])
-            clears = clear_rounds.get(k, [[] for _ in range(n_sh)])
-            restores = restore_rounds.get(k)
-            # Chunk wide rounds to bound compiled shapes.
-            offset = 0
-            while True:
-                chunk = [m[offset : offset + self.max_kernel_width] for m in members]
-                if not any(chunk) and offset > 0:
-                    break
-                self._run_round(
-                    chunk,
-                    clears if offset == 0 else [[] for _ in range(n_sh)],
-                    greg_dur,
-                    greg_exp,
-                    now_ms,
-                    requests,
-                    responses,
-                    restores=restores if offset == 0 else None,
-                    expire_of=expire_of,
-                )
-                self.rounds_total += 1
-                offset += self.max_kernel_width
-                if all(offset >= len(m) for m in members):
-                    break
+        with span("engine.batch", batch=len(valid), rounds=len(rounds)):
+            for k in sorted(set(rounds) | set(clear_rounds)):
+                members = rounds.get(k, [[] for _ in range(n_sh)])
+                clears = clear_rounds.get(k, [[] for _ in range(n_sh)])
+                restores = restore_rounds.get(k)
+                # Chunk wide rounds to bound compiled shapes.
+                offset = 0
+                while True:
+                    chunk = [m[offset : offset + self.max_kernel_width] for m in members]
+                    if not any(chunk) and offset > 0:
+                        break
+                    with span(
+                        "engine.round",
+                        round=k,
+                        width=max(len(c) for c in chunk),
+                    ):
+                        self._run_round(
+                            chunk,
+                            clears if offset == 0 else [[] for _ in range(n_sh)],
+                            greg_dur,
+                            greg_exp,
+                            now_ms,
+                            requests,
+                            responses,
+                            restores=restores if offset == 0 else None,
+                            expire_of=expire_of,
+                        )
+                    self.rounds_total += 1
+                    offset += self.max_kernel_width
+                    if all(offset >= len(m) for m in members):
+                        break
 
         if self.store is not None:
             from gubernator_tpu.core.engine import write_through_store
@@ -466,12 +477,16 @@ class ShardedDecisionEngine:
             greg_duration=jnp.asarray(b_gdur),
             greg_expire=jnp.asarray(b_gexp),
         )
+        import time as _time
+
+        t0 = _time.monotonic()
         self._state, out, over = self._step(
             self._state,
             batch,
             jnp.asarray(b_clear),
             jnp.asarray(now_ms, dtype=jnp.int64),
         )
+        self.round_duration.observe(_time.monotonic() - t0)
         self.over_limit_total += int(over)
 
         o_status = np.asarray(out.status)
@@ -660,7 +675,9 @@ class ShardedDecisionEngine:
             greg_dur = np.zeros(n, dtype=_I64)
             greg_exp = greg_dur
 
-        with self._lock:
+        from gubernator_tpu.utils.tracing import span
+
+        with self._lock, span("engine.columnar", batch=n):
             pending = self._apply_columnar_locked(
                 keys, algo, behavior, hits, limit, duration, burst,
                 greg_dur, greg_exp, greg_mask, now_ms,
@@ -831,12 +848,16 @@ class ShardedDecisionEngine:
             )
             dst_rows.append(idx_sorted)
 
+        import time as _time
+
+        t0 = _time.monotonic()
         pin = jnp.asarray(buf)
         if self._fused:
             self._state, pout = self._packed_fused(self._state, pin)
         else:
             slot_dev, vals, pout = self._packed_compute(self._state, pin)
             self._state = self._step_scatter(self._state, slot_dev, vals)
+        self.round_duration.observe(_time.monotonic() - t0)
         pout.copy_to_host_async()
         return (pout, dst_rows, [len(m) for m in members], width)
 

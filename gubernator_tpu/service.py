@@ -158,6 +158,11 @@ class V1Instance:
             "check_errors": 0,
             "async_retries": 0,
         }
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        # Peer-flush duration summary, shared by every PeerClient this
+        # instance creates (reference: guber_batch_send_duration).
+        self.flush_duration = DurationStat()
 
     # ------------------------------------------------------------------
     # Public API (reference: proto/gubernator.proto service V1)
@@ -495,14 +500,20 @@ class V1Instance:
                 if info.datacenter != self.conf.data_center:
                     existing = self.region_picker.get_by_peer_info(info)
                     peer = existing or PeerClient(
-                        info, self.conf.behaviors, credentials=creds
+                        info,
+                        self.conf.behaviors,
+                        credentials=creds,
+                        flush_stat=self.flush_duration,
                     )
                     peer.info = info
                     region_picker.add(peer)
                 else:
                     existing = self.local_picker.get_by_peer_info(info)
                     peer = existing or PeerClient(
-                        info, self.conf.behaviors, credentials=creds
+                        info,
+                        self.conf.behaviors,
+                        credentials=creds,
+                        flush_stat=self.flush_duration,
                     )
                     peer.info = info
                     local_members.append(peer)

@@ -11,16 +11,37 @@ Collector at scrape time, which also serves as the test oracle
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Iterable
+import threading
+from typing import TYPE_CHECKING, Iterable, Sequence
 
 from prometheus_client.core import (
     CounterMetricFamily,
     GaugeMetricFamily,
+    SummaryMetricFamily,
 )
 from prometheus_client.registry import Collector, CollectorRegistry
 
 if TYPE_CHECKING:
     from gubernator_tpu.service import V1Instance
+
+
+class DurationStat:
+    """Cheap duration summary (count + sum seconds), exported as a
+    prometheus summary.  Observations happen on flush/round boundaries
+    (ms-scale work), so a tiny lock is fine; the per-decision hot path
+    never touches one."""
+
+    __slots__ = ("count", "total", "_lock")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
 
 
 class InstanceCollector(Collector):
@@ -111,9 +132,85 @@ class InstanceCollector(Collector):
         c.add_metric([], eng.rounds_total)
         yield c
 
+        # Queue-depth gauges (reference: guber_queue_length /
+        # guber_pool_queue_length, gubernator.go:70-84).
+        g = GaugeMetricFamily(
+            "gubernator_queue_length",
+            "Per-peer batch queue depth (requests awaiting a flush).",
+            labels=["peer"],
+        )
+        for peer in inst.get_peer_list():
+            try:
+                g.add_metric([peer.info.grpc_address], peer.queue_length())
+            except Exception:  # noqa: BLE001 — peer mid-shutdown
+                continue
+        yield g
 
-def build_registry(instance: "V1Instance") -> CollectorRegistry:
-    """Fresh registry per daemon (reference: daemon.go:85-99)."""
+        g = GaugeMetricFamily(
+            "gubernator_global_queue_length",
+            "GLOBAL manager queue depths by queue.",
+            labels=["queue"],
+        )
+        g.add_metric(["hits"], inst.global_mgr._hits.pending())
+        g.add_metric(["broadcasts"], inst.global_mgr._updates.pending())
+        yield g
+
+        # Batch-duration summaries (reference: guber_batch_send_duration
+        # gubernator.go:100-106; guber_async_durations /
+        # guber_broadcast_durations global.go:41-57;
+        # guber_grpc_request_duration analog for engine rounds).
+        s = SummaryMetricFamily(
+            "gubernator_batch_send_duration",
+            "Seconds spent flushing peer request batches.",
+            count_value=inst.flush_duration.count,
+            sum_value=inst.flush_duration.total,
+        )
+        yield s
+
+        s = SummaryMetricFamily(
+            "gubernator_global_send_duration",
+            "Seconds spent sending GLOBAL hit windows to owners.",
+            count_value=inst.global_mgr.hits_duration.count,
+            sum_value=inst.global_mgr.hits_duration.total,
+        )
+        yield s
+
+        s = SummaryMetricFamily(
+            "gubernator_broadcast_duration",
+            "Seconds spent broadcasting GLOBAL statuses to peers.",
+            count_value=inst.global_mgr.broadcast_duration.count,
+            sum_value=inst.global_mgr.broadcast_duration.total,
+        )
+        yield s
+
+        s = SummaryMetricFamily(
+            "gubernator_engine_round_duration",
+            "Seconds of host-side dispatch per device kernel round.",
+            count_value=eng.round_duration.count,
+            sum_value=eng.round_duration.total,
+        )
+        yield s
+
+
+def build_registry(
+    instance: "V1Instance", metric_flags: Sequence[str] = ()
+) -> CollectorRegistry:
+    """Fresh registry per daemon (reference: daemon.go:85-99).
+
+    `metric_flags` mirrors GUBER_METRIC_FLAGS (reference:
+    flags.go:19-57, daemon.go:251-263): "os" adds the process
+    CPU/RSS/fd collector; "python" adds the GC + platform collectors
+    (the Go-runtime collector analog); "all" adds both."""
     reg = CollectorRegistry()
     reg.register(InstanceCollector(instance))
+    flags = {f.strip().lower() for f in metric_flags if f.strip()}
+    if flags & {"os", "all"}:
+        from prometheus_client import ProcessCollector
+
+        ProcessCollector(registry=reg)
+    if flags & {"python", "golang", "all"}:
+        from prometheus_client import GCCollector, PlatformCollector
+
+        GCCollector(registry=reg)
+        PlatformCollector(registry=reg)
     return reg

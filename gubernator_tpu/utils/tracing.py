@@ -1,13 +1,24 @@
-"""OpenTelemetry tracing (optional, env-driven).
+"""Tracing: spans woven through the hot path, no-op when disabled.
 
 reference: the reference weaves holster tracing through every function
-(SURVEY.md §5.1 — e.g. gubernator.go:198-202, algorithms.go:32-36) and
+(SURVEY.md §5.1 — e.g. gubernator.go:198-202, algorithms.go:32-44) and
 exports via OTEL_* env configuration (cmd/gubernator/main.go:57-69).
 
-Here tracing is opt-in: `init_tracing()` configures a tracer provider
-when OTEL_EXPORTER_OTLP_ENDPOINT or OTEL_TRACES_EXPORTER is set (and
-the exporter package is importable); otherwise every span helper is a
-cheap no-op — the decision hot path never pays for disabled tracing.
+Three backends, selected by `init_tracing()`:
+
+- disabled (default): `span()` is one global check — the decision hot
+  path never pays for tracing that is off.
+- OTel (when OTEL_EXPORTER_OTLP_ENDPOINT / OTEL_TRACES_EXPORTER is set
+  and the opentelemetry SDK is importable): real OTLP export.
+- in-memory recorder (`InMemoryTracer`, or
+  GUBER_TRACING=memory): dependency-free span capture with parent
+  links, attributes, and events — the test oracle
+  (tests/test_tracing.py) and a flight-recorder for debugging.
+
+Span sites (matching the reference's observability depth):
+service entry points, engine batches/rounds/sweeps, peer batch
+flushes, GLOBAL hit/broadcast windows — each with batch-size/round
+attributes.
 """
 
 from __future__ import annotations
@@ -15,7 +26,10 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
-from typing import Iterator, Optional
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
 
 log = logging.getLogger("gubernator_tpu.tracing")
 
@@ -23,13 +37,95 @@ _tracer = None
 _initialized = False
 
 
+@dataclass
+class RecordedSpan:
+    """One finished span in the in-memory recorder."""
+
+    name: str
+    attributes: dict = field(default_factory=dict)
+    events: List[tuple] = field(default_factory=list)  # (name, attrs)
+    parent: Optional[str] = None  # parent span name (None = root)
+    start_ns: int = 0
+    end_ns: int = 0
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append((name, attrs))
+
+
+class InMemoryTracer:
+    """Thread-safe span recorder with a per-thread active-span stack
+    (parent links come from nesting, like OTel's context)."""
+
+    def __init__(self) -> None:
+        self.finished: List[RecordedSpan] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _stack(self) -> List[RecordedSpan]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def start_span(self, name: str, **attributes) -> Iterator[RecordedSpan]:
+        stack = self._stack()
+        s = RecordedSpan(
+            name=name,
+            attributes=dict(attributes),
+            parent=stack[-1].name if stack else None,
+            start_ns=time.monotonic_ns(),
+        )
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            stack.pop()
+            s.end_ns = time.monotonic_ns()
+            with self._lock:
+                self.finished.append(s)
+
+    # Test helpers -----------------------------------------------------
+
+    def spans(self, name: Optional[str] = None) -> List[RecordedSpan]:
+        with self._lock:
+            out = list(self.finished)
+        return [s for s in out if name is None or s.name == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self.finished.clear()
+
+
+class _OtelTracer:
+    """Adapter presenting the start_span interface over an OTel tracer."""
+
+    def __init__(self, tracer) -> None:
+        self._tracer = tracer
+
+    @contextlib.contextmanager
+    def start_span(self, name: str, **attributes) -> Iterator[object]:
+        with self._tracer.start_as_current_span(name) as s:
+            for k, v in attributes.items():
+                s.set_attribute(k, v)
+            yield s
+
+
 def init_tracing(service_name: str = "gubernator_tpu") -> bool:
-    """Configure the global tracer from OTEL_* env; returns whether
-    tracing is active.  reference: cmd/gubernator/main.go:57-69."""
+    """Configure the global tracer from OTEL_*/GUBER_TRACING env;
+    returns whether tracing is active.
+    reference: cmd/gubernator/main.go:57-69."""
     global _tracer, _initialized
     if _initialized:
         return _tracer is not None
     _initialized = True
+    if os.environ.get("GUBER_TRACING", "") == "memory":
+        _tracer = InMemoryTracer()
+        log.info("in-memory tracing active")
+        return True
     want = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT") or os.environ.get(
         "OTEL_TRACES_EXPORTER"
     )
@@ -51,9 +147,21 @@ def init_tracing(service_name: str = "gubernator_tpu") -> bool:
     )
     provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
     trace.set_tracer_provider(provider)
-    _tracer = trace.get_tracer("gubernator_tpu")
+    _tracer = _OtelTracer(trace.get_tracer("gubernator_tpu"))
     log.info("OTel tracing active (service=%s)", service_name)
     return True
+
+
+def set_tracer(tracer) -> None:
+    """Install a tracer directly (tests: an InMemoryTracer); None
+    disables tracing."""
+    global _tracer, _initialized
+    _tracer = tracer
+    _initialized = True
+
+
+def current_tracer():
+    return _tracer
 
 
 @contextlib.contextmanager
@@ -62,15 +170,13 @@ def span(name: str, **attributes) -> Iterator[Optional[object]]:
     if _tracer is None:
         yield None
         return
-    with _tracer.start_as_current_span(name) as s:
-        for k, v in attributes.items():
-            s.set_attribute(k, v)
+    with _tracer.start_span(name, **attributes) as s:
         yield s
 
 
 def shutdown_tracing() -> None:
     global _tracer, _initialized
-    if _tracer is not None:
+    if isinstance(_tracer, _OtelTracer):
         try:
             from opentelemetry import trace
 
