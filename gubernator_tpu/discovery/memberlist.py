@@ -17,10 +17,21 @@ certificate* (tombstone at the dead incarnation) that is itself
 gossiped; without it, peers that haven't expired the member yet would
 re-introduce it and the pool would oscillate.  Incarnations (startup
 timestamps) resolve restarts: a restarted node's fresh incarnation
-exceeds its tombstone and rejoins cleanly.  Full-map gossip converges in
-O(log N) rounds and a datagram holds ~hundreds of members — the
-intended deployment sizes for the host tier (the data plane scales via
-the device mesh, not host count).
+exceeds its tombstone and rejoins cleanly.
+
+Wire format: the member map is SEGMENTED into datagrams of at most
+`max_datagram` bytes (default 1200 — safely under any path MTU, like
+hashicorp memberlist's packet budget).  Each datagram is a
+self-contained partial map — merging is per-member idempotent
+anti-entropy, so segmentation needs no reassembly protocol, and losing
+a datagram only delays convergence of the members it carried.  The
+sender's own entry rides in every segment so liveness never depends on
+which segment survives.  Member-count envelope: a segment holds ~10
+members, a 1000-member map is ~100 datagrams per target per interval
+(~120KB/s at the defaults) — fine for hundreds of members, and the
+soak test pins 50 members converging through loss
+(tests/test_gossip_hardening.py); the data plane scales via the device
+mesh, not host count.
 """
 
 from __future__ import annotations
@@ -60,11 +71,13 @@ class MemberListPool(DiscoveryBase):
         interval: float = 1.0,
         suspect_after: float = 5.0,
         fanout: int = 3,
+        max_datagram: int = 1200,
     ):
         super().__init__(daemon)
         self.interval = interval
         self.suspect_after = suspect_after
         self.fanout = fanout
+        self.max_datagram = max_datagram
         bind = conf.member_list_address or f"0.0.0.0:{conf.advertise_port}"
         host, _, port = bind.rpartition(":")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -219,22 +232,54 @@ class MemberListPool(DiscoveryBase):
             if self._merge(payload):
                 self._push_peers()
 
+    def _encode_segments(self, snapshot: Dict[str, dict]) -> List[bytes]:
+        """Split the member map into standalone datagrams ≤ max_datagram.
+
+        The sender's own entry is repeated in every segment (liveness
+        must not depend on which segment survives a lossy network); the
+        remaining entries are packed greedily.  An entry that alone
+        exceeds the budget still ships (the OS may fragment it)."""
+        me_key = self.gossip_address
+        me_entry = {me_key: snapshot[me_key]}
+        base = len(json.dumps(me_entry).encode())
+        segments: List[bytes] = []
+        pending: Dict[str, dict] = dict(me_entry)
+        size = base
+        for addr, meta in snapshot.items():
+            if addr == me_key:
+                continue
+            entry_len = len(json.dumps({addr: meta}).encode())
+            if size + entry_len > self.max_datagram and len(pending) > 1:
+                segments.append(json.dumps(pending).encode())
+                pending = dict(me_entry)
+                size = base
+            pending[addr] = meta
+            size += entry_len
+        segments.append(json.dumps(pending).encode())
+        return segments
+
+    def _send(self, blob: bytes, addr: str) -> None:
+        """One datagram to one member — the fault-injection seam
+        (tests drop a fraction of sends here to model lossy networks)."""
+        host, _, port = addr.rpartition(":")
+        try:
+            self._sock.sendto(blob, (host, int(port)))
+        except OSError as e:
+            log.debug("gossip send to %s failed: %s", addr, e)
+
     def _gossip_loop(self) -> None:
         # Announce immediately so joins propagate fast.
         self._push_peers()
         while not self._closed.wait(self.interval):
             self.heartbeat += 1
-            blob = json.dumps(self._snapshot()).encode()
+            segments = self._encode_segments(self._snapshot())
             with self._lock:
                 members = list(self._members)
             targets = set(random.sample(members, min(self.fanout, len(members))))
             targets.update(self.seeds)
             for addr in targets:
-                host, _, port = addr.rpartition(":")
-                try:
-                    self._sock.sendto(blob, (host, int(port)))
-                except OSError as e:
-                    log.debug("gossip send to %s failed: %s", addr, e)
+                for blob in segments:
+                    self._send(blob, addr)
             if self._expire():
                 self._push_peers()
 

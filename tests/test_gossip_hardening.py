@@ -1,0 +1,152 @@
+"""Gossip hardening: datagram segmentation, packet loss, 50-member soak.
+
+VERDICT r2 item 7 — the old wire format was the full member map in ONE
+datagram with a documented-but-unenforced size limit; an oversized map
+silently failed to gossip.  These tests drive MemberListPool directly
+(lightweight fake daemons, no TPU engines) and pin:
+
+- segmentation: maps larger than max_datagram still converge (every
+  segment is a standalone partial map);
+- loss tolerance: 30% of sends dropped, membership still converges
+  (anti-entropy full-map gossip re-sends everything each interval);
+- scale: 50 members converge and survive member death.
+
+reference analog: memberlist.go:126-233 (hashicorp memberlist handles
+these internally; this backend must handle them itself).
+"""
+
+import random
+import threading
+import time
+
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.discovery.memberlist import MemberListPool
+from gubernator_tpu.types import PeerInfo
+
+
+class FakeDaemon:
+    """Just enough daemon for a discovery backend: peer_info() and
+    set_peers()."""
+
+    def __init__(self, idx: int):
+        self.info = PeerInfo(
+            grpc_address=f"127.0.0.1:{20000 + idx}",
+            http_address=f"127.0.0.1:{30000 + idx}",
+        )
+        self._lock = threading.Lock()
+        self.peers = []
+
+    def peer_info(self) -> PeerInfo:
+        return self.info
+
+    def set_peers(self, peers) -> None:
+        with self._lock:
+            self.peers = list(peers)
+
+    def peer_count(self) -> int:
+        with self._lock:
+            return len(self.peers)
+
+
+def _conf(known_hosts):
+    return DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        member_list_address="127.0.0.1:0",
+        known_hosts=known_hosts,
+        advertise_port=0,
+    )
+
+
+def _until(pred, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _start_pools(n, *, max_datagram=1200, drop=0.0, interval=0.05,
+                 suspect_after=2.0, seed_rng=0):
+    """n gossip pools wired through fake daemons; pool 0 seeds the rest.
+    `drop` patches the send seam to lose that fraction of datagrams."""
+    rng = random.Random(seed_rng)
+    daemons = [FakeDaemon(i) for i in range(n)]
+    pools = []
+    seed = None
+    for i, d in enumerate(daemons):
+        p = MemberListPool(
+            _conf([seed] if seed else []),
+            d,
+            interval=interval,
+            suspect_after=suspect_after,
+            fanout=3,
+            max_datagram=max_datagram,
+        )
+        if drop > 0:
+            orig = p._send
+
+            def lossy(blob, addr, _orig=orig):
+                if rng.random() >= drop:
+                    _orig(blob, addr)
+
+            p._send = lossy
+        if seed is None:
+            seed = p.gossip_address
+        pools.append(p)
+    for p in pools:
+        p.start()
+    return daemons, pools
+
+
+def _stop(pools):
+    for p in pools:
+        p.close()
+
+
+def test_segmentation_converges_with_tiny_datagrams():
+    """max_datagram far below the map size → multi-segment gossip, full
+    convergence (each member entry is ~120 bytes; 8 members ≫ 300B)."""
+    daemons, pools = _start_pools(8, max_datagram=300)
+    try:
+        assert _until(lambda: all(d.peer_count() == 8 for d in daemons)), [
+            d.peer_count() for d in daemons
+        ]
+        # Segmentation really happened: the snapshot encodes to >1
+        # segment, each within budget (allowing the self-entry floor).
+        segs = pools[0]._encode_segments(pools[0]._snapshot())
+        assert len(segs) > 1
+        assert all(len(s) <= 300 for s in segs)
+    finally:
+        _stop(pools)
+
+
+def test_convergence_under_30pct_loss():
+    daemons, pools = _start_pools(10, drop=0.30)
+    try:
+        assert _until(
+            lambda: all(d.peer_count() == 10 for d in daemons), timeout=45
+        ), [d.peer_count() for d in daemons]
+    finally:
+        _stop(pools)
+
+
+def test_50_member_soak_with_deaths():
+    daemons, pools = _start_pools(50, interval=0.1, suspect_after=3.0)
+    try:
+        assert _until(
+            lambda: all(d.peer_count() == 50 for d in daemons), timeout=60
+        ), sorted(d.peer_count() for d in daemons)
+
+        # Kill 5 members; survivors drop them and do NOT resurrect.
+        for p in pools[45:]:
+            p.close()
+        assert _until(
+            lambda: all(d.peer_count() == 45 for d in daemons[:45]),
+            timeout=60,
+        ), sorted(d.peer_count() for d in daemons[:45])
+        time.sleep(1.0)  # several gossip rounds of resurrection window
+        assert all(d.peer_count() == 45 for d in daemons[:45])
+    finally:
+        _stop(pools)
