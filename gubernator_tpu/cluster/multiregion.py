@@ -1,12 +1,16 @@
-"""Multi-region replication manager (wired, eventually-consistent stub).
+"""Multi-region replication manager (real eventually-consistent push).
 
 reference: multiregion.go — the reference queues and aggregates
-MULTI_REGION hits per key but its `sendHits` is an empty TODO stub
+MULTI_REGION hits per key, but its `sendHits` is an empty TODO stub
 (multiregion.go:94-98) and its test is empty (functional_test.go:
-1148-1156).  Capability parity is therefore "wired but stub": hits are
-aggregated per window; `_send_hits` resolves each key's owner in every
-region via the RegionPicker (the push itself is intentionally a no-op,
-matching the reference).
+1148-1156).  This implementation EXCEEDS the reference: each window's
+aggregated hits are pushed to the owning peer in every OTHER region
+(resolved via the RegionPicker, the structure the reference built for
+exactly this), so cross-DC counts converge eventually.  The
+MULTI_REGION flag is cleared on the forwarded copy — the receiving
+region applies the hits locally instead of re-queueing them back
+across the DCN (the cross-region analog of the GLOBAL broadcast
+clearing its flag, global.go:216).
 """
 
 from __future__ import annotations
@@ -38,6 +42,7 @@ class MultiRegionManager:
         self.conf = conf
         self.instance = instance
         self.windows = 0
+        self.region_sends = 0  # successful per-region pushes (metrics)
         self._hits = IntervalBatcher(
             conf.multi_region_sync_wait,
             conf.multi_region_batch_limit,
@@ -51,17 +56,49 @@ class MultiRegionManager:
         self._hits.add(r.hash_key(), r)
 
     def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
-        """Resolve each key's owner per region; pushing is a stub.
+        """Group aggregated hits by (region, owner) and push.
 
-        reference: multiregion.go:78-98 — "TODO: Send the hits to other
-        regions". Kept a no-op for parity.
-        """
-        for key in hits:
-            try:
-                self.instance.region_picker.get_clients(key)
-            except Exception as e:  # noqa: BLE001
-                log.error("while picking regional peers for '%s': %s", key, e)
-        self.windows += 1
+        reference: multiregion.go:78-98 sketches this loop but leaves
+        the send as "TODO: Send the hits to other regions"; here the
+        send is real — see module docstring for the flag-clearing
+        semantics that make it loop-free."""
+        from gubernator_tpu.cluster.peer_client import PeerError
+        from gubernator_tpu.types import MAX_BATCH_SIZE, Behavior
+        from gubernator_tpu.utils.tracing import span
+
+        with span("multiregion.hits_window", keys=len(hits)):
+            by_peer: Dict[str, list] = {}
+            clients: Dict[str, object] = {}
+            for key, r in hits.items():
+                try:
+                    peers = self.instance.region_picker.get_clients(key)
+                except Exception as e:  # noqa: BLE001
+                    log.error(
+                        "while picking regional peers for '%s': %s", key, e
+                    )
+                    continue
+                fwd = replace(
+                    r, behavior=int(r.behavior) & ~int(Behavior.MULTI_REGION)
+                )
+                for peer in peers:
+                    addr = peer.info.grpc_address
+                    by_peer.setdefault(addr, []).append(fwd)
+                    clients[addr] = peer
+            for addr, reqs in by_peer.items():
+                peer = clients[addr]
+                try:
+                    for lo in range(0, len(reqs), MAX_BATCH_SIZE):
+                        peer.get_peer_rate_limits(
+                            reqs[lo : lo + MAX_BATCH_SIZE],
+                            timeout=self.conf.multi_region_timeout,
+                        )
+                    self.region_sends += 1
+                except PeerError as e:
+                    log.error(
+                        "error sending multi-region hits to '%s': %s", addr, e
+                    )
+                    continue
+            self.windows += 1
 
     def close(self) -> None:
         self._hits.close()

@@ -118,6 +118,13 @@ class InstanceCollector(Collector):
         yield c
 
         c = CounterMetricFamily(
+            "gubernator_multiregion_sends",
+            "The count of successful cross-region hit pushes.",
+        )
+        c.add_metric([], inst.multi_region_mgr.region_sends)
+        yield c
+
+        c = CounterMetricFamily(
             "gubernator_engine_batches",
             "Engine batches applied (device step groups).",
         )

@@ -314,8 +314,7 @@ def test_grpc_gateway(cluster):
 
 
 def test_multi_region_queues(cluster):
-    """MULTI_REGION hits are queued and windows flush (push itself is a
-    stub, matching the reference: multiregion.go:94-98)."""
+    """MULTI_REGION hits are queued and windows flush."""
     req = RateLimitReq(
         name="test_mr",
         unique_key=random_string(prefix="mr_"),
@@ -329,6 +328,51 @@ def test_multi_region_queues(cluster):
         rs = c.get_rate_limits([req], timeout=10)
         assert rs[0].error == ""
     assert _until(lambda: owner.instance.multi_region_mgr.windows >= 1)
+
+
+def test_multi_region_hits_converge_across_dcs(cluster):
+    """MULTI_REGION hits applied in one DC converge onto the key's
+    owner in the OTHER DC (exceeds the reference, whose sendHits is an
+    empty stub: multiregion.go:94-98).  Forwarded copies carry the
+    flag cleared, so counts do not ping-pong back."""
+    req = RateLimitReq(
+        name="test_mr_conv",
+        unique_key=random_string(prefix="mrc_"),
+        behavior=Behavior.MULTI_REGION,
+        duration=60_000,
+        limit=100,
+        hits=7,
+    )
+    # Apply in the default DC.
+    owner = cluster.owner_of(req.hash_key())
+    with V1Client(owner.grpc_address) as c:
+        rs = c.get_rate_limits([req], timeout=10)
+        assert rs[0].error == ""
+        assert rs[0].remaining == 93
+    assert _until(lambda: owner.instance.multi_region_mgr.region_sends >= 1)
+
+    # The datacenter-1 owner of this key must eventually see the hits.
+    dc1 = next(
+        d
+        for d, dc in zip(cluster.daemons, cluster._datacenters)
+        if dc == "datacenter-1"
+    )
+
+    def dc1_remaining():
+        query = RateLimitReq(
+            name="test_mr_conv",
+            unique_key=req.unique_key,
+            duration=60_000,
+            limit=100,
+            hits=0,
+        )
+        with V1Client(dc1.grpc_address) as c:
+            return c.get_rate_limits([query], timeout=10)[0].remaining
+
+    assert _until(lambda: dc1_remaining() == 93), dc1_remaining()
+    # ...and it stays there: no cross-DC amplification loop.
+    time.sleep(0.3)
+    assert dc1_remaining() == 93
 
 
 def test_health_check_detects_dead_peer():
