@@ -1,0 +1,157 @@
+# gubernator_tpu on AWS ECS (Fargate) with Cloud Map DNS discovery.
+#
+# Peers find each other through GUBER_PEER_DISCOVERY_TYPE=dns: every
+# task registers in a Cloud Map private DNS namespace, and each daemon
+# polls the service FQDN's A records (gubernator_tpu/discovery/dns.py).
+# Deployment-artifact parity with the reference's ECS example
+# (reference: examples/aws-ecs-service-discovery-deployment/), written
+# for this framework's env surface.
+
+terraform {
+  required_version = ">= 1.5"
+  required_providers {
+    aws = {
+      source  = "hashicorp/aws"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "aws" {
+  region = var.region
+}
+
+# --- network -----------------------------------------------------------
+
+data "aws_vpc" "this" {
+  id = var.vpc_id
+}
+
+resource "aws_security_group" "gubernator" {
+  name_prefix = "${var.name}-"
+  vpc_id      = var.vpc_id
+
+  # gRPC (client + peer) and HTTP gateway, cluster-internal only.
+  ingress {
+    from_port   = var.grpc_port
+    to_port     = var.grpc_port
+    protocol    = "tcp"
+    cidr_blocks = [data.aws_vpc.this.cidr_block]
+  }
+  ingress {
+    from_port   = var.http_port
+    to_port     = var.http_port
+    protocol    = "tcp"
+    cidr_blocks = [data.aws_vpc.this.cidr_block]
+  }
+  egress {
+    from_port   = 0
+    to_port     = 0
+    protocol    = "-1"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+}
+
+# --- service discovery (Cloud Map private DNS) -------------------------
+
+resource "aws_service_discovery_private_dns_namespace" "this" {
+  name = var.discovery_namespace
+  vpc  = var.vpc_id
+}
+
+resource "aws_service_discovery_service" "gubernator" {
+  name = var.name
+
+  dns_config {
+    namespace_id   = aws_service_discovery_private_dns_namespace.this.id
+    routing_policy = "MULTIVALUE"
+    dns_records {
+      type = "A"
+      ttl  = 10
+    }
+  }
+
+  health_check_custom_config {
+    failure_threshold = 1
+  }
+}
+
+# --- ECS ---------------------------------------------------------------
+
+resource "aws_ecs_cluster" "this" {
+  name = var.name
+}
+
+resource "aws_cloudwatch_log_group" "this" {
+  name              = "/ecs/${var.name}"
+  retention_in_days = 14
+}
+
+resource "aws_iam_role" "task_execution" {
+  name_prefix        = "${var.name}-exec-"
+  assume_role_policy = jsonencode({
+    Version = "2012-10-17"
+    Statement = [{
+      Effect    = "Allow"
+      Principal = { Service = "ecs-tasks.amazonaws.com" }
+      Action    = "sts:AssumeRole"
+    }]
+  })
+}
+
+resource "aws_iam_role_policy_attachment" "task_execution" {
+  role       = aws_iam_role.task_execution.name
+  policy_arn = "arn:aws:iam::aws:policy/service-role/AmazonECSTaskExecutionRolePolicy"
+}
+
+resource "aws_ecs_task_definition" "gubernator" {
+  family                   = var.name
+  requires_compatibilities = ["FARGATE"]
+  network_mode             = "awsvpc"
+  cpu                      = var.task_cpu
+  memory                   = var.task_memory
+  execution_role_arn       = aws_iam_role.task_execution.arn
+
+  container_definitions = jsonencode([{
+    name      = var.name
+    image     = var.image
+    essential = true
+    portMappings = [
+      { containerPort = var.grpc_port, protocol = "tcp" },
+      { containerPort = var.http_port, protocol = "tcp" },
+    ]
+    environment = [
+      { name = "GUBER_GRPC_ADDRESS", value = "0.0.0.0:${var.grpc_port}" },
+      { name = "GUBER_HTTP_ADDRESS", value = "0.0.0.0:${var.http_port}" },
+      { name = "GUBER_PEER_DISCOVERY_TYPE", value = "dns" },
+      { name = "GUBER_DNS_FQDN", value = "${var.name}.${var.discovery_namespace}" },
+      { name = "GUBER_DNS_POLL_INTERVAL", value = "15" },
+      { name = "GUBER_CACHE_SIZE", value = tostring(var.cache_size) },
+    ]
+    logConfiguration = {
+      logDriver = "awslogs"
+      options = {
+        "awslogs-group"         = aws_cloudwatch_log_group.this.name
+        "awslogs-region"        = var.region
+        "awslogs-stream-prefix" = var.name
+      }
+    }
+  }])
+}
+
+resource "aws_ecs_service" "gubernator" {
+  name            = var.name
+  cluster         = aws_ecs_cluster.this.id
+  task_definition = aws_ecs_task_definition.gubernator.arn
+  desired_count   = var.replicas
+  launch_type     = "FARGATE"
+
+  network_configuration {
+    subnets         = var.subnet_ids
+    security_groups = [aws_security_group.gubernator.id]
+  }
+
+  service_registries {
+    registry_arn = aws_service_discovery_service.gubernator.arn
+  }
+}

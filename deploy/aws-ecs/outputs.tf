@@ -1,0 +1,12 @@
+output "cluster_arn" {
+  value = aws_ecs_cluster.this.arn
+}
+
+output "service_fqdn" {
+  description = "DNS name the daemons poll for peer discovery."
+  value       = "${var.name}.${var.discovery_namespace}"
+}
+
+output "security_group_id" {
+  value = aws_security_group.gubernator.id
+}

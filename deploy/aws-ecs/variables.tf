@@ -1,0 +1,58 @@
+variable "region" {
+  type    = string
+  default = "us-east-1"
+}
+
+variable "name" {
+  type    = string
+  default = "gubernator-tpu"
+}
+
+variable "image" {
+  type        = string
+  description = "Container image built from deploy/Dockerfile."
+}
+
+variable "vpc_id" {
+  type = string
+}
+
+variable "subnet_ids" {
+  type        = list(string)
+  description = "Private subnets for the Fargate tasks."
+}
+
+variable "discovery_namespace" {
+  type    = string
+  default = "gubernator.local"
+}
+
+variable "replicas" {
+  type    = number
+  default = 3
+}
+
+variable "grpc_port" {
+  type    = number
+  default = 1051
+}
+
+variable "http_port" {
+  type    = number
+  default = 1050
+}
+
+variable "task_cpu" {
+  type    = number
+  default = 1024
+}
+
+variable "task_memory" {
+  type    = number
+  default = 2048
+}
+
+variable "cache_size" {
+  type    = number
+  default = 1000000
+}

@@ -27,6 +27,7 @@ from prometheus_client.registry import CollectorRegistry
 
 from gubernator_tpu.net import serde
 from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.net.pb import peers_pb2 as peers_pb
 from gubernator_tpu.service import ServiceError, V1Instance
 
 
@@ -74,36 +75,50 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply_error(404, 5, "not found")
 
-    def do_POST(self):  # noqa: N802
-        path = self.path.split("?", 1)[0]
-        if path != "/v1/GetRateLimits":
-            self._reply_error(404, 5, "not found")
-            return
-        try:
-            length = int(self.headers.get("Content-Length", "0"))
-            body = self.rfile.read(length)
-            req = json_format.Parse(
-                body or b"{}", pb.GetRateLimitsReq(), ignore_unknown_fields=True
-            )
-        except json_format.ParseError as e:
-            self._reply_error(400, 3, str(e))  # INVALID_ARGUMENT
-            return
-        try:
-            resps = self.instance.get_rate_limits(
-                [serde.rate_limit_req_from_pb(m) for m in req.requests]
-            )
-        except ServiceError as e:
-            self._reply_error(400, 11, str(e))  # OUT_OF_RANGE
-            return
-        out = serde.get_rate_limits_resp_to_pb(resps)
+    def _read_json(self, msg):
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        return json_format.Parse(body or b"{}", msg, ignore_unknown_fields=True)
+
+    def _reply_json(self, msg):
         self._reply(
             200,
             json_format.MessageToJson(
-                out,
+                msg,
                 preserving_proto_field_name=True,
                 always_print_fields_with_no_presence=True,
             ).encode(),
         )
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/v1/GetRateLimits":
+                req = self._read_json(pb.GetRateLimitsReq())
+                resps = self.instance.get_rate_limits(
+                    [serde.rate_limit_req_from_pb(m) for m in req.requests]
+                )
+                self._reply_json(serde.get_rate_limits_resp_to_pb(resps))
+            elif path == "/pb.gubernator.PeersV1/GetPeerRateLimits":
+                # Peer-service REST routes: grpc-gateway's unbound-method
+                # default paths (reference: peers.pb.gw.go:108-143).
+                req = self._read_json(peers_pb.GetPeerRateLimitsReq())
+                resps = self.instance.get_peer_rate_limits(
+                    [serde.rate_limit_req_from_pb(m) for m in req.requests]
+                )
+                self._reply_json(serde.peer_rate_limits_resp_to_pb(resps))
+            elif path == "/pb.gubernator.PeersV1/UpdatePeerGlobals":
+                req = self._read_json(peers_pb.UpdatePeerGlobalsReq())
+                self.instance.update_peer_globals(
+                    [serde.update_peer_global_from_pb(g) for g in req.globals]
+                )
+                self._reply_json(peers_pb.UpdatePeerGlobalsResp())
+            else:
+                self._reply_error(404, 5, "not found")
+        except json_format.ParseError as e:
+            self._reply_error(400, 3, str(e))  # INVALID_ARGUMENT
+        except ServiceError as e:
+            self._reply_error(400, 11, str(e))  # OUT_OF_RANGE
 
 
 class Gateway:

@@ -313,6 +313,68 @@ def test_grpc_gateway(cluster):
     assert resp["responses"][0]["reset_time"] != "0"
 
 
+def test_peer_rest_gateway(cluster):
+    """Peer-service REST routes: grpc-gateway's unbound-method default
+    paths (reference: peers.pb.gw.go)."""
+    d = cluster.daemon_at(0)
+    key = random_string(prefix="peerrest_")
+    data = json.dumps(
+        {
+            "requests": [
+                {
+                    "name": "test_peer_rest",
+                    "unique_key": key,
+                    "hits": "2",
+                    "limit": "9",
+                    "duration": "60000",
+                }
+            ]
+        }
+    ).encode()
+    resp = json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{d.http_address}/pb.gubernator.PeersV1/GetPeerRateLimits",
+                data=data,
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=5,
+        ).read()
+    )
+    assert resp["rate_limits"][0]["status"] == "UNDER_LIMIT"
+    assert resp["rate_limits"][0]["remaining"] == "7"
+
+    # UpdatePeerGlobals installs a broadcast status readable via the
+    # GLOBAL non-owner path.
+    upd = json.dumps(
+        {
+            "globals": [
+                {
+                    "key": f"test_peer_rest_{key}",
+                    "algorithm": "TOKEN_BUCKET",
+                    "status": {
+                        "status": "OVER_LIMIT",
+                        "limit": "9",
+                        "remaining": "0",
+                        "reset_time": "99999999999999",
+                    },
+                }
+            ]
+        }
+    ).encode()
+    out = json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{d.http_address}/pb.gubernator.PeersV1/UpdatePeerGlobals",
+                data=upd,
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=5,
+        ).read()
+    )
+    assert out == {}
+
+
 def test_multi_region_queues(cluster):
     """MULTI_REGION hits are queued and windows flush."""
     req = RateLimitReq(

@@ -111,7 +111,7 @@ def test_global_series_move_under_load():
         # keys turn up.
         remote = [
             g(i)
-            for i in range(500)
+            for i in range(2000)
             if not inst.get_peer(g(i).hash_key()).info.is_owner
         ][:5]
         assert remote

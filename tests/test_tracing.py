@@ -118,11 +118,14 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         # Keys owned by the OTHER node.  A multi-item forward group
         # rides the unary batch RPC (peer.batch_rpc); a single item
         # rides the 500µs batcher (peer.flush).
+        # The reference-exact ring can be lumpy for 2 members and the
+        # arcs depend on the ephemeral ports; scan until enough
+        # remotely-owned keys turn up.
         fwd = [
             req(f"fwd{i}")
-            for i in range(40)
+            for i in range(2000)
             if not inst.get_peer(req(f"fwd{i}").hash_key()).info.is_owner
-        ]
+        ][:3]
         assert len(fwd) >= 3, "expected remotely-owned keys"
         inst.get_rate_limits(fwd[:3])
         rpc = tracer.spans("peer.batch_rpc")
@@ -141,7 +144,7 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         # GLOBAL behavior → async hits window (+ broadcast on owner).
         g = [
             req(f"g{i}", behavior=Behavior.GLOBAL)
-            for i in range(40)
+            for i in range(2000)
             if not inst.get_peer(req(f"g{i}").hash_key()).info.is_owner
         ][:3]
         assert g
