@@ -1,0 +1,99 @@
+"""Run every BASELINE benchmark config and write BENCH_r03_*.json.
+
+Configs (BASELINE.md / BASELINE.json):
+  1. default  — token+leaky mixed, 100k keys, single chip (headline)
+  2. leaky1m  — leaky bucket, 1M keys, batch 1000
+  3. global4  — GLOBAL behavior, 4-node in-process cluster
+  4. zipf     — mixed algos, Zipf-skewed keys over a large space
+  wire        — loopback gRPC at the serving window (p99 SLO evidence)
+
+Each config is one bench.py subprocess (fresh backend; a wedged run
+cannot poison the next) with its knobs passed via env.  Artifacts land
+in the repo root as BENCH_r03_<name>.json.
+
+Usage: python scripts/bench_all.py [name ...]   (default: all)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS: dict[str, dict] = {
+    "default": {},
+    "leaky1m": {
+        "BENCH_ALGO": "leaky",
+        "BENCH_KEYS": "1000000",
+        "BENCH_CAPACITY": str(1 << 21),
+        "BENCH_BATCH": "8192",
+    },
+    "global4": {
+        "BENCH_MODE": "global",
+        "BENCH_NODES": "4",
+        "BENCH_KEYS": "100000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_BATCH": "1000",
+    },
+    "zipf": {
+        "BENCH_ZIPF": "1.2",
+        "BENCH_KEYS": "100000000",
+        "BENCH_CAPACITY": str(1 << 24),  # hot working set; full 100M
+        # slots is a 7.6GB HBM budget question, answered in PERF.md §8
+        "BENCH_BATCH": "8192",
+    },
+    "wire": {
+        "BENCH_MODE": "wire",
+        "BENCH_BATCH": "1000",
+        "BENCH_KEYS": "100000",
+        "BENCH_CAPACITY": str(1 << 17),
+    },
+}
+
+
+def run(name: str, overrides: dict) -> dict:
+    env = dict(os.environ)
+    env.update(overrides)
+    env.setdefault("BENCH_SECONDS", "5")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        env=env,
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    line = ""
+    for ln in (proc.stdout or "").strip().splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if not line:
+        return {
+            "error": f"no JSON line (rc={proc.returncode})",
+            "stderr_tail": (proc.stderr or "")[-400:],
+        }
+    result = json.loads(line)
+    result["config"] = name
+    result["env"] = overrides
+    return result
+
+
+def main() -> int:
+    names = sys.argv[1:] or list(CONFIGS)
+    for name in names:
+        print(f"=== {name}: {CONFIGS[name]}", file=sys.stderr, flush=True)
+        result = run(name, CONFIGS[name])
+        path = os.path.join(ROOT, f"BENCH_r03_{name}.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    main()
