@@ -63,6 +63,13 @@ from gubernator_tpu.types import (
 _I32 = np.int32
 _I64 = np.int64
 
+# Hot-loop constants: IntFlag/IntEnum operations cost ~1.5µs each in
+# CPython — at 1000-item batches the enum protocol alone was ~15ms per
+# wire batch (profiled); plain ints and a lookup table are ~50ns.
+_GREG = int(Behavior.DURATION_IS_GREGORIAN)
+_OVER_I = int(Status.OVER_LIMIT)
+_STATUS_OF = {int(s): s for s in Status}
+
 
 def _pad_size(n: int, floor: int = 64) -> int:
     """Next power of two ≥ n (bounded set of compiled batch shapes)."""
@@ -330,7 +337,7 @@ class DecisionEngine:
         greg_exp = np.zeros(n, dtype=_I64)
         valid_idx: List[int] = []
         for i, r in enumerate(requests):
-            if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN:
+            if int(r.behavior) & _GREG:
                 if now_dt is None:
                     # Derive civil time from now_ms itself — a second
                     # clock read could land in a different calendar
@@ -517,75 +524,74 @@ class DecisionEngine:
         responses: List[Optional[RateLimitResp]],
         host_expire: np.ndarray,
     ) -> None:
-        m = len(members)
-        size = _pad_size(m)
-        # Padding lanes use distinct ascending out-of-range slots so the
-        # kernel's sorted+unique gather/scatter flags stay truthful.
-        b_slot = np.arange(
-            self.capacity, self.capacity + size, dtype=np.int64
-        ).astype(_I32)
-        b_algo = np.zeros(size, dtype=_I32)
-        b_beh = np.zeros(size, dtype=_I32)
-        b_hits = np.zeros(size, dtype=_I64)
-        b_limit = np.zeros(size, dtype=_I64)
-        b_dur = np.zeros(size, dtype=_I64)
-        b_burst = np.zeros(size, dtype=_I64)
-        b_gdur = np.zeros(size, dtype=_I64)
-        b_gexp = np.zeros(size, dtype=_I64)
+        """One round of the dataclass path, dispatched through the SAME
+        packed single-transfer program as the columnar path (host
+        presort by slot, one h2d, one/two kernels, one readback) — the
+        old per-column transfers paid the backend's per-op dispatch
+        floor 10× per round (PERF.md §2)."""
+        from gubernator_tpu.ops.bucket_kernel import unpack_out_host
 
+        m = len(members)
+        c_slot = np.empty(m, dtype=_I32)
+        c_algo = np.empty(m, dtype=_I32)
+        c_beh = np.empty(m, dtype=_I32)
+        c_hits = np.empty(m, dtype=_I64)
+        c_limit = np.empty(m, dtype=_I64)
+        c_dur = np.empty(m, dtype=_I64)
+        c_burst = np.empty(m, dtype=_I64)
+        c_gdur = np.empty(m, dtype=_I64)
+        c_gexp = np.empty(m, dtype=_I64)
         for lane, j in enumerate(members):
             i = valid_idx[j]
             r = requests[i]
-            b_slot[lane] = slots[j]
-            b_algo[lane] = int(r.algorithm)
-            b_beh[lane] = int(r.behavior)
-            b_hits[lane] = r.hits
-            b_limit[lane] = r.limit
-            b_dur[lane] = r.duration
-            b_burst[lane] = r.burst
-            b_gdur[lane] = greg_dur[i]
-            b_gexp[lane] = greg_exp[i]
+            c_slot[lane] = slots[j]
+            c_algo[lane] = int(r.algorithm)
+            beh = int(r.behavior)
+            c_beh[lane] = beh
+            c_hits[lane] = r.hits
+            c_limit[lane] = r.limit
+            c_dur[lane] = r.duration
+            c_burst[lane] = r.burst
+            c_gdur[lane] = greg_dur[i]
+            c_gexp[lane] = greg_exp[i]
             # Host TTL mirror estimate (device value is authoritative).
-            if b_beh[lane] & Behavior.DURATION_IS_GREGORIAN:
-                host_expire[j] = b_gexp[lane]
+            if beh & _GREG:
+                host_expire[j] = greg_exp[i]
             else:
                 host_expire[j] = now_ms + r.duration
 
-
-        import time as _time
-
-        t0 = _time.monotonic()
-        batch = BatchInput(
-            slot=jnp.asarray(b_slot),
-            algo=jnp.asarray(b_algo),
-            behavior=jnp.asarray(b_beh),
-            hits=jnp.asarray(b_hits),
-            limit=jnp.asarray(b_limit),
-            duration=jnp.asarray(b_dur),
-            burst=jnp.asarray(b_burst),
-            greg_duration=jnp.asarray(b_gdur),
-            greg_expire=jnp.asarray(b_gexp),
+        sort_idx = np.argsort(c_slot, kind="stable")
+        buf = pack_batch_host(
+            _pad_size(m),
+            now_ms,
+            self.capacity,
+            np.ascontiguousarray(c_slot[sort_idx]),
+            c_algo[sort_idx],
+            c_beh[sort_idx],
+            c_hits[sort_idx],
+            c_limit[sort_idx],
+            c_dur[sort_idx],
+            c_burst[sort_idx],
+            c_gdur[sort_idx],
+            c_gexp[sort_idx],
         )
-        self._state, out = apply_batch(
-            self._state, batch, self._noop_clear, jnp.asarray(now_ms, dtype=jnp.int64)
-        )
-        self.round_duration.observe(_time.monotonic() - t0)
+        pout = self._dispatch_packed(buf)
 
-        o_status = np.asarray(out.status)
-        o_limit = np.asarray(out.limit)
-        o_rem = np.asarray(out.remaining)
-        o_reset = np.asarray(out.reset_time)
-        for lane, j in enumerate(members):
+        o_status, o_rem, o_reset = unpack_out_host(np.asarray(pout), m)
+        over = 0
+        for pos, sj in enumerate(sort_idx.tolist()):
+            j = members[sj]
             i = valid_idx[j]
-            st = int(o_status[lane])
-            if st == Status.OVER_LIMIT:
-                self.over_limit_total += 1
+            st = int(o_status[pos])
+            if st == _OVER_I:
+                over += 1
             responses[i] = RateLimitResp(
-                status=Status(st),
-                limit=int(o_limit[lane]),
-                remaining=int(o_rem[lane]),
-                reset_time=int(o_reset[lane]),
+                status=_STATUS_OF[st],
+                limit=int(c_limit[sj]),
+                remaining=int(o_rem[pos]),
+                reset_time=int(o_reset[pos]),
             )
+        self.over_limit_total += over
 
     # ------------------------------------------------------------------
 

@@ -46,6 +46,12 @@ from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
 _I32 = np.int32
 _I64 = np.int64
 
+# Hot-loop int constants (IntFlag/IntEnum ops are ~1.5µs each in
+# CPython; see core/engine.py note).
+_GREG = int(Behavior.DURATION_IS_GREGORIAN)
+_OVER_I = int(Status.OVER_LIMIT)
+_STATUS_OF = {int(st): st for st in Status}
+
 
 def _pad_size(n: int, floor: int = 64) -> int:
     size = floor
@@ -301,7 +307,7 @@ class ShardedDecisionEngine:
         greg_exp = np.zeros(n, dtype=_I64)
         valid: List[int] = []
         for i, r in enumerate(requests):
-            if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN:
+            if int(r.behavior) & _GREG:
                 if now_dt is None:
                     # Same time-source invariant as core.engine: civil
                     # time derives from now_ms, never a second read.
@@ -414,6 +420,12 @@ class ShardedDecisionEngine:
         restores: Optional[List[List[tuple]]] = None,
         expire_of: Optional[Dict[int, int]] = None,
     ) -> None:
+        from gubernator_tpu.ops.bucket_kernel import (
+            PACKED_IN_ROWS,
+            pack_batch_host,
+            unpack_out_host,
+        )
+
         n_sh = self.n_shards
         cap = self.shard_capacity
         width = _pad_size(max((len(m) for m in members), default=1))
@@ -423,84 +435,104 @@ class ShardedDecisionEngine:
         self._apply_shard_clears(clears)
         if restores is not None and any(restores):
             self._apply_shard_restores(restores)
-        csize = 16
 
-        # Padding: distinct ascending out-of-range slots per shard.
-        b_slot = np.tile(
-            np.arange(cap, cap + width, dtype=_I64).astype(_I32), (n_sh, 1)
-        )
-        b_algo = np.zeros((n_sh, width), dtype=_I32)
-        b_beh = np.zeros((n_sh, width), dtype=_I32)
-        b_hits = np.zeros((n_sh, width), dtype=_I64)
-        b_limit = np.zeros((n_sh, width), dtype=_I64)
-        b_dur = np.zeros((n_sh, width), dtype=_I64)
-        b_burst = np.zeros((n_sh, width), dtype=_I64)
-        b_gdur = np.zeros((n_sh, width), dtype=_I64)
-        b_gexp = np.zeros((n_sh, width), dtype=_I64)
-        b_clear = np.tile(
-            np.arange(cap, cap + csize, dtype=_I64).astype(_I32), (n_sh, 1)
-        )
-
+        # One packed [n_sh, 16, width] buffer, host-presorted per shard
+        # -- the same 3-op program as the columnar path (PERF.md sec 4);
+        # the old per-column transfers paid the per-op dispatch floor
+        # 10x per round.
+        buf = np.zeros((n_sh, PACKED_IN_ROWS, width), dtype=_I32)
+        order_of: List[np.ndarray] = []
+        limits_of: List[np.ndarray] = []
         host_expire: List[Tuple[List[int], List[int]]] = [
             ([], []) for _ in range(n_sh)
         ]  # per shard: (slots, expires)
+        empty64 = np.empty(0, dtype=_I64)
         for sh in range(n_sh):
+            m = len(members[sh])
+            if m == 0:
+                pack_batch_host(
+                    width, now_ms, cap, np.empty(0, dtype=_I32),
+                    empty64, empty64, empty64, empty64, empty64, empty64,
+                    empty64, empty64, out=buf[sh],
+                )
+                order_of.append(np.empty(0, dtype=np.int64))
+                limits_of.append(empty64)
+                continue
+            c_slot = np.empty(m, dtype=_I32)
+            c_algo = np.empty(m, dtype=_I32)
+            c_beh = np.empty(m, dtype=_I32)
+            c_hits = np.empty(m, dtype=_I64)
+            c_limit = np.empty(m, dtype=_I64)
+            c_dur = np.empty(m, dtype=_I64)
+            c_burst = np.empty(m, dtype=_I64)
+            c_gdur = np.empty(m, dtype=_I64)
+            c_gexp = np.empty(m, dtype=_I64)
             for lane, (i, slot) in enumerate(members[sh]):
                 r = requests[i]
-                b_slot[sh, lane] = slot
-                b_algo[sh, lane] = int(r.algorithm)
-                b_beh[sh, lane] = int(r.behavior)
-                b_hits[sh, lane] = r.hits
-                b_limit[sh, lane] = r.limit
-                b_dur[sh, lane] = r.duration
-                b_burst[sh, lane] = r.burst
-                b_gdur[sh, lane] = greg_dur[i]
-                b_gexp[sh, lane] = greg_exp[i]
+                c_slot[lane] = slot
+                c_algo[lane] = int(r.algorithm)
+                beh = int(r.behavior)
+                c_beh[lane] = beh
+                c_hits[lane] = r.hits
+                c_limit[lane] = r.limit
+                c_dur[lane] = r.duration
+                c_burst[lane] = r.burst
+                c_gdur[lane] = greg_dur[i]
+                c_gexp[lane] = greg_exp[i]
                 exp = (
                     greg_exp[i]
-                    if int(r.behavior) & Behavior.DURATION_IS_GREGORIAN
+                    if beh & _GREG
                     else now_ms + r.duration
                 )
                 host_expire[sh][0].append(slot)
                 host_expire[sh][1].append(exp)
                 if expire_of is not None:
                     expire_of[i] = int(exp)
+            sort_idx = np.argsort(c_slot, kind="stable")
+            pack_batch_host(
+                width, now_ms, cap,
+                np.ascontiguousarray(c_slot[sort_idx]),
+                c_algo[sort_idx], c_beh[sort_idx], c_hits[sort_idx],
+                c_limit[sort_idx], c_dur[sort_idx], c_burst[sort_idx],
+                c_gdur[sort_idx], c_gexp[sort_idx],
+                out=buf[sh],
+            )
+            order_of.append(sort_idx)
+            limits_of.append(c_limit)
 
-        batch = BatchInput(
-            slot=jnp.asarray(b_slot),
-            algo=jnp.asarray(b_algo),
-            behavior=jnp.asarray(b_beh),
-            hits=jnp.asarray(b_hits),
-            limit=jnp.asarray(b_limit),
-            duration=jnp.asarray(b_dur),
-            burst=jnp.asarray(b_burst),
-            greg_duration=jnp.asarray(b_gdur),
-            greg_expire=jnp.asarray(b_gexp),
-        )
         import time as _time
 
         t0 = _time.monotonic()
-        self._state, out, over = self._step(
-            self._state,
-            batch,
-            jnp.asarray(b_clear),
-            jnp.asarray(now_ms, dtype=jnp.int64),
-        )
+        pin = jnp.asarray(buf)
+        if self._fused:
+            self._state, pout = self._packed_fused(self._state, pin)
+        else:
+            slot_dev, vals, pout = self._packed_compute(self._state, pin)
+            self._state = self._step_scatter(self._state, slot_dev, vals)
         self.round_duration.observe(_time.monotonic() - t0)
-        self.over_limit_total += int(over)
 
-        o_status = np.asarray(out.status)
-        o_limit = np.asarray(out.limit)
-        o_rem = np.asarray(out.remaining)
-        o_reset = np.asarray(out.reset_time)
+        arr = np.asarray(pout)
         for sh in range(n_sh):
-            for lane, (i, _slot) in enumerate(members[sh]):
+            mm = len(members[sh])
+            if mm == 0:
+                continue
+            o_status, o_rem, o_reset = unpack_out_host(arr[sh], mm)
+            sort_idx = order_of[sh]
+            c_limit = limits_of[sh]
+            over = 0
+            for pos in range(mm):
+                sj = int(sort_idx[pos])
+                i = members[sh][sj][0]
+                st = int(o_status[pos])
+                if st == _OVER_I:
+                    over += 1
                 responses[i] = RateLimitResp(
-                    status=Status(int(o_status[sh, lane])),
-                    limit=int(o_limit[sh, lane]),
-                    remaining=int(o_rem[sh, lane]),
-                    reset_time=int(o_reset[sh, lane]),
+                    status=_STATUS_OF[st],
+                    limit=int(c_limit[sj]),
+                    remaining=int(o_rem[pos]),
+                    reset_time=int(o_reset[pos]),
                 )
+            self.over_limit_total += over
         for sh, (e_slots, e_exps) in enumerate(host_expire):
             if e_slots:
                 self.tables[sh].set_expiry(

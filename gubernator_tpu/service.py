@@ -48,6 +48,11 @@ from gubernator_tpu.types import (
 
 log = logging.getLogger("gubernator_tpu.service")
 
+# Hot-loop int constants (IntFlag ops are ~1.5µs each in CPython; see
+# core/engine.py note).
+_GLOBAL_I = int(Behavior.GLOBAL)
+_MULTI_REGION_I = int(Behavior.MULTI_REGION)
+
 HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
 
@@ -114,12 +119,23 @@ class _GlobalStatusCache:
 
     def put(self, key: str, resp: RateLimitResp, algorithm: int) -> None:
         with self._lock:
-            self._items[key] = _GlobalEntry(
-                resp=resp, algorithm=algorithm, expire_at=resp.reset_time
-            )
-            self._items.move_to_end(key)
-            while len(self._items) > self.capacity:
-                self._items.popitem(last=False)
+            self._put_locked(key, resp, algorithm)
+
+    def put_many(self, entries) -> None:
+        """Batch insert under ONE lock acquisition — UpdatePeerGlobals
+        delivers up to MAX_BATCH_SIZE statuses per RPC and a lock per
+        item contends with the serving path's get_many."""
+        with self._lock:
+            for key, resp, algorithm in entries:
+                self._put_locked(key, resp, algorithm)
+
+    def _put_locked(self, key: str, resp: RateLimitResp, algorithm: int) -> None:
+        self._items[key] = _GlobalEntry(
+            resp=resp, algorithm=algorithm, expire_at=resp.reset_time
+        )
+        self._items.move_to_end(key)
+        while len(self._items) > self.capacity:
+            self._items.popitem(last=False)
 
     def __len__(self) -> int:
         with self._lock:
@@ -217,7 +233,7 @@ class V1Instance:
             r = requests[i]
             if owner is None or owner.info.is_owner:
                 local_idx.append(i)
-            elif has_behavior(r.behavior, Behavior.GLOBAL):
+            elif int(r.behavior) & _GLOBAL_I:
                 # reference: gubernator.go:276-287, 426-466
                 global_items.append((i, owner))
             else:
@@ -434,10 +450,11 @@ class V1Instance:
 
         reference: gubernator.go:470-490.
         """
-        for g in globals_:
-            if g.status is None:
-                continue
-            self.global_cache.put(g.key, g.status, g.algorithm)
+        self.global_cache.put_many(
+            (g.key, g.status, g.algorithm)
+            for g in globals_
+            if g.status is not None
+        )
 
     def health_check(self) -> HealthCheckResp:
         """Aggregate recent peer errors. reference: gubernator.go:562-619."""
@@ -472,9 +489,10 @@ class V1Instance:
         then the algorithm runs (here: one vectorized engine call).
         """
         for r in reqs:
-            if has_behavior(r.behavior, Behavior.GLOBAL):
+            beh = int(r.behavior)
+            if beh & _GLOBAL_I:
                 self.global_mgr.queue_update(r)
-            if has_behavior(r.behavior, Behavior.MULTI_REGION):
+            if beh & _MULTI_REGION_I:
                 self.multi_region_mgr.queue_hits(r)
         return self.engine.get_rate_limits(reqs, now_ms=now_ms)
 
