@@ -94,6 +94,11 @@ class GlobalManager:
         """Mark a key the owner must re-broadcast. reference: global.go:72-74."""
         self._updates.add(r.hash_key(), r)
 
+    def queue_updates_many(self, reqs) -> None:
+        """Batch enqueue under one lock (wire batches are ≤1000 items;
+        a lock per item contends with the flush thread)."""
+        self._updates.add_many((r.hash_key(), r) for r in reqs)
+
     # -- flush paths (run on batcher threads) --------------------------
 
     def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:

@@ -488,12 +488,12 @@ class V1Instance:
         queue an owner broadcast, MULTI_REGION items queue region hits,
         then the algorithm runs (here: one vectorized engine call).
         """
-        for r in reqs:
-            beh = int(r.behavior)
-            if beh & _GLOBAL_I:
-                self.global_mgr.queue_update(r)
-            if beh & _MULTI_REGION_I:
-                self.multi_region_mgr.queue_hits(r)
+        g_items = [r for r in reqs if int(r.behavior) & _GLOBAL_I]
+        if g_items:
+            self.global_mgr.queue_updates_many(g_items)
+        mr_items = [r for r in reqs if int(r.behavior) & _MULTI_REGION_I]
+        for r in mr_items:
+            self.multi_region_mgr.queue_hits(r)
         return self.engine.get_rate_limits(reqs, now_ms=now_ms)
 
     # ------------------------------------------------------------------
