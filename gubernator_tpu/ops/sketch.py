@@ -1,0 +1,225 @@
+"""Count-min-sketch rate limiting: approximate decisions at unbounded
+key cardinality (BASELINE config 5 stretch; no reference counterpart —
+the reference caps state at its LRU size and evicts, store.go/lrucache
+.go, while a sketch answers for EVERY key in O(1) memory with a
+one-sided overcount error).
+
+TPU-first design:
+
+- Sketch state: int32 `[depth, width]` counters in HBM, one sketch per
+  fixed window duration.  Sliding behavior comes from TWO alternating
+  epochs (current + previous) with linear interpolation — the classic
+  sliding-window approximation, all branch-free arithmetic.
+- Hashing: the host computes one fnv1a-64 per key (it already has the
+  bytes); the device derives the `depth` row indexes via
+  Kirsch-Mitzenmacher double hashing (h1 + r·h2) mod width — no
+  per-row string hashing anywhere.
+- Duplicate handling: scatter-add with arbitrary duplicate indexes
+  lowers to a serial per-element loop on TPU, so the HOST pre-combines
+  each row's duplicates (sort + reduce) and the device runs only
+  sorted-unique gathers/scatter-adds — the same fast-path contract as
+  the bucket kernel (ops/bucket_kernel.py).
+- One packed int32 input `[2 + 3*depth, B]` per step (header, hits
+  row, then per-row sorted unique indexes / summed hits / gather
+  positions), one packed int32 output `[1, B]` (the estimate), so the
+  step costs 3 device ops like the exact engine (PERF.md §4).
+
+Estimate semantics: `est = min_r sketch[r][idx_r]` AFTER adding this
+batch's hits, interpolated across the two epochs; OVER_LIMIT when
+`est > limit`.  Errors are one-sided (never under-counts), matching a
+rate limiter's fail-closed preference.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+_U64 = np.uint64
+
+
+class SketchState(NamedTuple):
+    """Two-epoch count-min sketch, shape [2, depth, width] int32."""
+
+    counts: jax.Array  # int32 [2, depth, width]
+    epoch: jax.Array  # int64 scalar — window index of counts[cur]
+    cur: jax.Array  # int32 scalar — which plane is the current epoch
+
+
+def make_sketch(depth: int = 4, width: int = 1 << 20) -> SketchState:
+    return SketchState(
+        counts=jnp.zeros((2, depth, width), dtype=_I32),
+        epoch=jnp.asarray(0, dtype=_I64),
+        cur=jnp.asarray(0, dtype=_I32),
+    )
+
+
+def _rotate(state: SketchState, epoch_now: jax.Array) -> SketchState:
+    """Advance to `epoch_now`: one step rotates planes (previous ←
+    current, current ← zeros); a gap ≥ 2 windows zeroes both."""
+    delta = epoch_now - state.epoch
+    cur = state.cur
+    other = 1 - cur
+    # delta == 1: zero the other plane, flip cur.
+    counts = jnp.where(
+        delta == 1,
+        state.counts.at[other].set(0),
+        state.counts,
+    )
+    cur2 = jnp.where(delta == 1, other, cur).astype(_I32)
+    # delta >= 2: zero everything.
+    counts = jnp.where(delta >= 2, jnp.zeros_like(counts), counts)
+    return SketchState(
+        counts=counts,
+        epoch=jnp.maximum(state.epoch, epoch_now),
+        cur=cur2,
+    )
+
+
+def _sketch_step_impl(
+    state: SketchState,
+    pin: jax.Array,  # int32 [2 + 3*depth, B] (see host packer)
+    depth: int,
+):
+    # Header row 0: [epoch_hi, epoch_lo, frac_q16, ...].
+    epoch_now = (pin[0, 0].astype(_I64) << 32) | (
+        pin[0, 1].astype(_I64) & 0xFFFFFFFF
+    )
+    frac_q16 = pin[0, 2].astype(_I64)  # elapsed fraction of window, Q16
+    state = _rotate(state, epoch_now)
+    hits = pin[1].astype(_I64)  # per-lane hits (request order)
+
+    cur = state.cur
+    prev = 1 - cur
+    counts = state.counts
+    est = jnp.full(pin.shape[1], jnp.iinfo(jnp.int64).max, dtype=_I64)
+    for r in range(depth):
+        idx = pin[2 + 3 * r]  # sorted unique indexes (padding = width+lane)
+        add = pin[2 + 3 * r + 1]  # combined hits per unique index
+        pos = pin[2 + 3 * r + 2]  # lane → position into idx/new counts
+        row_cur = counts[cur, r]
+        row_prev = counts[prev, r]
+        new_row = row_cur.at[idx].add(
+            add, mode="drop", indices_are_sorted=True, unique_indices=True
+        )
+        counts = counts.at[cur, r].set(new_row)
+        g_cur = new_row.at[idx].get(
+            mode="fill", fill_value=0, indices_are_sorted=True,
+            unique_indices=True,
+        )
+        g_prev = row_prev.at[idx].get(
+            mode="fill", fill_value=0, indices_are_sorted=True,
+            unique_indices=True,
+        )
+        # Sliding-window interpolation: prev·(1−f) + cur, in Q16.
+        row_est = (
+            g_prev.astype(_I64) * (65536 - frac_q16) // 65536
+            + g_cur.astype(_I64)
+        )
+        est = jnp.minimum(est, row_est[pos])
+
+    new_state = SketchState(counts=counts, epoch=state.epoch, cur=cur)
+    out = jnp.stack(
+        [(est >> 32).astype(_I32), est.astype(_I32)]
+    )  # int64 estimate as hi/lo rows
+    del hits  # already folded into `add` host-side
+    return new_state, out
+
+
+class SketchLimiter:
+    """Approximate per-key rate limiter over a count-min sketch.
+
+    One limiter = one (window_ms, depth, width) sketch; keys are
+    unbounded.  `apply(keys, hits, limit)` returns (over_limit bool
+    array, estimate array).  Overcounting is possible (collisions) at
+    a rate bounded by ~batch_hits/width per row; undercounting is not.
+    """
+
+    def __init__(
+        self,
+        window_ms: int = 1_000,
+        depth: int = 4,
+        width: int = 1 << 20,
+        *,
+        seed: int = 0x9E3779B97F4A7C15,
+    ):
+        if depth < 1 or width < 2:
+            raise ValueError("depth >= 1 and width >= 2 required")
+        self.window_ms = int(window_ms)
+        self.depth = depth
+        self.width = width
+        self._seed = np.uint64(seed)
+        self._state = make_sketch(depth, width)
+        self._step = jax.jit(
+            lambda s, pin: _sketch_step_impl(s, pin, depth),
+            donate_argnums=(0,),
+        )
+
+    # -- host packing --------------------------------------------------
+
+    def _indexes(self, keys) -> np.ndarray:
+        """[depth, B] int64 row indexes via double hashing."""
+        from gubernator_tpu.hashing import fnv1a_64_batch, pack_keys
+
+        padded, lengths = pack_keys(keys)
+        h1 = fnv1a_64_batch(padded, lengths)
+        # Second hash: one multiply-xor over h1 (splitmix-style).
+        h2 = (h1 ^ (h1 >> np.uint64(33))) * self._seed
+        rows = np.empty((self.depth, len(keys)), dtype=np.int64)
+        for r in range(self.depth):
+            rows[r] = (
+                (h1 + np.uint64(r) * h2) % np.uint64(self.width)
+            ).astype(np.int64)
+        return rows
+
+    def apply(
+        self,
+        keys,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        now_ms: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(keys)
+        if n == 0:
+            return np.zeros(0, dtype=bool), np.zeros(0, dtype=np.int64)
+        rows = self._indexes(keys)
+        hits64 = np.asarray(hits, dtype=np.int64)
+
+        size = 64
+        while size < n:
+            size *= 2
+        pin = np.zeros((2 + 3 * self.depth, size), dtype=np.int32)
+        epoch = now_ms // self.window_ms
+        frac = (now_ms % self.window_ms) * 65536 // self.window_ms
+        pin[0, 0] = np.int32(epoch >> 32)
+        pin[0, 1] = np.int64(epoch).astype(np.int32)
+        pin[0, 2] = frac
+        pin[1, :n] = np.minimum(hits64, np.int64(2**31 - 1)).astype(np.int32)
+        for r in range(self.depth):
+            idx = rows[r]
+            # Host pre-combine: unique sorted indexes + summed hits,
+            # plus each lane's position into the unique array.
+            uniq, inv = np.unique(idx, return_inverse=True)
+            sums = np.bincount(inv, weights=hits64.astype(np.float64))
+            m = len(uniq)
+            pin[2 + 3 * r, :m] = uniq.astype(np.int32)
+            if size > m:
+                pin[2 + 3 * r, m:] = (
+                    np.arange(self.width, self.width + (size - m), dtype=np.int64)
+                    .astype(np.int32)
+                )
+            pin[2 + 3 * r + 1, :m] = sums.astype(np.int64).astype(np.int32)
+            pin[2 + 3 * r + 2, :n] = inv.astype(np.int32)
+
+        self._state, out = self._step(self._state, jnp.asarray(pin))
+        arr = np.asarray(out)
+        est = (arr[0, :n].astype(np.int64) << 32) | (
+            arr[1, :n].astype(np.int64) & 0xFFFFFFFF
+        )
+        over = est > np.asarray(limit, dtype=np.int64)
+        return over, est
