@@ -6,9 +6,10 @@ request batch is routed host-side to its owning shard
 (fnv1a(key) mod n_shards — the TPU-native replacement for the worker
 hash ring, reference: gubernator_pool.go:183-187) and applied by ONE
 jitted shard_map step: every chip gathers/updates only its local state
-block, so the decision path needs zero inter-chip traffic; the step
-ends with a psum over the mesh (aggregate over-limit count) so cluster
-metrics ride ICI instead of per-shard host readbacks.
+block, so the decision path needs zero inter-chip traffic (PERF.md §7
+— the measured argument for why zero-ICI is the optimum here); the
+packed per-shard outputs return in the response readback, so cluster
+metrics cost no extra transfer.
 
 Per-key serialization and eviction-clear scheduling reuse the round
 scheme of the single-device engine (core/engine.py), applied per shard.
@@ -33,10 +34,7 @@ from gubernator_tpu.gregorian import (
 )
 from gubernator_tpu.hashing import fnv1a_64, fnv1a_64_batch, pack_keys
 from gubernator_tpu.ops.bucket_kernel import (
-    BatchInput,
     BucketState,
-    _apply_batch_impl,
-    _apply_core,
     make_state,
 )
 from gubernator_tpu.core.native import make_intern_table
@@ -113,7 +111,7 @@ class ShardedDecisionEngine:
             make_state(shard_capacity),
             state_spec,
         )
-        self._step = self._build_step()
+        self._build_step()
 
     # ------------------------------------------------------------------
 
@@ -121,33 +119,7 @@ class ShardedDecisionEngine:
         mesh = self.mesh
         cap = self.shard_capacity
 
-        def local_step(state, batch, clear, now):
-            state1 = _squeeze(state)
-            batch1 = _squeeze(batch)
-            new_state, out = _apply_batch_impl(state1, batch1, clear[0], now)
-            active = batch1.slot < cap
-            over = jnp.sum(
-                ((out.status == int(Status.OVER_LIMIT)) & active).astype(jnp.int32)
-            )
-            # Aggregate over the ICI mesh — cluster-wide over-limit count
-            # (the GLOBAL async all-reduce analog, SURVEY.md §2.2).
-            over = jax.lax.psum(over, KEYS_AXIS)
-            return _expand(new_state), _expand(out), over
-
         pspec = P(KEYS_AXIS)
-        state_specs = jax.tree.map(lambda _: pspec, make_state(0))
-        batch_specs = jax.tree.map(
-            lambda _: pspec,
-            BatchInput(*(0,) * len(BatchInput._fields)),
-        )
-        out_specs_batch = jax.tree.map(lambda _: pspec, _dummy_out())
-
-        stepped = jax.shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(state_specs, batch_specs, pspec, P()),
-            out_specs=(state_specs, out_specs_batch, P()),
-        )
 
         def local_clear(occupied, slots):
             # occupied/slots carry the leading shard axis inside
@@ -244,7 +216,6 @@ class ShardedDecisionEngine:
         # single-device fused step, so its copy-insertion behavior
         # probes identically at shard capacity.
         self._fused = fused_step_ok(self.shard_capacity)
-        return jax.jit(stepped, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
 
@@ -1024,9 +995,3 @@ class ShardedDecisionEngine:
 
     def close(self) -> None:
         pass
-
-
-def _dummy_out():
-    from gubernator_tpu.ops.bucket_kernel import BatchOutput
-
-    return BatchOutput(*(0,) * len(BatchOutput._fields))

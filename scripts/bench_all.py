@@ -50,6 +50,18 @@ CONFIGS: dict[str, dict] = {
         "BENCH_BATCH": "1000",
         "BENCH_KEYS": "100000",
         "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_WIRE_PROCS": "4",
+    },
+    # The 100M-slot HBM proof (BASELINE config 4 at full scale):
+    # 19 arrays x 4B x 100M = 7.6GB of device state on one v5e chip.
+    # TPU-only (the CPU fallback would also allocate 7.6GB, fine on
+    # this 125GB host, but the number is meaningless there).
+    "zipf100m": {
+        "BENCH_ZIPF": "1.2",
+        "BENCH_KEYS": "100000000",
+        "BENCH_CAPACITY": "100000000",
+        "BENCH_BATCH": "8192",
+        "BENCH_SECONDS": "8",
     },
 }
 

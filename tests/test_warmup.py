@@ -86,7 +86,6 @@ def test_sharded_warmup_covers_serving_widths(frozen_clock):
     before = tuple(
         f._cache_size()
         for f in (
-            engine._step,
             engine._packed_fused,
             engine._packed_compute,
             engine._step_scatter,
@@ -111,7 +110,6 @@ def test_sharded_warmup_covers_serving_widths(frozen_clock):
     after = tuple(
         f._cache_size()
         for f in (
-            engine._step,
             engine._packed_fused,
             engine._packed_compute,
             engine._step_scatter,
