@@ -151,12 +151,17 @@ class ReplicatedConsistentHash(Generic[T]):
 
     def get_batch(self, keys: Sequence[str]) -> List[T]:
         """Vectorized owner lookup for a whole request batch."""
-        if not self._member_list:
-            raise PoolEmptyError()
         if not keys:
             return []
         padded, lengths = pack_keys([k.encode() for k in keys])
-        hashes = _BATCH[self.hash_name](padded, lengths)
+        return self.get_batch_hashed(_BATCH[self.hash_name](padded, lengths))
+
+    def get_batch_hashed(self, hashes: np.ndarray) -> List[T]:
+        """Owner lookup from precomputed key hashes (the native wire
+        codec emits both fnv1 and fnv1a per key; pick the column
+        matching `hash_name`)."""
+        if not self._member_list:
+            raise PoolEmptyError()
         idx = np.searchsorted(self._hashes, hashes, side="left")
         idx[idx == len(self._hashes)] = 0
         owners = self._owner_idx[idx]

@@ -96,6 +96,27 @@ class _ZerosCache:
 _ZEROS_CACHE = _ZerosCache()
 
 
+class PackedKeys:
+    """Keys as one concatenated byte buffer + offsets — the native wire
+    codec's output format, consumed by the native table's
+    schedule_packed without materializing per-key Python objects."""
+
+    __slots__ = ("buf", "offsets", "count")
+
+    def __init__(self, buf: np.ndarray, offsets: np.ndarray, count: int):
+        self.buf = buf
+        self.offsets = offsets
+        self.count = count
+
+    def __len__(self) -> int:
+        return self.count
+
+    def to_list(self) -> List[bytes]:
+        raw = self.buf.tobytes()
+        off = self.offsets
+        return [raw[off[i] : off[i + 1]] for i in range(self.count)]
+
+
 class PendingColumnar:
     """In-flight columnar batch: device work dispatched, packed outputs
     copying to host asynchronously.  `.get()` materializes (status,
@@ -692,11 +713,19 @@ class DecisionEngine:
         greg_dur, greg_exp, greg_mask, now_ms,
     ):
         n = len(keys)
-        if hasattr(self.table, "schedule"):
+        if isinstance(keys, PackedKeys) and hasattr(self.table, "schedule_packed"):
+            slots, rounds_arr, evicted, evict_rounds = self.table.schedule_packed(
+                keys.buf, keys.offsets, now_ms
+            )
+        elif hasattr(self.table, "schedule"):
+            if isinstance(keys, PackedKeys):
+                keys = keys.to_list()
             slots, rounds_arr, evicted, evict_rounds = self.table.schedule(
                 keys, now_ms
             )
         else:
+            if isinstance(keys, PackedKeys):
+                keys = keys.to_list()
             slots = np.empty(n, dtype=_I32)
             rounds_arr = np.empty(n, dtype=_I32)
             seq: dict[int, int] = {}

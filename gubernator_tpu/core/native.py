@@ -47,6 +47,20 @@ def load_library():
         ctypes.c_void_p,  # out_evict_rounds
         ctypes.c_void_p,  # stats_out
     ]
+    lib.git_schedule_idx.restype = ctypes.c_int64
+    lib.git_schedule_idx.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,  # buf
+        ctypes.c_void_p,  # offsets
+        ctypes.c_void_p,  # idx (nullable)
+        ctypes.c_int64,  # n
+        ctypes.c_int64,  # now_ms
+        ctypes.c_void_p,  # out_slots
+        ctypes.c_void_p,  # out_rounds
+        ctypes.c_void_p,  # out_evicted
+        ctypes.c_void_p,  # out_evict_rounds
+        ctypes.c_void_p,  # stats_out
+    ]
     lib.git_set_expiry.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
@@ -114,15 +128,34 @@ class NativeInternTable:
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(k) for k in keys], out=offsets[1:])
         buf_arr = np.frombuffer(buf, dtype=np.uint8) if buf else np.zeros(1, np.uint8)
+        return self.schedule_packed(buf_arr, offsets, now_ms)
+
+    def schedule_packed(
+        self,
+        buf_arr: np.ndarray,  # uint8 concatenated key bytes
+        offsets: np.ndarray,  # int64 [total+1]
+        now_ms: int,
+        idx: Optional[np.ndarray] = None,  # int64 subset (None = all)
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Like schedule(), but over an already-packed key buffer (the
+        native wire codec's output) — zero per-key Python.  `idx`
+        selects a subset of items (the sharded engine's per-shard
+        routing over one decoded batch)."""
+        n = len(idx) if idx is not None else len(offsets) - 1
+        buf_arr = np.ascontiguousarray(buf_arr, dtype=np.uint8)
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        if idx is not None:
+            idx = np.ascontiguousarray(idx, dtype=np.int64)
         slots = np.empty(n, dtype=np.int32)
         rounds = np.empty(n, dtype=np.int32)
         evicted = np.empty(n if n else 1, dtype=np.int32)
         evict_rounds = np.empty(n if n else 1, dtype=np.int32)
         stats = np.zeros(4, dtype=np.int64)
-        n_ev = self._lib.git_schedule(
+        n_ev = self._lib.git_schedule_idx(
             self._t,
             _ptr(buf_arr),
             _ptr(offsets),
+            _ptr(idx) if idx is not None else None,
             n,
             now_ms,
             _ptr(slots),

@@ -212,16 +212,21 @@ int64_t git_len(void* t) { return static_cast<Table*>(t)->used; }
 // out_slots[n], out_rounds[n]; out_evicted/out_evict_rounds sized n.
 // Returns the number of evictions.  stats_out[4]: hits, misses,
 // evictions, unexpired_evictions (cumulative totals).
-int64_t git_schedule(void* tp, const uint8_t* buf, const int64_t* offsets,
-                     int64_t n, int64_t now_ms, int32_t* out_slots,
-                     int32_t* out_rounds, int32_t* out_evicted,
-                     int32_t* out_evict_rounds, int64_t* stats_out) {
+// `idx`: optional indirection — schedule items buf[offsets[idx[j]]..]
+// for j in [0, n) (the sharded engine's per-shard subsets over ONE
+// decoded wire buffer; nullptr = identity).
+int64_t git_schedule_idx(void* tp, const uint8_t* buf, const int64_t* offsets,
+                         const int64_t* idx, int64_t n, int64_t now_ms,
+                         int32_t* out_slots, int32_t* out_rounds,
+                         int32_t* out_evicted, int32_t* out_evict_rounds,
+                         int64_t* stats_out) {
   Table& t = *static_cast<Table*>(tp);
   ++t.epoch;
   int64_t n_evicted = 0;
   for (int64_t j = 0; j < n; ++j) {
-    const uint8_t* key = buf + offsets[j];
-    const int64_t len = offsets[j + 1] - offsets[j];
+    const int64_t item = idx ? idx[j] : j;
+    const uint8_t* key = buf + offsets[item];
+    const int64_t len = offsets[item + 1] - offsets[item];
     const uint64_t h = fnv1a(key, len);
     uint64_t at;
     int32_t slot = t.find(h, key, len, &at);
@@ -266,6 +271,15 @@ int64_t git_schedule(void* tp, const uint8_t* buf, const int64_t* offsets,
   stats_out[2] = t.evictions;
   stats_out[3] = t.unexpired_evictions;
   return n_evicted;
+}
+
+int64_t git_schedule(void* tp, const uint8_t* buf, const int64_t* offsets,
+                     int64_t n, int64_t now_ms, int32_t* out_slots,
+                     int32_t* out_rounds, int32_t* out_evicted,
+                     int32_t* out_evict_rounds, int64_t* stats_out) {
+  return git_schedule_idx(tp, buf, offsets, nullptr, n, now_ms, out_slots,
+                          out_rounds, out_evicted, out_evict_rounds,
+                          stats_out);
 }
 
 void git_set_expiry(void* tp, const int32_t* slots, const int64_t* expires,

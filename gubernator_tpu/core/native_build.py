@@ -17,19 +17,18 @@ from typing import Optional
 
 log = logging.getLogger("gubernator_tpu.native")
 
-_SRC = Path(__file__).parent / "native" / "intern_table.cpp"
-_BUILD_DIR = Path(__file__).parent / "native" / "build"
+_NATIVE_DIR = Path(__file__).parent / "native"
+_BUILD_DIR = _NATIVE_DIR / "build"
 
 
-def _source_tag() -> str:
-    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-
-
-def ensure_built() -> Optional[Path]:
-    """Compile if needed; returns the .so path or None on failure."""
+def ensure_built(stem: str = "intern_table") -> Optional[Path]:
+    """Compile `native/<stem>.cpp` if needed; returns the .so path or
+    None on failure."""
     if os.environ.get("GUBERNATOR_TPU_NATIVE", "1") == "0":
         return None
-    so = _BUILD_DIR / f"intern_table-{_source_tag()}.so"
+    src = _NATIVE_DIR / f"{stem}.cpp"
+    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    so = _BUILD_DIR / f"{stem}-{tag}.so"
     if so.exists():
         return so
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
@@ -44,21 +43,22 @@ def ensure_built() -> Optional[Path]:
         "-fPIC",
         "-o",
         str(tmp),
-        str(_SRC),
+        str(src),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
         detail = getattr(e, "stderr", b"")
         log.warning(
-            "native intern table build failed (falling back to Python): %s %s",
+            "native %s build failed (falling back to Python): %s %s",
+            stem,
             e,
             detail.decode(errors="replace") if detail else "",
         )
         return None
     os.replace(tmp, so)
     # Drop stale builds of older source versions.
-    for old in _BUILD_DIR.glob("intern_table-*.so"):
+    for old in _BUILD_DIR.glob(f"{stem}-*.so"):
         if old != so:
             old.unlink(missing_ok=True)
     return so

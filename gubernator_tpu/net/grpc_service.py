@@ -53,17 +53,26 @@ def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
     )
 
 
+def _unary_raw(fn: Callable) -> grpc.RpcMethodHandler:
+    """Handler that receives the UNDESERIALIZED request bytes and may
+    return either raw response bytes (native wire-codec fast path) or
+    a protobuf message (slow path) — see net/server.py."""
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=lambda raw: raw,
+        response_serializer=lambda resp: (
+            resp if isinstance(resp, bytes) else resp.SerializeToString()
+        ),
+    )
+
+
 def add_v1_to_server(servicer: V1Servicer, server: grpc.Server) -> None:
     server.add_generic_rpc_handlers(
         (
             grpc.method_handlers_generic_handler(
                 V1_SERVICE,
                 {
-                    "GetRateLimits": _unary(
-                        servicer.GetRateLimits,
-                        pb.GetRateLimitsReq,
-                        pb.GetRateLimitsResp,
-                    ),
+                    "GetRateLimits": _unary_raw(servicer.GetRateLimits),
                     "HealthCheck": _unary(
                         servicer.HealthCheck,
                         pb.HealthCheckReq,
@@ -81,10 +90,8 @@ def add_peers_v1_to_server(servicer: PeersV1Servicer, server: grpc.Server) -> No
             grpc.method_handlers_generic_handler(
                 PEERS_SERVICE,
                 {
-                    "GetPeerRateLimits": _unary(
-                        servicer.GetPeerRateLimits,
-                        peers_pb.GetPeerRateLimitsReq,
-                        peers_pb.GetPeerRateLimitsResp,
+                    "GetPeerRateLimits": _unary_raw(
+                        servicer.GetPeerRateLimits
                     ),
                     "UpdatePeerGlobals": _unary(
                         servicer.UpdatePeerGlobals,

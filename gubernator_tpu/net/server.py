@@ -25,14 +25,9 @@ _CODE = {
     "INTERNAL": grpc.StatusCode.INTERNAL,
 }
 
-# Behaviors that need the dataclass path: GLOBAL (status cache + async
-# queues), MULTI_REGION (region queues), Gregorian durations (per-item
-# civil-time validation with error-in-response).
-_COLUMNAR_DISQUALIFIERS = (
-    int(Behavior.GLOBAL)
-    | int(Behavior.MULTI_REGION)
-    | int(Behavior.DURATION_IS_GREGORIAN)
-)
+# Behaviors that need the dataclass path (defined next to the service
+# core; the native wire codec shares the same mask).
+from gubernator_tpu.service import COLUMNAR_DISQUALIFIERS as _COLUMNAR_DISQUALIFIERS  # noqa: E402
 
 
 def _decode_columns(items) -> Optional[Tuple]:
@@ -91,6 +86,20 @@ class GrpcV1Adapter:
         self.instance = instance
 
     def GetRateLimits(self, request, context):
+        # The method handler passes RAW request bytes (grpc_service
+        # _unary_raw): the native codec path serves the whole RPC in
+        # compiled code when it can.
+        if isinstance(request, (bytes, memoryview)):
+            out_raw = self.instance.serve_wire_bytes(request)
+            if out_raw is not None:
+                return out_raw
+            try:
+                request = pb.GetRateLimitsReq.FromString(request)
+            except Exception:  # noqa: BLE001 — match the framework
+                # deserializer's client-visible INTERNAL status.
+                context.abort(
+                    grpc.StatusCode.INTERNAL, "Exception deserializing request!"
+                )
         cols = _decode_columns(request.requests)
         if cols is not None:
             keys_str, keys_bytes, *columns = cols
@@ -119,6 +128,18 @@ class GrpcPeersV1Adapter:
     def GetPeerRateLimits(self, request, context):
         # Owner side of a forwarded batch: answered authoritatively
         # (never re-forwarded), so no ownership check is needed.
+        if isinstance(request, (bytes, memoryview)):
+            out_raw = self.instance.serve_wire_bytes(
+                request, check_ownership=False
+            )
+            if out_raw is not None:
+                return out_raw
+            try:
+                request = peers_pb.GetPeerRateLimitsReq.FromString(request)
+            except Exception:  # noqa: BLE001 — see GetRateLimits
+                context.abort(
+                    grpc.StatusCode.INTERNAL, "Exception deserializing request!"
+                )
         cols = _decode_columns(request.requests)
         if cols is not None:
             keys_str, keys_bytes, *columns = cols

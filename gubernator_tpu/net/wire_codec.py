@@ -1,0 +1,149 @@
+"""ctypes wrapper over the native wire codec (wire_codec.cpp).
+
+`decode_reqs(raw)` turns one GetRateLimitsReq/GetPeerRateLimitsReq
+payload into engine-ready columns — the concatenated key buffer +
+offsets that the native intern table's schedule() consumes directly,
+plus per-key FNV ring hashes — and `encode_resps(...)` assembles the
+response bytes straight from the engine's output columns.  Together
+they remove every per-item protobuf object from the served hot path
+(profiled ~3.2ms per 1000-item batch in Python; see PERF.md).
+
+Falls back cleanly: `load()` returns None when the native toolchain is
+unavailable (GUBERNATOR_TPU_NATIVE=0 or g++ missing), and decode
+returns None for any batch the columnar path can't serve (disqualifying
+behaviors, empty name/key, malformed bytes) — callers then use the
+protobuf path, so behavior is identical, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from gubernator_tpu.core.native_build import ensure_built
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class DecodedBatch(NamedTuple):
+    n: int
+    key_buf: np.ndarray  # uint8 [total_key_bytes]
+    key_offsets: np.ndarray  # int64 [n+1]
+    algo: np.ndarray  # int32 [n]
+    behavior: np.ndarray  # int32 [n]
+    hits: np.ndarray  # int64 [n]
+    limit: np.ndarray  # int64 [n]
+    duration: np.ndarray  # int64 [n]
+    burst: np.ndarray  # int64 [n]
+    fnv1: np.ndarray  # uint64 [n]
+    fnv1a: np.ndarray  # uint64 [n]
+
+
+def load():
+    """Load (building if needed) the codec library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = ensure_built("wire_codec")
+        if so is None:
+            return None
+        lib = ctypes.CDLL(str(so))
+        lib.wire_decode_reqs.restype = ctypes.c_int64
+        # (buf, len, max_items, disqualify_mask, key_buf, key_cap,
+        #  key_offsets, algo, behavior, hits, limit, duration, burst,
+        #  fnv1, fnv1a) — key_cap is an int64 BETWEEN pointers; the
+        # full 15-entry list must match the C signature exactly.
+        lib.wire_decode_reqs.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ] + [ctypes.c_void_p] * 9
+        lib.wire_encode_resps.restype = ctypes.c_int64
+        lib.wire_encode_resps.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _lib = lib
+    return _lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def decode_reqs(
+    raw: bytes, max_items: int, disqualify_mask: int
+) -> Optional[DecodedBatch]:
+    """Decode or decline.  None ⇒ caller takes the protobuf path
+    (malformed input included — the pb parser then produces the proper
+    error)."""
+    lib = load()
+    if lib is None or not raw:
+        return None
+    # Key bytes + one '_' per item always fit in len(raw): each item's
+    # wire framing alone costs more than the added separator byte.
+    key_cap = len(raw)
+    key_buf = np.empty(key_cap, dtype=np.uint8)
+    key_offsets = np.empty(max_items + 1, dtype=np.int64)
+    algo = np.empty(max_items, dtype=np.int32)
+    behavior = np.empty(max_items, dtype=np.int32)
+    hits = np.empty(max_items, dtype=np.int64)
+    limit = np.empty(max_items, dtype=np.int64)
+    duration = np.empty(max_items, dtype=np.int64)
+    burst = np.empty(max_items, dtype=np.int64)
+    fnv1 = np.empty(max_items, dtype=np.uint64)
+    fnv1a = np.empty(max_items, dtype=np.uint64)
+    n = lib.wire_decode_reqs(
+        raw, len(raw), max_items, disqualify_mask,
+        _ptr(key_buf), key_cap, _ptr(key_offsets), _ptr(algo),
+        _ptr(behavior), _ptr(hits), _ptr(limit), _ptr(duration),
+        _ptr(burst), _ptr(fnv1), _ptr(fnv1a),
+    )
+    if n <= 0:
+        # -2 (too many items) must surface as the RPC-level batch error;
+        # the pb path re-parses and raises it.  All other declines are
+        # equivalent fallbacks.
+        return None
+    return DecodedBatch(
+        n=int(n),
+        key_buf=key_buf[: key_offsets[n]],
+        key_offsets=key_offsets[: n + 1],
+        algo=algo[:n],
+        behavior=behavior[:n],
+        hits=hits[:n],
+        limit=limit[:n],
+        duration=duration[:n],
+        burst=burst[:n],
+        fnv1=fnv1[:n],
+        fnv1a=fnv1a[:n],
+    )
+
+
+def encode_resps(
+    status: np.ndarray,
+    limit: np.ndarray,
+    remaining: np.ndarray,
+    reset_time: np.ndarray,
+) -> bytes:
+    """Columns → GetRateLimitsResp/GetPeerRateLimitsResp bytes."""
+    lib = load()
+    assert lib is not None, "encode_resps requires the native codec"
+    n = len(status)
+    status = np.ascontiguousarray(status, dtype=np.int32)
+    limit = np.ascontiguousarray(limit, dtype=np.int64)
+    remaining = np.ascontiguousarray(remaining, dtype=np.int64)
+    reset_time = np.ascontiguousarray(reset_time, dtype=np.int64)
+    # Worst case per item: tag+len (6) + 4 fields × (1 tag + 10 varint).
+    out = np.empty(n * 52 + 16, dtype=np.uint8)
+    written = lib.wire_encode_resps(
+        _ptr(status), _ptr(limit), _ptr(remaining), _ptr(reset_time),
+        n, _ptr(out), len(out),
+    )
+    assert written >= 0
+    return out[:written].tobytes()

@@ -648,7 +648,7 @@ class ShardedDecisionEngine:
 
     def apply_columnar(
         self,
-        keys: List[bytes],
+        keys,  # List[bytes] | core.engine.PackedKeys
         algo: np.ndarray,
         behavior: np.ndarray,
         hits: np.ndarray,
@@ -657,6 +657,7 @@ class ShardedDecisionEngine:
         burst: np.ndarray,
         now_ms: Optional[int] = None,
         want_async: bool = False,
+        route_hashes: Optional[np.ndarray] = None,  # uint64 fnv1a per key
     ):
         if self.store is not None:
             raise RuntimeError(
@@ -683,7 +684,7 @@ class ShardedDecisionEngine:
         with self._lock, span("engine.columnar", batch=n):
             pending = self._apply_columnar_locked(
                 keys, algo, behavior, hits, limit, duration, burst,
-                greg_dur, greg_exp, greg_mask, now_ms,
+                greg_dur, greg_exp, greg_mask, now_ms, route_hashes,
             )
             self.requests_total += n
             self.batches_total += 1
@@ -691,17 +692,29 @@ class ShardedDecisionEngine:
 
     def _apply_columnar_locked(
         self, keys, algo, behavior, hits, limit, duration, burst,
-        greg_dur, greg_exp, greg_mask, now_ms,
+        greg_dur, greg_exp, greg_mask, now_ms, route_hashes=None,
     ):
+        from gubernator_tpu.core.engine import PackedKeys
+
         n_sh = self.n_shards
         cap = self.shard_capacity
         n = len(keys)
+        packed = keys if isinstance(keys, PackedKeys) else None
+        if packed is not None and not all(
+            hasattr(t, "schedule_packed") for t in self.tables
+        ):
+            keys = packed.to_list()
+            packed = None
 
-        # 1. Vectorized shard routing: one FNV-1a pass over the batch.
-        padded, lengths = pack_keys(keys)
-        shards = (fnv1a_64_batch(padded, lengths) % np.uint64(n_sh)).astype(
-            np.int64
-        )
+        # 1. Vectorized shard routing: one FNV-1a pass over the batch
+        # (or the wire codec's precomputed hashes, when given).
+        if route_hashes is not None:
+            hashes = np.asarray(route_hashes, dtype=np.uint64)
+        else:
+            assert packed is None, "PackedKeys requires route_hashes"
+            padded, lengths = pack_keys(keys)
+            hashes = fnv1a_64_batch(padded, lengths)
+        shards = (hashes % np.uint64(n_sh)).astype(np.int64)
 
         # 2. Per-shard native scheduling.
         shard_idx: List[np.ndarray] = []  # request indices per shard
@@ -717,7 +730,12 @@ class ShardedDecisionEngine:
                 shard_rounds.append(np.empty(0, dtype=_I32))
                 continue
             table = self.tables[sh]
-            if hasattr(table, "schedule"):
+            if packed is not None:
+                slots, rounds, evicted, evict_rounds = table.schedule_packed(
+                    packed.buf, packed.offsets, now_ms,
+                    idx=idx.astype(np.int64),
+                )
+            elif hasattr(table, "schedule"):
                 slots, rounds, evicted, evict_rounds = table.schedule(
                     [keys[i] for i in idx], now_ms
                 )
