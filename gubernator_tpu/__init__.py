@@ -25,6 +25,23 @@ if os.environ.get("GUBERNATOR_TPU_X64", "1") != "0":  # pragma: no branch
 
     jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: daemon warmup precompiles a ladder
+# of batch widths (engine.warmup), and a TPU compile costs 5-40s each —
+# the cache makes every process after the first start in seconds.
+# Opt out with GUBERNATOR_TPU_COMPILE_CACHE=0.
+if os.environ.get("GUBERNATOR_TPU_COMPILE_CACHE", "1") != "0":
+    import jax
+
+    _cache_dir = os.environ.get(
+        "GUBERNATOR_TPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        pass
+
 from gubernator_tpu._version import __version__
 from gubernator_tpu.types import (
     Algorithm,

@@ -202,6 +202,15 @@ def test_leaky_bucket_with_burst(engine, frozen_clock):
 
 def test_leaky_bucket_gregorian(engine, frozen_clock):
     """reference: functional_test.go:601-664 (TestLeakyBucketGregorian)"""
+    # The Gregorian leaky rate is (ms remaining in the current minute)
+    # / limit, so the expected leak depends on where in the minute the
+    # first hit lands — pin the clock early in a minute instead of
+    # freezing at the wall time (flaked when the suite crossed a minute
+    # boundary's tail; observed at a midnight rollover).
+    frozen_clock.freeze_at(
+        (frozen_clock.now_ms() // 60_000 * 60_000 + 5_000) * 1_000_000
+    )
+    engine.clock = frozen_clock
     table = [
         ("first hit", 1, 59, Status.UNDER_LIMIT, 500),
         ("second hit; no leak", 1, 58, Status.UNDER_LIMIT, SECOND),
