@@ -190,21 +190,19 @@ def _key_indices(np, n_batches: int):
     ]
 
 
-def _algo_column(np, n: int):
+def _algo_column(np, key_idx):
+    """Algorithm per KEY (it is a property of the limit's name in real
+    traffic — reference: request-carried config keyed by name), so
+    duplicate occurrences of a key agree and hot-key segments stay
+    collapsible."""
     from gubernator_tpu import Algorithm
 
+    n = len(key_idx)
     if ALGO == "token":
         return np.full(n, int(Algorithm.TOKEN_BUCKET), dtype=np.int32)
     if ALGO == "leaky":
         return np.full(n, int(Algorithm.LEAKY_BUCKET), dtype=np.int32)
-    return np.fromiter(
-        (
-            int(Algorithm.TOKEN_BUCKET if i % 2 == 0 else Algorithm.LEAKY_BUCKET)
-            for i in range(n)
-        ),
-        dtype=np.int32,
-        count=n,
-    )
+    return (np.asarray(key_idx) % 2).astype(np.int32)
 
 
 def _run_engine(np, platform: str) -> dict:
@@ -231,7 +229,7 @@ def _run_engine(np, platform: str) -> dict:
         batches.append(
             dict(
                 keys=keys,
-                algo=_algo_column(np, BATCH),
+                algo=_algo_column(np, idx),
                 behavior=np.zeros(BATCH, dtype=np.int32),
                 hits=np.ones(BATCH, dtype=np.int64),
                 limit=np.full(BATCH, 1_000_000, dtype=np.int64),

@@ -32,9 +32,18 @@ if os.environ.get("GUBERNATOR_TPU_X64", "1") != "0":  # pragma: no branch
 if os.environ.get("GUBERNATOR_TPU_COMPILE_CACHE", "1") != "0":
     import jax
 
-    _cache_dir = os.environ.get(
-        "GUBERNATOR_TPU_COMPILE_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+    _repo_root = os.path.dirname(os.path.dirname(__file__))
+    _cache_dir = os.environ.get("GUBERNATOR_TPU_COMPILE_CACHE_DIR") or (
+        os.path.join(_repo_root, ".jax_cache")
+        # Source checkout: cache next to the code.  Installed package:
+        # the parent is site-packages — use the user cache dir instead.
+        if os.path.isdir(os.path.join(_repo_root, ".git"))
+        else os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "gubernator_tpu",
+            "jax",
+        )
     )
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)

@@ -41,11 +41,14 @@ from gubernator_tpu.ops.bucket_kernel import (
     SlotRecord,
     apply_batch,
     clear_occupied,
+    collapsed_compute,
+    collapsed_step,
     fused_step,
     fused_step_ok,
     load_slots,
     make_state,
     pack_batch_host,
+    pack_collapsed_host,
     packed_compute,
     scatter_store,
 )
@@ -478,20 +481,27 @@ class DecisionEngine:
                 requests, valid_idx, greg_dur, now_ms, responses, host_expire
             )
 
-    def _dispatch_packed(self, buf: np.ndarray):
-        """Run one packed round on device; returns the packed output
-        (device array, caller starts the async readback)."""
+    def _dispatch(self, buf: np.ndarray, fused_fn, compute_fn):
+        """One device round: single h2d of the packed buffer, then the
+        fused donated kernel (or the split compute + scatter pair);
+        returns the packed output (caller starts the async readback)."""
         import time as _time
 
         t0 = _time.monotonic()
-        pin = jnp.asarray(buf)  # the round's single h2d transfer
+        pin = jnp.asarray(buf)
         if self._fused:
-            self._state, pout = fused_step(self._state, pin)
+            self._state, pout = fused_fn(self._state, pin)
         else:
-            slot_dev, vals, pout = packed_compute(self._state, pin)
+            slot_dev, vals, pout = compute_fn(self._state, pin)
             self._state = scatter_store(self._state, slot_dev, vals)
         self.round_duration.observe(_time.monotonic() - t0)
         return pout
+
+    def _dispatch_collapsed(self, buf: np.ndarray):
+        return self._dispatch(buf, collapsed_step, collapsed_compute)
+
+    def _dispatch_packed(self, buf: np.ndarray):
+        return self._dispatch(buf, fused_step, packed_compute)
 
     def _apply_clears(self, cleared: np.ndarray) -> None:
         """Eviction clears: a separate tiny scatter so the apply
@@ -749,6 +759,32 @@ class DecisionEngine:
             greg_exp = greg_dur
 
         max_round = int(rounds_arr.max()) if n else 0
+        pieces: Optional[List[tuple]] = None
+        if max_round > 0:
+            # Hot-key batches: one dispatch per duplicate would be the
+            # worst case (Zipf traffic measured ~1500 rounds/batch);
+            # uniform duplicate segments collapse to ONE dispatch with
+            # exact sequential semantics (bucket_kernel closed form).
+            pieces = self._try_collapse(
+                slots, algo, behavior, hits, limit, duration, burst,
+                greg_dur, greg_exp, now_ms, evicted, evict_rounds,
+            )
+        if pieces is None:
+            pieces = self._dispatch_rounds(
+                slots, rounds_arr, max_round, algo, behavior, hits,
+                limit, duration, burst, greg_dur, greg_exp, now_ms,
+                evicted, evict_rounds, n,
+            )
+
+        expires = np.where(greg_mask, greg_exp, now_ms + duration)
+        self.table.set_expiry(slots, expires.astype(_I64))
+        return PendingColumnar(self, pieces, limit, n)
+
+    def _dispatch_rounds(
+        self, slots, rounds_arr, max_round, algo, behavior, hits, limit,
+        duration, burst, greg_dur, greg_exp, now_ms, evicted,
+        evict_rounds, n,
+    ) -> List[tuple]:
         if max_round == 0:
             round_members = [(0, None)]  # None = all lanes, no gather
         else:
@@ -811,10 +847,90 @@ class DecisionEngine:
                 else:
                     dst_idx = members[lo:hi][sort_idx]
                 pieces.append((pout, dst_idx, m, size))
+        return pieces
 
-        expires = np.where(greg_mask, greg_exp, now_ms + duration)
-        self.table.set_expiry(slots, expires.astype(_I64))
-        return PendingColumnar(self, pieces, limit, n)
+    def _try_collapse(
+        self, slots, algo, behavior, hits, limit, duration, burst,
+        greg_dur, greg_exp, now_ms, evicted, evict_rounds,
+    ) -> Optional[List[tuple]]:
+        """Collapse uniform duplicate segments into one dispatch each
+        chunk; returns pieces, or None when the batch needs rounds
+        (non-uniform duplicate fields, RESET_REMAINING on a duplicate,
+        or a mid-batch slot reuse via eviction)."""
+        # Mid-batch eviction reuse (a slot freed after use and handed
+        # to ANOTHER key in the same batch) breaks the one-key-per-
+        # segment invariant.
+        if len(evict_rounds) and int(evict_rounds.max()) > 0:
+            return None
+        n = len(slots)
+        order = np.argsort(slots, kind="stable")  # stable = arrival order
+        sorted_slots = slots[order]
+        uniq, seg_start, counts = np.unique(
+            sorted_slots, return_index=True, return_counts=True
+        )
+        seg_of = np.repeat(np.arange(len(uniq), dtype=np.int64), counts)
+        dup_lane = counts[seg_of] > 1
+        cols = (algo, behavior, hits, limit, duration, burst,
+                greg_dur, greg_exp)
+        for col in cols:
+            cs = col[order]
+            if not np.array_equal(
+                cs[dup_lane], cs[seg_start][seg_of][dup_lane]
+            ):
+                return None
+        beh_sorted = behavior[order]
+        if bool(
+            ((beh_sorted & int(Behavior.RESET_REMAINING)) != 0)[dup_lane].any()
+        ):
+            return None
+        # Sequential leaky semantics re-clamp remaining to burst on
+        # EVERY gather; with negative hits the closed form would skip
+        # the intermediate clamps — keep those (rare) on the rounds
+        # path.
+        if bool(
+            (
+                (algo[order] == int(Algorithm.LEAKY_BUCKET))
+                & (hits[order] < 0)
+            )[dup_lane].any()
+        ):
+            return None
+
+        # All clears are round 0 here: run them before dispatching.
+        if len(evicted):
+            self._apply_clears(np.asarray(evicted, dtype=_I32))
+
+        sorted_cols = tuple(col[order] for col in cols)
+        pieces: List[tuple] = []
+        for lo in range(0, n, self.max_kernel_width):
+            hi = min(lo + self.max_kernel_width, n)
+            m = hi - lo
+            # Per-chunk segments (a segment split across chunks is
+            # fine: the next chunk's first occurrence re-gathers the
+            # post-scatter state — still exact).
+            c_slots = sorted_slots[lo:hi]
+            c_uniq, c_start, c_counts = np.unique(
+                c_slots, return_index=True, return_counts=True
+            )
+            c_seg_of = np.repeat(
+                np.arange(len(c_uniq), dtype=np.int64), c_counts
+            )
+            c_pos = np.arange(m, dtype=np.int64) - c_start[c_seg_of]
+            size = _pad_size(m)
+            buf = pack_collapsed_host(
+                size,
+                now_ms,
+                self.capacity,
+                np.ascontiguousarray(c_uniq, dtype=_I32),
+                c_counts.astype(np.int64),
+                tuple(c[lo:hi][c_start] for c in sorted_cols),
+                c_seg_of.astype(_I32),
+                c_pos.astype(_I32),
+            )
+            pout = self._dispatch_collapsed(buf)
+            pout.copy_to_host_async()
+            self.rounds_total += 1
+            pieces.append((pout, order[lo:hi], m, size))
+        return pieces
 
     # ------------------------------------------------------------------
     # Bulk persistence (reference: store.go:69-78 Loader; the pool-level
@@ -963,6 +1079,18 @@ class DecisionEngine:
                     np.zeros(width, dtype=_I32),
                     np.zeros(width, dtype=_I32),
                     np.zeros(width, dtype=_I64),  # hits=0: report-only
+                    np.ones(width, dtype=_I64),
+                    np.ones(width, dtype=_I64),
+                    np.zeros(width, dtype=_I64),
+                    now_ms=now,
+                )
+                # Duplicate keys → the collapsed-segment program (a
+                # separate compile family from the packed step).
+                self.apply_columnar(
+                    [b"__warmup__dup" for _ in range(width)],
+                    np.zeros(width, dtype=_I32),
+                    np.zeros(width, dtype=_I32),
+                    np.zeros(width, dtype=_I64),
                     np.ones(width, dtype=_I64),
                     np.ones(width, dtype=_I64),
                     np.zeros(width, dtype=_I64),

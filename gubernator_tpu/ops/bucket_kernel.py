@@ -846,6 +846,186 @@ packed_compute = jax.jit(_packed_compute_core)
 import functools
 
 
+# ---------------------------------------------------------------------------
+# Collapsed duplicate-segment step.
+#
+# A batch with a hot key repeated m times would cost m serialization
+# rounds (m device dispatches) under the rounds scheme — Zipf traffic
+# measured 12k dec/s on the zipf bench config because of exactly this.
+# When every occurrence of a key in the batch carries IDENTICAL request
+# fields (the overwhelmingly common case — same limit/duration/hits per
+# client), the sequential semantics have a CLOSED FORM:
+#
+#   After the first application (handled by the full _compute_update),
+#   the remaining m-1 occurrences see an existing item with unchanged
+#   config and zero elapsed time (same `now`), so each either consumes
+#   `h` or is rejected without consuming.  With R1 = remaining after
+#   the first application, the number of accepted extras is
+#   a2 = clip(R1 // h, 0, m-1) (all, for h <= 0), occurrence p
+#   (0-based among extras) responds
+#     accepted (p < a2):  remaining R1-(p+1)h, sticky/UNDER status
+#     rejected:           remaining R1-a2·h, OVER
+#   and the stored remaining is R1 - a2·h.  The token bucket's sticky
+#   status flips to OVER iff some extra actually saw remaining==0
+#   (h > 0, R1-a2·h == 0, a2 < m-1).  The leaky bucket is identical
+#   over floor(rem_f) with reset_time = now + (limit - rem_resp)·rate.
+#
+# One dispatch therefore serves ALL duplicates exactly; the kernel
+# fuzz (tests/test_collapse.py) pins equality with the sequential
+# scalar spec.  Segments with RESET_REMAINING, mid-batch slot reuse
+# (eviction rounds > 0), or non-uniform fields fall back to rounds.
+#
+# Packed layout (int32 [COLLAPSED_IN_ROWS, W]):
+#   row 0   header [now_hi, now_lo]
+#   rows 1-16   SEGMENT level (first S lanes real; padding = m 0 +
+#               ascending out-of-range slots): slot, m, algo, behavior,
+#               hits, limit, duration, burst, greg_dur, greg_exp
+#               (64-bit as hi/lo pairs)
+#   row 17  lane → segment index;  row 18  lane → position in segment
+# Output rows are PACKED_OUT_ROWS, lane order.
+
+COLLAPSED_IN_ROWS = 19
+
+
+def _collapsed_values(state: BucketState, pin: jax.Array):
+    now = (pin[0, 0].astype(_I64) << 32) | (pin[0, 1].astype(_I64) & 0xFFFFFFFF)
+    slot = pin[1]
+    m = pin[2].astype(_I64)
+    s_algo = pin[3]
+    s_beh = pin[4]
+
+    def r64(hi, lo):
+        return (pin[hi].astype(_I64) << 32) | (pin[lo].astype(_I64) & 0xFFFFFFFF)
+
+    s_hits = r64(5, 6)
+    s_limit = r64(7, 8)
+    s_dur = r64(9, 10)
+    s_burst = r64(11, 12)
+    s_gdur = r64(13, 14)
+    s_gexp = r64(15, 16)
+    seg = pin[17]
+    pos = pin[18].astype(_I64)
+
+    # First application per segment: the full bucket update.
+    vals, st1, rem1, rst1 = _compute_update(
+        state, state.occupied, slot, s_algo, s_beh, s_hits, s_limit,
+        s_dur, s_burst, s_gdur, s_gexp, now,
+    )
+
+    extras = jnp.maximum(m - 1, 0)
+    h = s_hits
+    h_safe = jnp.maximum(h, 1)
+    is_tok = s_algo == int(Algorithm.TOKEN_BUCKET)
+
+    # Token extras.
+    R1 = vals.remaining
+    a2_tok = jnp.where(h > 0, jnp.clip(R1 // h_safe, 0, extras), extras)
+    rem2_tok = R1 - a2_tok * h
+    sticky_over = (h > 0) & (rem2_tok == 0) & (a2_tok < extras)
+    status2 = jnp.where(sticky_over & is_tok, _OVER, vals.status).astype(_I32)
+
+    # Leaky extras (over floor of the fixed-point remaining).
+    W1f = vals.rem_f
+    W1 = W1f.astype(_I64)
+    a2_lk = jnp.where(h > 0, jnp.clip(W1 // h_safe, 0, extras), extras)
+    rem2_lkf = W1f - (a2_lk * h).astype(_F64)
+
+    vals2 = vals._replace(
+        remaining=jnp.where(is_tok, rem2_tok, vals.remaining),
+        status=status2,
+        rem_f=jnp.where(is_tok, vals.rem_f, rem2_lkf),
+    )
+
+    # Leaky reset slope (same formula as _compute_update's lk_rate_i).
+    lk_D = jnp.where((s_beh & int(Behavior.DURATION_IS_GREGORIAN)) != 0, s_gdur, s_dur)
+    limit_pos = s_limit > 0
+    lk_rate = f64_div(
+        lk_D.astype(_F64), jnp.where(limit_pos, s_limit, 1).astype(_F64)
+    )
+    lk_rate_i = jnp.where(limit_pos, lk_rate, 0.0).astype(_I64)
+
+    # Lane-level responses.
+    def g(x):
+        return x[seg]
+
+    p = jnp.maximum(pos - 1, 0)
+    first = pos == 0
+    l_tok = g(is_tok)
+    l_h = g(h)
+
+    acc_tok = p < g(a2_tok)
+    rem_tok = jnp.where(acc_tok, g(R1) - (p + 1) * l_h, g(rem2_tok))
+    st_tok = jnp.where(acc_tok, g(vals.status), _OVER)
+    rst_tok = g(vals.expire)
+
+    acc_lk = p < g(a2_lk)
+    rem_lk = jnp.where(acc_lk, g(W1) - (p + 1) * l_h, g(W1 - a2_lk * h))
+    st_lk = jnp.where(acc_lk, _UNDER, _OVER)
+    rst_lk = now + (g(s_limit) - rem_lk) * g(lk_rate_i)
+
+    o_status = jnp.where(first, g(st1), jnp.where(l_tok, st_tok, st_lk))
+    o_rem = jnp.where(first, g(rem1), jnp.where(l_tok, rem_tok, rem_lk))
+    o_reset = jnp.where(first, g(rst1), jnp.where(l_tok, rst_tok, rst_lk))
+    return slot, vals2, _pack_out(o_status.astype(_I32), o_rem, o_reset)
+
+
+def _collapsed_step_core(state: BucketState, pin: jax.Array):
+    slot, vals2, packed = _collapsed_values(state, pin)
+    return _scatter_values(state, slot, vals2), packed
+
+
+# Fused (donated RMW) and split variants, mirroring fused_step /
+# packed_compute — the engine picks by the same fused_step_ok probe.
+collapsed_step = jax.jit(_collapsed_step_core, donate_argnums=(0,))
+collapsed_compute = jax.jit(_collapsed_values)
+
+
+def pack_collapsed_host(
+    size: int,
+    now_ms: int,
+    capacity: int,
+    uniq_slots: np.ndarray,  # int32 [S] sorted unique
+    counts: np.ndarray,  # int64 [S]
+    seg_fields: tuple,  # (algo, behavior, hits, limit, duration, burst,
+    #                      greg_dur, greg_exp) per segment, [S]
+    seg_idx: np.ndarray,  # int32 [m_lanes]
+    pos: np.ndarray,  # int32 [m_lanes]
+) -> np.ndarray:
+    """Host packer for the collapsed step (layout above)."""
+    s_count = len(uniq_slots)
+    n_lanes = len(seg_idx)
+    out = np.zeros((COLLAPSED_IN_ROWS, size), dtype=np.int32)
+    out[0, 0] = (np.int64(now_ms) >> 32).astype(np.int32)
+    out[0, 1] = np.int64(now_ms).astype(np.int32)
+    out[1, :s_count] = uniq_slots
+    if size > s_count:
+        out[1, s_count:] = np.arange(
+            capacity, capacity + (size - s_count), dtype=np.int64
+        ).astype(np.int32)
+    out[2, :s_count] = counts.astype(np.int32)
+    algo, behavior, hits, limit, duration, burst, gdur, gexp = seg_fields
+    out[3, :s_count] = algo
+    out[4, :s_count] = behavior
+
+    def w64(hi_row, lo_row, col):
+        c = col.astype(np.int64, copy=False)
+        out[hi_row, :s_count] = (c >> 32).astype(np.int32)
+        out[lo_row, :s_count] = c.astype(np.int32)
+
+    w64(5, 6, hits)
+    w64(7, 8, limit)
+    w64(9, 10, duration)
+    w64(11, 12, burst)
+    w64(13, 14, gdur)
+    w64(15, 16, gexp)
+    out[17, :n_lanes] = seg_idx
+    # Padding lanes point at the last padding segment (m=0, harmless).
+    if size > n_lanes:
+        out[17, n_lanes:] = size - 1
+    out[18, :n_lanes] = pos
+    return out
+
+
 @functools.lru_cache(maxsize=None)
 def fused_step_ok(capacity: int, width: int = 64) -> bool:
     """Probe whether `fused_step` compiles to a true in-place update.

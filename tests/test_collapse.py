@@ -1,0 +1,230 @@
+"""Collapsed duplicate-segment kernel ⇄ sequential semantics (fuzzed).
+
+Hot-key batches collapse each uniform duplicate segment into ONE
+device dispatch with a closed form for the sequential per-occurrence
+responses (bucket_kernel COLLAPSED_IN_ROWS).  These tests pin exact
+equality against (a) the rounds path (the proven sequential execution)
+and (b) the scalar spec, across token/leaky, new/existing buckets,
+over-limit boundaries, negative hits, queries, and eviction pressure.
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.core.engine import DecisionEngine
+from gubernator_tpu.types import Algorithm, Behavior
+
+
+def _columns(rng, n, n_keys, *, uniform=True, hits_range=(0, 4)):
+    kidx = rng.integers(0, n_keys, n)
+    keys = [b"ck%d" % i for i in kidx]
+    if uniform:
+        # Per-KEY uniform fields (the collapse precondition).
+        per_key_algo = rng.integers(0, 2, n_keys).astype(np.int32)
+        per_key_hits = rng.integers(*hits_range, n_keys).astype(np.int64)
+        per_key_limit = rng.integers(1, 12, n_keys).astype(np.int64)
+        per_key_burst = rng.integers(0, 14, n_keys).astype(np.int64)
+        algo = per_key_algo[kidx]
+        hits = per_key_hits[kidx]
+        limit = per_key_limit[kidx]
+        burst = per_key_burst[kidx]
+    else:
+        algo = rng.integers(0, 2, n).astype(np.int32)
+        hits = rng.integers(*hits_range, n).astype(np.int64)
+        limit = rng.integers(1, 12, n).astype(np.int64)
+        burst = rng.integers(0, 14, n).astype(np.int64)
+    return dict(
+        keys=keys,
+        algo=algo,
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=hits,
+        limit=limit,
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=burst,
+    )
+
+
+def _run(engine, cols, now):
+    st, lim, rem, rst = engine.apply_columnar(now_ms=now, **cols)
+    return st.tolist(), rem.tolist(), rst.tolist()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_collapse_matches_rounds_fuzz(frozen_clock, seed):
+    """Same random duplicate-heavy traffic through two engines — one
+    collapsing, one forced onto the rounds path — must answer
+    identically, batch after batch (state evolves too)."""
+    rng = np.random.default_rng(seed)
+    e_fast = DecisionEngine(capacity=256, clock=frozen_clock)
+    e_slow = DecisionEngine(capacity=256, clock=frozen_clock)
+    e_slow._try_collapse = lambda *a, **k: None  # force rounds
+
+    now = frozen_clock.now_ms()
+    for batch in range(12):
+        n = int(rng.integers(1, 120))
+        # Odd seeds include negative hits (exercises the leaky
+        # negative-duplicate fallback to rounds).
+        hr = (-2, 4) if seed % 2 else (0, 4)
+        cols = _columns(rng, n, n_keys=6, hits_range=hr)
+        assert _run(e_fast, cols, now) == _run(e_slow, cols, now), (
+            f"seed={seed} batch={batch}"
+        )
+        now += int(rng.integers(0, 30_000))
+
+
+def test_collapse_token_over_limit_boundary(frozen_clock):
+    """20 duplicates of one token key, limit 7, hits 2: positions
+    0-2 consume (5,3,1 remaining), the rest reject without consuming."""
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+    n = 20
+    cols = dict(
+        keys=[b"hot"] * n,
+        algo=np.zeros(n, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.full(n, 2, dtype=np.int64),
+        limit=np.full(n, 7, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+    )
+    st, lim, rem, rst = eng.apply_columnar(**cols)
+    assert rem[:3].tolist() == [5, 3, 1]
+    assert st[:3].tolist() == [0, 0, 0]
+    assert st[3:].tolist() == [1] * 17  # OVER, no consume
+    assert rem[3:].tolist() == [1] * 17
+    # One more batch: bucket still has 1 left.
+    st, _, rem, _ = eng.apply_columnar(
+        **{**cols, "keys": [b"hot"], "algo": cols["algo"][:1],
+           "behavior": cols["behavior"][:1], "hits": np.asarray([1]),
+           "limit": cols["limit"][:1], "duration": cols["duration"][:1],
+           "burst": cols["burst"][:1]}
+    )
+    assert (st[0], rem[0]) == (0, 0)
+
+
+def test_collapse_sticky_over_and_queries(frozen_clock):
+    """Exact drain flips the token sticky status only when an extra
+    actually sees remaining==0; queries (hits=0) never consume."""
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+
+    def batch(k, hits, m, limit=4):
+        n = m
+        return dict(
+            keys=[k] * n,
+            algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.full(n, hits, dtype=np.int64),
+            limit=np.full(n, limit, dtype=np.int64),
+            duration=np.full(n, 60_000, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+        )
+
+    # 5 x hits=1 on limit 4: last one sees 0 remaining → OVER.
+    st, _, rem, _ = eng.apply_columnar(**batch(b"a", 1, 5))
+    assert rem.tolist() == [3, 2, 1, 0, 0]
+    assert st.tolist() == [0, 0, 0, 0, 1]
+    # Queries reflect the stored (now sticky-OVER) status, no consume.
+    st, _, rem, _ = eng.apply_columnar(**batch(b"a", 0, 3))
+    assert st.tolist() == [1, 1, 1]
+    assert rem.tolist() == [0, 0, 0]
+
+
+def test_collapse_negative_hits_refill(frozen_clock):
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+    n = 4
+    base = dict(
+        algo=np.zeros(n, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        limit=np.full(n, 10, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+    )
+    eng.apply_columnar(keys=[b"neg"] * n, hits=np.full(n, 2, np.int64), **base)
+    st, _, rem, _ = eng.apply_columnar(
+        keys=[b"neg"] * n, hits=np.full(n, -1, np.int64), **base
+    )
+    assert rem.tolist() == [3, 4, 5, 6]
+    assert st.tolist() == [0, 0, 0, 0]
+
+
+def test_nonuniform_duplicates_fall_back_to_rounds(frozen_clock):
+    """Duplicates with DIFFERENT limits must keep exact sequential
+    semantics via the rounds path."""
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+    n = 3
+    cols = dict(
+        keys=[b"nu"] * n,
+        algo=np.zeros(n, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.ones(n, dtype=np.int64),
+        limit=np.asarray([10, 20, 20], dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+    )
+    st, lim, rem, rst = eng.apply_columnar(**cols)
+    # Sequential: 10-1=9; limit change 10→20 adds +10 → 19-1=18; 17.
+    assert rem.tolist() == [9, 18, 17]
+
+
+def test_collapse_leaky_segments(frozen_clock):
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+    n = 8
+    cols = dict(
+        keys=[b"lk"] * n,
+        algo=np.ones(n, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.full(n, 3, dtype=np.int64),
+        limit=np.full(n, 10, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.full(n, 10, dtype=np.int64),
+    )
+    st, _, rem, rst = eng.apply_columnar(**cols)
+    # 10 → 7 → 4 → 1 → then 3 > 1: reject without consuming.
+    assert rem.tolist() == [7, 4, 1, 1, 1, 1, 1, 1]
+    assert st.tolist() == [0, 0, 0, 1, 1, 1, 1, 1]
+    # reset_time slope: rate = 60000/10 = 6000ms per unit.
+    now = frozen_clock.now_ms()
+    assert rst[0] == now + (10 - 7) * 6000
+    assert rst[2] == now + (10 - 1) * 6000
+
+
+def test_collapse_under_eviction_pressure(frozen_clock):
+    """Evictions (round-0 clears) coexist with collapsed dispatch; a
+    tiny capacity forces slot reuse across batches."""
+    rng = np.random.default_rng(9)
+    e_fast = DecisionEngine(capacity=16, clock=frozen_clock)
+    e_slow = DecisionEngine(capacity=16, clock=frozen_clock)
+    e_slow._try_collapse = lambda *a, **k: None
+    now = frozen_clock.now_ms()
+    for batch in range(10):
+        n = int(rng.integers(2, 60))
+        cols = _columns(rng, n, n_keys=40)  # >> capacity → evictions
+        assert _run(e_fast, cols, now) == _run(e_slow, cols, now), batch
+        now += 1_000
+
+
+def test_leaky_negative_hits_duplicates_match_rounds(frozen_clock):
+    """Sequential leaky semantics re-clamp remaining to burst on every
+    gather; negative-hit duplicate segments must take the rounds path
+    (review repro: limit 10 at remaining 2, then 4x hits=-3 →
+    [5, 8, 11, 13], stored 13 — NOT 14)."""
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+    n1 = 1
+    base = dict(
+        algo=np.ones(1, dtype=np.int32),
+        behavior=np.zeros(1, dtype=np.int32),
+        limit=np.full(1, 10, dtype=np.int64),
+        duration=np.full(1, 60_000, dtype=np.int64),
+        burst=np.zeros(1, dtype=np.int64),
+    )
+    eng.apply_columnar(keys=[b"lneg"], hits=np.asarray([8]), **base)
+    n = 4
+    base4 = {k: np.repeat(v, n) for k, v in base.items()}
+    st, _, rem, _ = eng.apply_columnar(
+        keys=[b"lneg"] * n, hits=np.full(n, -3, np.int64), **base4
+    )
+    assert rem.tolist() == [5, 8, 11, 13]
+    # The next gather re-clamps the stored 13 to the burst (10).
+    st, _, rem, _ = eng.apply_columnar(
+        keys=[b"lneg"], hits=np.asarray([0]), **base
+    )
+    assert rem.tolist() == [10]

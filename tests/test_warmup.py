@@ -23,6 +23,8 @@ _KERNELS = (
     bk.apply_batch,
     bk.fused_step,
     bk.packed_compute,
+    bk.collapsed_step,
+    bk.collapsed_compute,
     bk.scatter_store,
     bk.clear_occupied,
 )
