@@ -990,11 +990,15 @@ def pack_collapsed_host(
     #                      greg_dur, greg_exp) per segment, [S]
     seg_idx: np.ndarray,  # int32 [m_lanes]
     pos: np.ndarray,  # int32 [m_lanes]
+    out: np.ndarray | None = None,  # reusable [COLLAPSED_IN_ROWS, size]
 ) -> np.ndarray:
     """Host packer for the collapsed step (layout above)."""
     s_count = len(uniq_slots)
     n_lanes = len(seg_idx)
-    out = np.zeros((COLLAPSED_IN_ROWS, size), dtype=np.int32)
+    if out is None:
+        out = np.zeros((COLLAPSED_IN_ROWS, size), dtype=np.int32)
+    else:
+        out[:] = 0
     out[0, 0] = (np.int64(now_ms) >> 32).astype(np.int32)
     out[0, 1] = np.int64(now_ms).astype(np.int32)
     out[1, :s_count] = uniq_slots

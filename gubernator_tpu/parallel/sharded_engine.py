@@ -139,6 +139,7 @@ class ShardedDecisionEngine:
 
         from gubernator_tpu.ops.bucket_kernel import (
             SlotValues,
+            _collapsed_values,
             _fused_step_core,
             _packed_compute_core,
             _scatter_values,
@@ -157,6 +158,18 @@ class ShardedDecisionEngine:
         def local_packed_compute(state, pin):
             slot, vals, pout = _packed_compute_core(_squeeze(state), pin[0])
             return slot[None], _expand(vals), pout[None]
+
+        # Collapsed duplicate-segment step per shard (hot keys — see
+        # bucket_kernel COLLAPSED_IN_ROWS; the single-device engine's
+        # closed form, run under shard_map).
+        def local_collapsed_fused(state, pin):
+            state1 = _squeeze(state)
+            slot, vals2, pout = _collapsed_values(state1, pin[0])
+            return _expand(_scatter_values(state1, slot, vals2)), pout[None]
+
+        def local_collapsed_compute(state, pin):
+            slot, vals2, pout = _collapsed_values(_squeeze(state), pin[0])
+            return slot[None], _expand(vals2), pout[None]
 
         def local_scatter(state, slot, vals):
             return _expand(
@@ -192,6 +205,23 @@ class ShardedDecisionEngine:
                 out_specs=state_specs2,
             ),
             donate_argnums=(0,),
+        )
+        self._collapsed_fused = jax.jit(
+            jax.shard_map(
+                local_collapsed_fused,
+                mesh=mesh,
+                in_specs=(state_specs2, pspec),
+                out_specs=(state_specs2, pspec),
+            ),
+            donate_argnums=(0,),
+        )
+        self._collapsed_compute = jax.jit(
+            jax.shard_map(
+                local_collapsed_compute,
+                mesh=mesh,
+                in_specs=(state_specs2, pspec),
+                out_specs=(pspec, vals_specs, pspec),
+            )
         )
         # Store read-through hydration: sharded counterpart of
         # core.engine load_slots (one batched scatter per round).
@@ -766,8 +796,30 @@ class ShardedDecisionEngine:
                     sh
                 ].append(es)
 
+        # 2b. Hot keys: collapse uniform duplicate segments per shard
+        # into one mesh dispatch per chunk (see core.engine
+        # _try_collapse and bucket_kernel's closed form).
+        pieces: Optional[List[tuple]] = None
+        if max_round > 0:
+            pieces = self._try_collapse_sharded(
+                shard_idx, shard_slots, clear_by_round,
+                algo, behavior, hits, limit, duration, burst,
+                greg_dur, greg_exp, now_ms,
+            )
+        if pieces is not None:
+            for sh in range(n_sh):
+                if len(shard_idx[sh]):
+                    self.tables[sh].set_expiry(
+                        shard_slots[sh],
+                        np.where(greg_mask, greg_exp, now_ms + duration)
+                        .astype(_I64)[shard_idx[sh]],
+                    )
+            from gubernator_tpu.core.engine import PendingColumnar as _PC
+
+            return _PC(self, pieces, limit, n)
+
         # 3. One mesh step per round (chunked by max_kernel_width).
-        pieces: List[tuple] = []
+        pieces = []
         for k in range(max_round + 1):
             members = [
                 shard_idx[sh][shard_rounds[sh] == k] if len(shard_idx[sh]) else shard_idx[sh]
@@ -817,6 +869,127 @@ class ShardedDecisionEngine:
         from gubernator_tpu.core.engine import PendingColumnar
 
         return PendingColumnar(self, pieces, limit, n)
+
+    def _try_collapse_sharded(
+        self, shard_idx, shard_slots, clear_by_round,
+        algo, behavior, hits, limit, duration, burst,
+        greg_dur, greg_exp, now_ms,
+    ) -> Optional[List[tuple]]:
+        """Per-shard duplicate-segment collapse; returns pieces or None
+        for the rounds fallback (same preconditions as the single-device
+        engine's _try_collapse)."""
+        from gubernator_tpu.ops.bucket_kernel import (
+            COLLAPSED_IN_ROWS,
+            pack_collapsed_host,
+        )
+        from gubernator_tpu.types import Algorithm
+
+        if any(k > 0 for k in clear_by_round):
+            return None  # mid-batch slot reuse
+        n_sh = self.n_shards
+        cap = self.shard_capacity
+        cols = (algo, behavior, hits, limit, duration, burst,
+                greg_dur, greg_exp)
+        rst_bit = int(Behavior.RESET_REMAINING)
+        leaky = int(Algorithm.LEAKY_BUCKET)
+
+        per_shard: List[Optional[tuple]] = []
+        for sh in range(n_sh):
+            idx = shard_idx[sh]
+            if len(idx) == 0:
+                per_shard.append(None)
+                continue
+            order = np.argsort(shard_slots[sh], kind="stable")
+            s_slots = shard_slots[sh][order]
+            uniq, seg_start, counts = np.unique(
+                s_slots, return_index=True, return_counts=True
+            )
+            seg_of = np.repeat(np.arange(len(uniq), dtype=np.int64), counts)
+            dup = counts[seg_of] > 1
+            src = idx[order]  # original request indices, sorted by slot
+            for col in cols:
+                cs = col[src]
+                if not np.array_equal(
+                    cs[dup], cs[seg_start][seg_of][dup]
+                ):
+                    return None
+            beh_s = behavior[src]
+            if bool((((beh_s & rst_bit) != 0) & dup).any()):
+                return None
+            if bool(
+                (((algo[src] == leaky) & (hits[src] < 0)) & dup).any()
+            ):
+                return None
+            per_shard.append((src, s_slots))
+
+        clears = clear_by_round.get(0)
+        if clears is not None:
+            self._apply_shard_clears(clears)
+
+        max_lanes = max(
+            (len(p[0]) for p in per_shard if p is not None), default=0
+        )
+        pieces: List[tuple] = []
+        empty64 = np.empty(0, dtype=_I64)
+        for lo in range(0, max_lanes, self.max_kernel_width):
+            chunk_m = [
+                min(max(len(p[0]) - lo, 0), self.max_kernel_width)
+                if p is not None
+                else 0
+                for p in per_shard
+            ]
+            width = _pad_size(max(chunk_m))
+            buf = np.zeros((n_sh, COLLAPSED_IN_ROWS, width), dtype=_I32)
+            dst_rows: List[np.ndarray] = []
+            for sh in range(n_sh):
+                m = chunk_m[sh]
+                if m == 0:
+                    pack_collapsed_host(
+                        width, now_ms, cap, np.empty(0, dtype=_I32),
+                        empty64,
+                        (empty64,) * 8,
+                        np.empty(0, dtype=_I32), np.empty(0, dtype=_I32),
+                        out=buf[sh],
+                    )
+                    dst_rows.append(np.empty(0, dtype=np.int64))
+                    continue
+                src, s_slots = per_shard[sh]
+                c_src = src[lo : lo + m]
+                c_slots = s_slots[lo : lo + m]
+                c_uniq, c_start, c_counts = np.unique(
+                    c_slots, return_index=True, return_counts=True
+                )
+                c_seg_of = np.repeat(
+                    np.arange(len(c_uniq), dtype=np.int64), c_counts
+                )
+                c_pos = np.arange(m, dtype=np.int64) - c_start[c_seg_of]
+                pack_collapsed_host(
+                    width, now_ms, cap,
+                    np.ascontiguousarray(c_uniq, dtype=_I32),
+                    c_counts.astype(np.int64),
+                    tuple(col[c_src][c_start] for col in cols),
+                    c_seg_of.astype(_I32),
+                    c_pos.astype(_I32),
+                    out=buf[sh],
+                )
+                dst_rows.append(c_src)
+
+            import time as _time
+
+            t0 = _time.monotonic()
+            pin = jnp.asarray(buf)
+            if self._fused:
+                self._state, pout = self._collapsed_fused(self._state, pin)
+            else:
+                slot_dev, vals2, pout = self._collapsed_compute(
+                    self._state, pin
+                )
+                self._state = self._step_scatter(self._state, slot_dev, vals2)
+            self.round_duration.observe(_time.monotonic() - t0)
+            pout.copy_to_host_async()
+            self.rounds_total += 1
+            pieces.append((pout, dst_rows, chunk_m, width))
+        return pieces
 
     def _dispatch_sorted_chunk(
         self, members, m_slots, algo, behavior, hits, limit, duration,

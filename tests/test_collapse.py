@@ -228,3 +228,31 @@ def test_leaky_negative_hits_duplicates_match_rounds(frozen_clock):
         keys=[b"lneg"], hits=np.asarray([0]), **base
     )
     assert rem.tolist() == [10]
+
+
+def test_sharded_collapse_matches_rounds_fuzz(frozen_clock):
+    """The sharded engine's per-shard collapse must equal its own
+    rounds path on duplicate-heavy traffic."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    from gubernator_tpu.parallel.mesh import make_mesh
+    from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+
+    rng = np.random.default_rng(21)
+    mesh = make_mesh(jax.devices()[:2])
+    e_fast = ShardedDecisionEngine(
+        shard_capacity=128, mesh=mesh, clock=frozen_clock
+    )
+    e_slow = ShardedDecisionEngine(
+        shard_capacity=128, mesh=make_mesh(jax.devices()[:2]),
+        clock=frozen_clock,
+    )
+    e_slow._try_collapse_sharded = lambda *a, **k: None
+
+    now = frozen_clock.now_ms()
+    for batch in range(8):
+        n = int(rng.integers(2, 100))
+        cols = _columns(rng, n, n_keys=5, hits_range=(-1, 4))
+        assert _run(e_fast, cols, now) == _run(e_slow, cols, now), batch
+        now += int(rng.integers(0, 20_000))

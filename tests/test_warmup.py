@@ -90,6 +90,8 @@ def test_sharded_warmup_covers_serving_widths(frozen_clock):
         for f in (
             engine._packed_fused,
             engine._packed_compute,
+            engine._collapsed_fused,
+            engine._collapsed_compute,
             engine._step_scatter,
             engine._clear_step,
         )
@@ -114,6 +116,8 @@ def test_sharded_warmup_covers_serving_widths(frozen_clock):
         for f in (
             engine._packed_fused,
             engine._packed_compute,
+            engine._collapsed_fused,
+            engine._collapsed_compute,
             engine._step_scatter,
             engine._clear_step,
         )
