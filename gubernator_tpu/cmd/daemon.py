@@ -26,10 +26,9 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    from gubernator_tpu.utils.logging_setup import configure_logging
+
+    configure_logging(debug=args.debug)
 
     from gubernator_tpu.config import setup_daemon_config
     from gubernator_tpu.daemon import spawn_daemon

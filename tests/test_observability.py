@@ -128,3 +128,31 @@ def test_global_series_move_under_load():
         assert _sample(body, "gubernator_global_send_duration_sum") > 0
     finally:
         h.stop()
+
+
+def test_log_level_and_format_env(capsys):
+    """GUBER_LOG_LEVEL / GUBER_LOG_FORMAT drive the logging layer
+    (reference: config.go:255-280)."""
+    import json as _json
+    import logging
+    import os
+
+    from gubernator_tpu.utils.logging_setup import configure_logging
+
+    os.environ["GUBER_LOG_FORMAT"] = "json"
+    os.environ["GUBER_LOG_LEVEL"] = "warn"
+    try:
+        configure_logging()
+        log = logging.getLogger("obs.test")
+        log.info("hidden")
+        log.warning("shown %d", 7)
+        err = capsys.readouterr().err
+        lines = [l for l in err.strip().splitlines() if l]
+        assert len(lines) == 1
+        rec = _json.loads(lines[0])
+        assert rec["level"] == "warning" and rec["msg"] == "shown 7"
+        assert rec["logger"] == "obs.test"
+    finally:
+        os.environ.pop("GUBER_LOG_FORMAT")
+        os.environ.pop("GUBER_LOG_LEVEL")
+        logging.getLogger().handlers[:] = []
