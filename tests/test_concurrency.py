@@ -237,3 +237,59 @@ def test_storm_racing_peer_shutdown():
                     break
     finally:
         h.stop()
+
+
+def test_hot_key_collapse_storm_exact_accounting(frozen_clock):
+    """Threads race columnar hot-key batches (collapsed path) against
+    dataclass batches of the same key; consumption must be exact and a
+    bounded-limit bucket must never over-admit."""
+    import numpy as np
+
+    engine = DecisionEngine(capacity=1024, clock=frozen_clock)
+    errs = []
+    admitted = [0] * N_THREADS
+    limit = N_THREADS * ROUNDS * 2  # exactly the total demand
+
+    def col_batch(m):
+        # Same canonical key as the dataclass path ("name_unique-key").
+        return dict(
+            keys=[b"storm_hot_storm"] * m,
+            algo=np.zeros(m, dtype=np.int32),
+            behavior=np.zeros(m, dtype=np.int32),
+            hits=np.ones(m, dtype=np.int64),
+            limit=np.full(m, limit, dtype=np.int64),
+            duration=np.full(m, 3_600_000, dtype=np.int64),
+            burst=np.zeros(m, dtype=np.int64),
+        )
+
+    def worker(tid):
+        try:
+            count = 0
+            for i in range(ROUNDS):
+                if tid % 2 == 0:
+                    st, _, rem, _ = engine.apply_columnar(**col_batch(2))
+                    count += int((st == 0).sum())
+                else:
+                    resps = engine.get_rate_limits(
+                        [_req("hot_storm", limit=limit)] * 2
+                    )
+                    count += sum(
+                        1 for r in resps if r.status == Status.UNDER_LIMIT
+                    )
+            admitted[tid] = count
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    # Demand == limit exactly: every hit must have been admitted, and
+    # the bucket must now be exactly empty.
+    assert sum(admitted) == limit
+    final = engine.get_rate_limits([_req("hot_storm", hits=0, limit=limit)])[0]
+    assert final.remaining == 0
