@@ -443,6 +443,16 @@ class DecisionEngine:
                         restore_rounds.setdefault(k, []).append((slot, item))
 
         host_expire = np.zeros(len(valid_idx), dtype=_I64)
+        if (
+            self.store is None
+            and len(rounds) > 1
+            and self._collapse_dataclass(
+                requests, valid_idx, slots, greg_dur, greg_exp, now_ms,
+                responses, host_expire, clear_rounds,
+            )
+        ):
+            self.table.set_expiry(slots, host_expire)
+            return
         with span(
             "engine.batch", batch=len(valid_idx), rounds=len(rounds)
         ):
@@ -848,6 +858,72 @@ class DecisionEngine:
                     dst_idx = members[lo:hi][sort_idx]
                 pieces.append((pout, dst_idx, m, size))
         return pieces
+
+    def _collapse_dataclass(
+        self,
+        requests: Sequence[RateLimitReq],
+        valid_idx: List[int],
+        slots: np.ndarray,
+        greg_dur: np.ndarray,
+        greg_exp: np.ndarray,
+        now_ms: int,
+        responses: List[Optional[RateLimitResp]],
+        host_expire: np.ndarray,
+        clear_rounds: dict,
+    ) -> bool:
+        """Hot-key batches on the dataclass path (GLOBAL items, CLI,
+        forwarded dataclasses): build columns once and reuse the
+        columnar collapse.  Returns False for the rounds fallback."""
+        from gubernator_tpu.ops.bucket_kernel import unpack_out_host
+
+        if any(k > 0 for k in clear_rounds):
+            return False
+        nv = len(valid_idx)
+        c_algo = np.empty(nv, dtype=_I32)
+        c_beh = np.empty(nv, dtype=_I32)
+        c_hits = np.empty(nv, dtype=_I64)
+        c_limit = np.empty(nv, dtype=_I64)
+        c_dur = np.empty(nv, dtype=_I64)
+        c_burst = np.empty(nv, dtype=_I64)
+        c_gdur = np.empty(nv, dtype=_I64)
+        c_gexp = np.empty(nv, dtype=_I64)
+        for j, i in enumerate(valid_idx):
+            r = requests[i]
+            c_algo[j] = int(r.algorithm)
+            beh = int(r.behavior)
+            c_beh[j] = beh
+            c_hits[j] = r.hits
+            c_limit[j] = r.limit
+            c_dur[j] = r.duration
+            c_burst[j] = r.burst
+            c_gdur[j] = greg_dur[i]
+            c_gexp[j] = greg_exp[i]
+            host_expire[j] = greg_exp[i] if beh & _GREG else now_ms + r.duration
+        cleared = clear_rounds.get(0, [])
+        pieces = self._try_collapse(
+            slots, c_algo, c_beh, c_hits, c_limit, c_dur, c_burst,
+            c_gdur, c_gexp, now_ms,
+            np.asarray(cleared, dtype=_I32),
+            np.zeros(len(cleared), dtype=_I32),
+        )
+        if pieces is None:
+            return False
+        over = 0
+        for pout, dst_idx, m, _size in pieces:
+            st, rem, rst = unpack_out_host(np.asarray(pout), m)
+            for pos, j in enumerate(dst_idx.tolist()):
+                i = valid_idx[j]
+                s = int(st[pos])
+                if s == _OVER_I:
+                    over += 1
+                responses[i] = RateLimitResp(
+                    status=_STATUS_OF[s],
+                    limit=int(c_limit[j]),
+                    remaining=int(rem[pos]),
+                    reset_time=int(rst[pos]),
+                )
+        self.over_limit_total += over  # rounds_total counted per piece
+        return True
 
     def _try_collapse(
         self, slots, algo, behavior, hits, limit, duration, burst,

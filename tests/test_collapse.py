@@ -256,3 +256,41 @@ def test_sharded_collapse_matches_rounds_fuzz(frozen_clock):
         cols = _columns(rng, n, n_keys=5, hits_range=(-1, 4))
         assert _run(e_fast, cols, now) == _run(e_slow, cols, now), batch
         now += int(rng.integers(0, 20_000))
+
+
+def test_dataclass_path_collapse_matches_rounds(frozen_clock):
+    """The dataclass path (get_rate_limits) also collapses hot keys;
+    equality with its rounds fallback, fuzzed."""
+    from gubernator_tpu.types import RateLimitReq
+
+    rng = np.random.default_rng(31)
+    e_fast = DecisionEngine(capacity=128, clock=frozen_clock)
+    e_slow = DecisionEngine(capacity=128, clock=frozen_clock)
+    e_slow._collapse_dataclass = lambda *a, **k: False
+
+    def reqs_of(n):
+        out = []
+        for _ in range(n):
+            k = int(rng.integers(0, 5))
+            out.append(
+                RateLimitReq(
+                    name="dc",
+                    unique_key=f"k{k}",
+                    hits=int(rng.integers(0, 4)),
+                    limit=5 + k,
+                    duration=60_000,
+                    algorithm=Algorithm(k % 2),
+                    burst=8 + k,
+                )
+            )
+        return out
+
+    now = frozen_clock.now_ms()
+    for batch in range(10):
+        rs = reqs_of(int(rng.integers(2, 60)))
+        a = [(r.status, r.remaining, r.reset_time, r.error)
+             for r in e_fast.get_rate_limits(rs, now_ms=now)]
+        b = [(r.status, r.remaining, r.reset_time, r.error)
+             for r in e_slow.get_rate_limits(rs, now_ms=now)]
+        assert a == b, batch
+        now += int(rng.integers(0, 20_000))
