@@ -443,19 +443,19 @@ class DecisionEngine:
                         restore_rounds.setdefault(k, []).append((slot, item))
 
         host_expire = np.zeros(len(valid_idx), dtype=_I64)
-        if (
-            self.store is None
-            and len(rounds) > 1
-            and self._collapse_dataclass(
-                requests, valid_idx, slots, greg_dur, greg_exp, now_ms,
-                responses, host_expire, clear_rounds,
-            )
-        ):
-            self.table.set_expiry(slots, host_expire)
-            return
         with span(
             "engine.batch", batch=len(valid_idx), rounds=len(rounds)
         ):
+            if (
+                self.store is None
+                and len(rounds) > 1
+                and self._collapse_dataclass(
+                    requests, valid_idx, slots, greg_dur, greg_exp, now_ms,
+                    responses, host_expire, clear_rounds,
+                )
+            ):
+                self.table.set_expiry(slots, host_expire)
+                return
             for k in sorted(rounds):
                 members = rounds[k]
                 cleared = clear_rounds.get(k)
@@ -900,12 +900,13 @@ class DecisionEngine:
             c_gexp[j] = greg_exp[i]
             host_expire[j] = greg_exp[i] if beh & _GREG else now_ms + r.duration
         cleared = clear_rounds.get(0, [])
-        pieces = self._try_collapse(
-            slots, c_algo, c_beh, c_hits, c_limit, c_dur, c_burst,
-            c_gdur, c_gexp, now_ms,
-            np.asarray(cleared, dtype=_I32),
-            np.zeros(len(cleared), dtype=_I32),
-        )
+        with span("engine.collapsed", width=nv):
+            pieces = self._try_collapse(
+                slots, c_algo, c_beh, c_hits, c_limit, c_dur, c_burst,
+                c_gdur, c_gexp, now_ms,
+                np.asarray(cleared, dtype=_I32),
+                np.zeros(len(cleared), dtype=_I32),
+            )
         if pieces is None:
             return False
         over = 0

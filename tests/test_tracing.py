@@ -44,7 +44,9 @@ def test_disabled_tracing_is_noop():
 
 def test_engine_batch_and_round_spans(frozen_clock, tracer):
     eng = DecisionEngine(capacity=256, clock=frozen_clock)
-    # 3 distinct keys + one duplicated twice → 2 rounds.
+    # 3 distinct keys + one duplicated twice: hot-key batches normally
+    # collapse to one dispatch; force the rounds path to trace rounds.
+    eng._collapse_dataclass = lambda *a, **k: False
     eng.get_rate_limits([req("a"), req("b"), req("a"), req("c")])
 
     batches = tracer.spans("engine.batch")
@@ -59,6 +61,15 @@ def test_engine_batch_and_round_spans(frozen_clock, tracer):
     assert all(s.parent == "engine.batch" for s in rounds)
     # Spans carry real durations.
     assert all(s.end_ns > s.start_ns for s in rounds)
+
+
+def test_engine_collapsed_span(frozen_clock, tracer):
+    eng = DecisionEngine(capacity=256, clock=frozen_clock)
+    eng.get_rate_limits([req("a"), req("b"), req("a"), req("c")])
+    collapsed = tracer.spans("engine.collapsed")
+    assert len(collapsed) == 1
+    assert collapsed[0].attributes == {"width": 4}
+    assert collapsed[0].parent == "engine.batch"
 
 
 def test_columnar_and_sweep_spans(frozen_clock, tracer):
