@@ -38,6 +38,16 @@ CONFIGS: dict[str, dict] = {
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_BATCH": "1000",
     },
+    # GLOBAL's design case: HOT keys, where non-owners answer from the
+    # owner-broadcast status cache (reference: architecture.md:46-74).
+    # The wide-keyspace variant above defeats that cache by design.
+    "global4hot": {
+        "BENCH_MODE": "global",
+        "BENCH_NODES": "4",
+        "BENCH_KEYS": "1000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_BATCH": "1000",
+    },
     "zipf": {
         "BENCH_ZIPF": "1.2",
         "BENCH_KEYS": "100000000",
