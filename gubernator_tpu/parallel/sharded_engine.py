@@ -370,6 +370,15 @@ class ShardedDecisionEngine:
 
         expire_of: Dict[int, int] = {}
         with span("engine.batch", batch=len(valid), rounds=len(rounds)):
+            if (
+                self.store is None
+                and len(rounds) > 1
+                and self._collapse_dataclass_sharded(
+                    requests, valid, rounds, clear_rounds,
+                    greg_dur, greg_exp, now_ms, responses,
+                )
+            ):
+                return
             for k in sorted(set(rounds) | set(clear_rounds)):
                 members = rounds.get(k, [[] for _ in range(n_sh)])
                 clears = clear_rounds.get(k, [[] for _ in range(n_sh)])
@@ -869,6 +878,103 @@ class ShardedDecisionEngine:
         from gubernator_tpu.core.engine import PendingColumnar
 
         return PendingColumnar(self, pieces, limit, n)
+
+    def _collapse_dataclass_sharded(
+        self,
+        requests: Sequence[RateLimitReq],
+        valid: List[int],
+        rounds: Dict[int, List[List[Tuple[int, int]]]],
+        clear_rounds: Dict[int, List[List[int]]],
+        greg_dur: np.ndarray,
+        greg_exp: np.ndarray,
+        now_ms: int,
+        responses: List[Optional[RateLimitResp]],
+    ) -> bool:
+        """Hot-key batches on the sharded dataclass path: build columns
+        once and reuse the sharded collapse.  Returns False for the
+        rounds fallback (see core.engine._collapse_dataclass)."""
+        from gubernator_tpu.ops.bucket_kernel import unpack_out_host
+        from gubernator_tpu.utils.tracing import span
+
+        if any(k > 0 for k in clear_rounds):
+            return False
+        n_sh = self.n_shards
+        nv = len(valid)
+        pos_of = {i: j for j, i in enumerate(valid)}
+        c_algo = np.empty(nv, dtype=_I32)
+        c_beh = np.empty(nv, dtype=_I32)
+        c_hits = np.empty(nv, dtype=_I64)
+        c_limit = np.empty(nv, dtype=_I64)
+        c_dur = np.empty(nv, dtype=_I64)
+        c_burst = np.empty(nv, dtype=_I64)
+        c_gdur = np.empty(nv, dtype=_I64)
+        c_gexp = np.empty(nv, dtype=_I64)
+        expire = np.empty(nv, dtype=_I64)
+        for j, i in enumerate(valid):
+            r = requests[i]
+            c_algo[j] = int(r.algorithm)
+            beh = int(r.behavior)
+            c_beh[j] = beh
+            c_hits[j] = r.hits
+            c_limit[j] = r.limit
+            c_dur[j] = r.duration
+            c_burst[j] = r.burst
+            c_gdur[j] = greg_dur[i]
+            c_gexp[j] = greg_exp[i]
+            expire[j] = greg_exp[i] if beh & _GREG else now_ms + r.duration
+
+        # Rebuild per-shard (column positions, slots) in arrival order.
+        shard_idx: List[np.ndarray] = []
+        shard_slots: List[np.ndarray] = []
+        per_shard: List[List[Tuple[int, int]]] = [[] for _ in range(n_sh)]
+        for k in sorted(rounds):
+            for sh in range(n_sh):
+                per_shard[sh].extend(rounds[k][sh])
+        for sh in range(n_sh):
+            # Arrival order within a key is the ROUND order (k ascending
+            # per slot); restore global arrival order by request index.
+            items = sorted(per_shard[sh], key=lambda t: pos_of[t[0]])
+            shard_idx.append(
+                np.asarray([pos_of[i] for i, _ in items], dtype=np.int64)
+            )
+            shard_slots.append(
+                np.asarray([s for _, s in items], dtype=_I32)
+            )
+
+        with span("engine.collapsed", width=nv):
+            pieces = self._try_collapse_sharded(
+                shard_idx, shard_slots, clear_rounds,
+                c_algo, c_beh, c_hits, c_limit, c_dur, c_burst,
+                c_gdur, c_gexp, now_ms,
+            )
+        if pieces is None:
+            return False
+        over = 0
+        for pout, dst_rows, chunk_m, _width in pieces:
+            arr = np.asarray(pout)
+            for sh in range(n_sh):
+                mm = chunk_m[sh]
+                if mm == 0:
+                    continue
+                st, rem, rst = unpack_out_host(arr[sh], mm)
+                for p, j in enumerate(dst_rows[sh].tolist()):
+                    i = valid[j]
+                    s = int(st[p])
+                    if s == _OVER_I:
+                        over += 1
+                    responses[i] = RateLimitResp(
+                        status=_STATUS_OF[s],
+                        limit=int(c_limit[j]),
+                        remaining=int(rem[p]),
+                        reset_time=int(rst[p]),
+                    )
+        self.over_limit_total += over
+        for sh in range(n_sh):
+            if len(shard_idx[sh]):
+                self.tables[sh].set_expiry(
+                    shard_slots[sh], expire[shard_idx[sh]]
+                )
+        return True
 
     def _try_collapse_sharded(
         self, shard_idx, shard_slots, clear_by_round,

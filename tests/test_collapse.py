@@ -294,3 +294,50 @@ def test_dataclass_path_collapse_matches_rounds(frozen_clock):
              for r in e_slow.get_rate_limits(rs, now_ms=now)]
         assert a == b, batch
         now += int(rng.integers(0, 20_000))
+
+
+def test_sharded_dataclass_collapse_matches_rounds(frozen_clock):
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    from gubernator_tpu.parallel.mesh import make_mesh
+    from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+    from gubernator_tpu.types import RateLimitReq
+
+    rng = np.random.default_rng(41)
+    e_fast = ShardedDecisionEngine(
+        shard_capacity=64, mesh=make_mesh(jax.devices()[:2]),
+        clock=frozen_clock,
+    )
+    e_slow = ShardedDecisionEngine(
+        shard_capacity=64, mesh=make_mesh(jax.devices()[:2]),
+        clock=frozen_clock,
+    )
+    e_slow._collapse_dataclass_sharded = lambda *a, **k: False
+
+    def reqs_of(n):
+        out = []
+        for _ in range(n):
+            k = int(rng.integers(0, 5))
+            out.append(
+                RateLimitReq(
+                    name="sdc",
+                    unique_key=f"k{k}",
+                    hits=int(rng.integers(0, 4)),
+                    limit=5 + k,
+                    duration=60_000,
+                    algorithm=Algorithm(k % 2),
+                    burst=8 + k,
+                )
+            )
+        return out
+
+    now = frozen_clock.now_ms()
+    for batch in range(8):
+        rs = reqs_of(int(rng.integers(2, 60)))
+        a = [(r.status, r.remaining, r.reset_time, r.error)
+             for r in e_fast.get_rate_limits(rs, now_ms=now)]
+        b = [(r.status, r.remaining, r.reset_time, r.error)
+             for r in e_slow.get_rate_limits(rs, now_ms=now)]
+        assert a == b, batch
+        now += int(rng.integers(0, 20_000))

@@ -111,6 +111,12 @@ def test_sharded_engine_spans(frozen_clock, tracer):
     assert len(batches) == 1
     assert batches[0].attributes["batch"] == 3
     assert batches[0].attributes["rounds"] == 2
+    # Hot-key duplicates collapse into one traced dispatch.
+    assert len(tracer.spans("engine.collapsed")) == 1
+    # Forcing the fallback traces per-round spans.
+    tracer.clear()
+    eng._collapse_dataclass_sharded = lambda *a, **k: False
+    eng.get_rate_limits([req("sa2"), req("sb2"), req("sa2")])
     assert len(tracer.spans("engine.round")) == 2
 
 
