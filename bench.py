@@ -155,6 +155,8 @@ def main() -> int:
             result = _run_wire(np, platform)
         elif MODE == "global":
             result = _run_global(np, platform)
+        elif MODE == "herd":
+            result = _run_herd(np, platform)
         else:
             result = _run_engine(np, platform)
         if backend_error:
@@ -510,6 +512,103 @@ def _drive_grpc(np, addrs: list, payloads: list, n_threads: int, items_per_rpc: 
     p50 = round(float(np.percentile(all_lat, 50)) * 1e3, 3) if all_lat.size else None
     p99 = round(float(np.percentile(all_lat, 99)) * 1e3, 3) if all_lat.size else None
     return rate, p50, p99
+
+
+def _run_herd(np, platform: str) -> dict:
+    """Thundering herd: many concurrent single-item requests for the
+    SAME hot key (reference: benchmark_test.go BenchmarkServer's
+    thundering-herd subtest) — measures per-request wire overhead plus
+    the hot-key collapse under maximal contention."""
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+    import grpc
+
+    n_threads = int(os.environ.get("BENCH_HERD_THREADS", 32))
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=CAPACITY,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        # The herd is what the group-commit window exists for: the
+        # concurrent single-item RPCs share one engine dispatch per
+        # window (net/wire_window.py).
+        local_batch_wait=float(
+            os.environ.get("BENCH_LOCAL_BATCH_WAIT", "0.0005")
+        ),
+    )
+    daemon = spawn_daemon(conf)
+    try:
+        payload = pb.GetRateLimitsReq(
+            requests=[
+                pb.RateLimitReq(
+                    name="herd", unique_key="hot", hits=1,
+                    limit=10**12, duration=3_600_000,
+                )
+            ]
+        ).SerializeToString()
+        barrier = threading.Barrier(n_threads + 1)
+        stop = threading.Event()
+        counts = [0] * n_threads
+        lats: list = [None] * n_threads
+
+        def worker(tid):
+            mylat = []
+            try:
+                ch = grpc.insecure_channel(daemon.grpc_address)
+                call = ch.unary_unary(
+                    f"/{V1_SERVICE}/GetRateLimits",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                call(payload)
+            finally:
+                barrier.wait()
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                call(payload)
+                mylat.append(time.perf_counter() - t0)
+                counts[tid] += 1
+            lats[tid] = mylat
+            ch.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        start = time.perf_counter()
+        time.sleep(MEASURE_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        import numpy as _np
+
+        all_lat = _np.asarray([x for ml in lats if ml for x in ml])
+        rate = sum(counts) / elapsed
+        return {
+            "metric": "rate-limit decisions/sec, thundering herd "
+            f"({n_threads} concurrent clients, 1 hot key, single-item RPCs)",
+            "value": round(rate, 1),
+            "unit": "decisions/sec",
+            "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
+            "p50_ms": round(float(_np.percentile(all_lat, 50)) * 1e3, 3)
+            if all_lat.size
+            else None,
+            "p99_ms": round(float(_np.percentile(all_lat, 99)) * 1e3, 3)
+            if all_lat.size
+            else None,
+            "platform": platform,
+        }
+    finally:
+        daemon.close()
 
 
 def _run_global(np, platform: str) -> dict:

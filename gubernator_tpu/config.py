@@ -66,6 +66,11 @@ class Config:
     # grpc.ChannelCredentials for dialing peers (None = plaintext);
     # set by the daemon when TLS is configured.
     peer_credentials: Optional[object] = None
+    # Group-commit window for client-facing wire batches (seconds);
+    # 0 disables.  Concurrent RPCs inside the window share ONE engine
+    # dispatch — the local-tier analog of the peer BatchWait
+    # (net/wire_window.py; SURVEY §7.1's batching front-end).
+    local_batch_wait: float = 0.0
 
 
 def _env(d: Dict[str, str], key: str, default: str = "") -> str:
@@ -191,6 +196,8 @@ class DaemonConfig:
     # buckets (the LRU evicts on pressure regardless; the sweep keeps
     # cache_size metrics honest and slots recycled).  0 disables.
     sweep_interval: float = 30.0
+    # Client-facing wire group-commit window (0 = off); see Config.
+    local_batch_wait: float = 0.0
 
     metric_flags: List[str] = field(default_factory=list)
 
@@ -281,6 +288,7 @@ def setup_daemon_config(
         tls=tls,
         device_count=device_count,
         sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),
+        local_batch_wait=_env_float_seconds(d, "GUBER_LOCAL_BATCH_WAIT", 0.0),
         metric_flags=[
             f.strip()
             for f in _env(d, "GUBER_METRIC_FLAGS", "").split(",")

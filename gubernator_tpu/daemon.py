@@ -114,6 +114,7 @@ class Daemon:
             hash_algorithm=conf.hash_algorithm,
             data_center=conf.data_center,
             peer_credentials=creds,
+            local_batch_wait=conf.local_batch_wait,
         )
         self.instance = V1Instance(service_conf, engine)
         self.registry = build_registry(

@@ -1,0 +1,136 @@
+"""Group-commit window for client-facing wire batches.
+
+SURVEY §7.1's "batching front-end": the reference batches PEER
+forwards over a 500µs window (peer_client.go:380-453) but processes
+client requests immediately — fine when a decision costs microseconds
+of Go, wrong when each dispatch pays a device round trip.  Under a
+thundering herd of small RPCs, every request would otherwise pay its
+own dispatch; this window lets concurrent requests share ONE engine
+batch (group commit): the first arrival becomes the leader, sleeps
+`wait` seconds while followers append, then runs the combined columns
+through the engine once and hands each caller its slice.
+
+Opt-in (GUBER_LOCAL_BATCH_WAIT, default 0 = disabled) because it adds
+`wait` to the latency of isolated requests — the classic throughput/
+latency trade the reference exposes as BehaviorConfig.BatchWait for
+its peer tier (config.go:113-115).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("gubernator_tpu.wire_window")
+
+
+class _Entry:
+    __slots__ = ("dec", "event", "result")
+
+    def __init__(self, dec):
+        self.dec = dec
+        self.event = threading.Event()
+        self.result = None  # (status, limit, remaining, reset) slices
+
+
+class WireWindow:
+    """Aggregates DecodedBatch submissions into one columnar engine
+    call per window."""
+
+    def __init__(self, engine, wait: float):
+        self.engine = engine
+        self.wait = wait
+        self._lock = threading.Lock()
+        self._pending: List[_Entry] = []
+        self._leader_active = False
+        # Metrics.
+        self.windows = 0
+        self.grouped_batches = 0
+
+    def submit(self, dec) -> Optional[Tuple]:
+        """Run `dec` through a shared window; returns this batch's
+        (status, limit, remaining, reset_time) columns."""
+        entry = _Entry(dec)
+        with self._lock:
+            self._pending.append(entry)
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+        if not lead:
+            entry.event.wait()
+            return entry.result
+        time.sleep(self.wait)
+        with self._lock:
+            batch = self._pending
+            self._pending = []
+            self._leader_active = False
+        self._run(batch)
+        return entry.result
+
+    def _run(self, batch: List[_Entry]) -> None:
+        from gubernator_tpu.core.engine import PackedKeys
+
+        try:
+            if len(batch) == 1:
+                e = batch[0]
+                d = e.dec
+                e.result = self._apply(
+                    PackedKeys(d.key_buf, d.key_offsets, d.n), d
+                )
+                return
+            # Concatenate columns (+ key buffers with shifted offsets).
+            decs = [e.dec for e in batch]
+            key_buf = np.concatenate([d.key_buf for d in decs])
+            offsets = [decs[0].key_offsets]
+            base = decs[0].key_offsets[-1]
+            for d in decs[1:]:
+                offsets.append(d.key_offsets[1:] + base)
+                base = base + d.key_offsets[-1]
+            key_offsets = np.concatenate(offsets)
+            n = sum(d.n for d in decs)
+            cols = tuple(
+                np.concatenate([getattr(d, f) for d in decs])
+                for f in (
+                    "algo", "behavior", "hits", "limit", "duration",
+                    "burst", "fnv1a",
+                )
+            )
+
+            class _Merged:
+                pass
+
+            m = _Merged()
+            m.n = n
+            (m.algo, m.behavior, m.hits, m.limit, m.duration, m.burst,
+             m.fnv1a) = cols
+            out = self._apply(PackedKeys(key_buf, key_offsets, n), m)
+            self.windows += 1
+            self.grouped_batches += len(batch)
+            lo = 0
+            for e in batch:
+                hi = lo + e.dec.n
+                e.result = tuple(col[lo:hi] for col in out)
+                lo = hi
+        except Exception:  # noqa: BLE001
+            # Callers fall back to the protobuf path on None.
+            log.exception("wire window apply failed; callers fall back")
+            for e in batch:
+                e.result = None
+        finally:
+            for e in batch:
+                e.event.set()
+
+    def _apply(self, packed, d):
+        if hasattr(self.engine, "tables"):
+            return self.engine.apply_columnar(
+                packed, d.algo, d.behavior, d.hits, d.limit, d.duration,
+                d.burst, route_hashes=d.fnv1a,
+            )
+        return self.engine.apply_columnar(
+            packed, d.algo, d.behavior, d.hits, d.limit, d.duration,
+            d.burst,
+        )

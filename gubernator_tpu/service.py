@@ -188,6 +188,13 @@ class V1Instance:
         # Peer-flush duration summary, shared by every PeerClient this
         # instance creates (reference: guber_batch_send_duration).
         self.flush_duration = DurationStat()
+        # Optional group-commit window for client wire batches
+        # (net/wire_window.py; conf.local_batch_wait > 0 enables).
+        self._wire_window = None
+        if conf.local_batch_wait > 0:
+            from gubernator_tpu.net.wire_window import WireWindow
+
+            self._wire_window = WireWindow(engine, conf.local_batch_wait)
 
     # ------------------------------------------------------------------
     # Public API (reference: proto/gubernator.proto service V1)
@@ -434,6 +441,12 @@ class V1Instance:
 
         from gubernator_tpu.core.engine import PackedKeys
 
+        if self._wire_window is not None:
+            out = self._wire_window.submit(dec)
+            if out is None:
+                return None
+            st, lim, rem, rst = out
+            return wire_codec.encode_resps(st, lim, rem, rst)
         packed = PackedKeys(dec.key_buf, dec.key_offsets, dec.n)
         if hasattr(engine, "tables"):  # sharded: codec hashes route shards
             st, lim, rem, rst = engine.apply_columnar(

@@ -208,3 +208,64 @@ def test_native_wire_path_sharded_engine(frozen_clock):
             assert d.instance.counters["columnar"] >= 100
     finally:
         d.close()
+
+
+def test_wire_window_group_commit(frozen_clock):
+    """Concurrent wire RPCs inside the group-commit window share one
+    engine dispatch and still get exact per-caller slices."""
+    import threading
+
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.cluster.harness import cluster_behaviors
+    from gubernator_tpu.net import wire_codec
+
+    if wire_codec.load() is None:
+        pytest.skip("native codec unavailable")
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=cluster_behaviors(),
+        cache_size=4096,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        local_batch_wait=0.005,  # wide window: threads surely share it
+    )
+    d = spawn_daemon(conf, clock=frozen_clock)
+    try:
+        n_threads = 8
+        results = [None] * n_threads
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tid):
+            barrier.wait()
+            with V1Client(d.grpc_address) as c:
+                results[tid] = c.get_rate_limits(
+                    [
+                        _req(f"win{tid}", hits=2, limit=50),
+                        _req("win_shared", hits=1, limit=1000),
+                    ],
+                    timeout=30,
+                )
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        shared_rems = sorted(
+            r[1].remaining for r in results if r is not None
+        )
+        for tid, r in enumerate(results):
+            assert r is not None and r[0].error == ""
+            assert r[0].remaining == 48  # private key: own hits only
+        # Shared key consumed exactly once per thread, sequentially.
+        assert shared_rems == list(range(1000 - n_threads, 1000))
+        ww = d.instance._wire_window
+        assert ww is not None and ww.grouped_batches >= 2
+    finally:
+        d.close()
