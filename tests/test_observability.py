@@ -116,7 +116,9 @@ def test_global_series_move_under_load():
         ][:5]
         assert remote
         inst.get_rate_limits(remote)
-        deadline = time.monotonic() + 5
+        # Generous deadline: the async windows run on 1 shared core and
+        # the full suite loads it.
+        deadline = time.monotonic() + 20
         while time.monotonic() < deadline:
             body = urllib.request.urlopen(
                 f"http://{h.daemon_at(0).http_address}/metrics", timeout=5

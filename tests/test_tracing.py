@@ -151,7 +151,7 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         inst.get_rate_limits(fwd[:1])  # single item → batcher window
         # The flush span is recorded on the flusher thread just after
         # the response futures resolve; poll briefly.
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while time.monotonic() < deadline and not tracer.spans("peer.flush"):
             time.sleep(0.02)
         assert tracer.spans("peer.flush"), "forwarding did not trace a flush"
@@ -166,7 +166,7 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         ][:3]
         assert g
         inst.get_rate_limits(g)
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while time.monotonic() < deadline and not (
             tracer.spans("global.hits_window")
             and tracer.spans("global.broadcast")

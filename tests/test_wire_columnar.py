@@ -146,13 +146,14 @@ def test_forwarding_still_works_with_fast_path(pair):
     d0 = pair.daemon_at(0)
     with V1Client(pair.peer_at(0).grpc_address) as c0:
         # Find a key owned by the other daemon so client 0 must forward.
-        for i in range(64):
+        # The reference-exact 2-member ring can be lumpy; scan wide.
+        for i in range(4096):
             key = f"colfwd_{i}"
             owner = d0.instance.get_peer("wire_" + key)
             if not owner.info.is_owner:
                 break
         else:
-            pytest.skip("no remote-owned key found in 64 tries")
+            pytest.skip("no remote-owned key found in 4096 tries")
         r0 = c0.get_rate_limits([_req(key, limit=3)])[0]
         assert r0.error == ""
         assert r0.metadata.get("owner") == owner.info.grpc_address
