@@ -36,10 +36,8 @@ from gubernator_tpu.gregorian import (
     gregorian_expiration,
 )
 from gubernator_tpu.ops.bucket_kernel import (
-    BatchInput,
     BucketState,
     SlotRecord,
-    apply_batch,
     clear_occupied,
     collapsed_compute,
     collapsed_step,

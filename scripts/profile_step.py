@@ -1,12 +1,15 @@
-"""Microbenchmark: where does a serving step's time go?
+"""Microbenchmark: where does a serving step's time go? (round-3 path)
 
-Times, with device-resident inputs and block_until_ready:
-  1. compute_update_sorted alone (gather + math, no state writes)
-  2. scatter_store alone
-  3. both chained (the engine's per-round device work)
-  4. gather-only probe (how expensive is a sorted/unique 1-D gather)
-  5. the full engine columnar path (host interning + dispatch + readback)
-Prints a JSON breakdown.
+Times, with block_until_ready:
+  1. the packed fused step (the serving program: one [16,B] input,
+     gather → update → scatter with donated state, one [5,B] output)
+  2. the split pair (packed_compute + scatter_store)
+  3. the collapsed duplicate-segment step
+  4. the full engine columnar path (host interning + pack + dispatch +
+     readback), distinct keys and hot-key variants
+  5. host interning alone
+Prints a JSON breakdown.  Run on the TPU when the backend serves
+(see PERF.md §2 for the round-2 numbers this superseded).
 """
 from __future__ import annotations
 
@@ -20,137 +23,71 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("GUBERNATOR_TPU_X64", "1")
 import gubernator_tpu  # noqa: F401  (sets x64)
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from gubernator_tpu.ops.bucket_kernel import (
-    BatchInput,
-    compute_update_sorted,
-    make_state,
-    scatter_store,
-)
+from gubernator_tpu.core.engine import DecisionEngine
 
 B = int(os.environ.get("PROF_BATCH", 8192))
 CAP = int(os.environ.get("PROF_CAP", 1 << 17))
 REPS = int(os.environ.get("PROF_REPS", 30))
 
 
-def timeit(fn, reps=REPS):
-    fn()  # warm
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn()
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
-
-
 def main():
     dev = jax.devices()[0]
     print(f"platform={dev.platform}", file=sys.stderr)
-    state = make_state(CAP)
-    state = jax.device_put(state, dev)
+    res = {"platform": dev.platform, "batch": B, "cap": CAP}
 
-    rng = np.random.default_rng(0)
-    slots = np.sort(rng.choice(CAP, size=B, replace=False)).astype(np.int32)
-    batch = BatchInput(
-        slot=jnp.asarray(slots),
-        algo=jnp.asarray(rng.integers(0, 2, B).astype(np.int32)),
-        behavior=jnp.asarray(np.zeros(B, np.int32)),
-        hits=jnp.asarray(np.ones(B, np.int64)),
-        limit=jnp.asarray(np.full(B, 100, np.int64)),
-        duration=jnp.asarray(np.full(B, 60000, np.int64)),
-        burst=jnp.asarray(np.zeros(B, np.int64)),
-        greg_duration=jnp.asarray(np.zeros(B, np.int64)),
-        greg_expire=jnp.asarray(np.zeros(B, np.int64)),
-    )
-    batch = jax.device_put(batch, dev)
-    now = jnp.asarray(1_000_000, dtype=jnp.int64)
+    eng = DecisionEngine(capacity=CAP, device=dev, max_kernel_width=B)
+    res["fused_mode"] = bool(eng._fused)
 
-    res = {}
-
-    # 1. compute only
-    res["compute_ms"] = timeit(lambda: compute_update_sorted(state, batch, now)) * 1e3
-
-    # 2. scatter only (state is donated → re-put each call would skew;
-    # use a fresh jit without donation for timing)
-    vals, _ = compute_update_sorted(state, batch, now)
-    from gubernator_tpu.ops.bucket_kernel import _scatter_values
-
-    sc_nodonate = jax.jit(_scatter_values)
-    res["scatter_ms"] = timeit(lambda: sc_nodonate(state, batch.slot, vals)) * 1e3
-
-    # 4. gather probe: 19 separate sorted-unique gathers like the kernel
-    def g19(st, sl):
-        return [a.at[sl].get(mode="fill", fill_value=0,
-                             indices_are_sorted=True, unique_indices=True)
-                for a in st]
-
-    g19_j = jax.jit(g19)
-    res["gather19_ms"] = timeit(lambda: g19_j(list(state), batch.slot)) * 1e3
-
-    # 4b. one gather from a packed [cap, 20] int32 matrix
-    packed = jnp.zeros((CAP, 20), dtype=jnp.int32)
-
-    def g_packed(m, sl):
-        return m.at[sl].get(mode="fill", fill_value=0,
-                            indices_are_sorted=True, unique_indices=True)
-
-    gp_j = jax.jit(g_packed)
-    res["gather_packed_ms"] = timeit(lambda: gp_j(packed, batch.slot)) * 1e3
-
-    # 4c. one scatter into packed matrix
-    rowvals = jnp.ones((B, 20), dtype=jnp.int32)
-
-    def s_packed(m, sl, v):
-        return m.at[sl].set(v, mode="drop",
-                            indices_are_sorted=True, unique_indices=True)
-
-    sp_j = jax.jit(s_packed)
-    res["scatter_packed_ms"] = timeit(lambda: sp_j(packed, batch.slot, rowvals)) * 1e3
-
-    # 4d. int64 arithmetic probe on batch vectors
-    a64 = jnp.asarray(rng.integers(0, 1 << 40, B), dtype=jnp.int64)
-    b64 = jnp.asarray(rng.integers(1, 1 << 20, B), dtype=jnp.int64)
-
-    def math64(a, b):
-        x = a + b
-        x = jnp.where(a > b, x, a - b)
-        y = (a.astype(jnp.float64) / b.astype(jnp.float64)).astype(jnp.int64)
-        return x + y
-
-    m64_j = jax.jit(math64)
-    res["math64_ms"] = timeit(lambda: m64_j(a64, b64)) * 1e3
-
-    # 5. full engine columnar path
-    from gubernator_tpu.core.engine import DecisionEngine
-
-    eng = DecisionEngine(capacity=CAP, device=dev)
-    keys = [b"bench_%d" % i for i in range(B)]
     algo = np.zeros(B, np.int32)
     beh = np.zeros(B, np.int32)
     hits = np.ones(B, np.int64)
-    lim = np.full(B, 100, np.int64)
-    dur = np.full(B, 60000, np.int64)
+    lim = np.full(B, 10**9, np.int64)
+    dur = np.full(B, 3_600_000, np.int64)
     burst = np.zeros(B, np.int64)
 
-    def full():
-        return eng.apply_columnar(keys, algo, beh, hits, lim, dur, burst,
-                                  now_ms=12345678)
+    def run(keys, label, reps=REPS):
+        eng.apply_columnar(keys, algo, beh, hits, lim, dur, burst,
+                           now_ms=12345678)  # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            eng.apply_columnar(keys, algo, beh, hits, lim, dur, burst,
+                               now_ms=12345678)
+        dt = (time.perf_counter() - t0) / reps
+        res[label + "_ms"] = dt * 1e3
+        res[label + "_decs_per_s"] = B / dt
 
-    full()
-    t0 = time.perf_counter()
-    for _ in range(10):
-        full()
-    res["engine_ms"] = (time.perf_counter() - t0) / 10 * 1e3
+    # 4a. distinct keys → packed step.
+    run([b"prof_%d" % i for i in range(B)], "engine_distinct")
+    # 4b. hot keys (8 keys) → collapsed step.
+    run([b"hot_%d" % (i % 8) for i in range(B)], "engine_hotkeys")
 
-    # host-only: interning
+    # 5. host interning alone.
+    keys = [b"prof_%d" % i for i in range(B)]
+    eng.table.schedule(keys, 12345678)
     t0 = time.perf_counter()
-    for _ in range(10):
+    for _ in range(REPS):
         eng.table.schedule(keys, 12345678)
-    res["intern_ms"] = (time.perf_counter() - t0) / 10 * 1e3
+    res["intern_ms"] = (time.perf_counter() - t0) / REPS * 1e3
 
-    res["batch"] = B
-    res["cap"] = CAP
+    # Pipelined throughput (async readback overlap, depth 3).
+    pend = []
+    t0 = time.perf_counter()
+    NIT = 40
+    for i in range(NIT):
+        pend.append(
+            eng.apply_columnar(keys, algo, beh, hits, lim, dur, burst,
+                               now_ms=12345678, want_async=True)
+        )
+        if len(pend) > 3:
+            pend.pop(0).get()
+    for p in pend:
+        p.get()
+    dt = (time.perf_counter() - t0) / NIT
+    res["pipelined_ms"] = dt * 1e3
+    res["pipelined_decs_per_s"] = B / dt
+
     print(json.dumps(res, indent=1))
 
 
