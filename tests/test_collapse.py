@@ -341,3 +341,39 @@ def test_sharded_dataclass_collapse_matches_rounds(frozen_clock):
              for r in e_slow.get_rate_limits(rs, now_ms=now)]
         assert a == b, batch
         now += int(rng.integers(0, 20_000))
+
+
+def test_gregorian_duplicates_collapse_matches_rounds(frozen_clock):
+    """DURATION_IS_GREGORIAN segments are uniform per key (same greg
+    fields) and must collapse identically to the rounds path."""
+    from gubernator_tpu.types import RateLimitReq
+
+    GREG_MINUTES = 1  # interval enum (gregorian.py)
+    e_fast = DecisionEngine(capacity=64, clock=frozen_clock)
+    e_slow = DecisionEngine(capacity=64, clock=frozen_clock)
+    e_slow._collapse_dataclass = lambda *a, **k: False
+
+    def reqs(n, algo):
+        return [
+            RateLimitReq(
+                name="greg",
+                unique_key="dup",
+                hits=2,
+                limit=30,
+                duration=GREG_MINUTES,
+                algorithm=algo,
+                behavior=Behavior.DURATION_IS_GREGORIAN,
+                burst=30,
+            )
+            for _ in range(n)
+        ]
+
+    now = frozen_clock.now_ms()
+    for algo in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+        a = [(r.status, r.remaining, r.reset_time, r.error)
+             for r in e_fast.get_rate_limits(reqs(7, algo), now_ms=now)]
+        b = [(r.status, r.remaining, r.reset_time, r.error)
+             for r in e_slow.get_rate_limits(reqs(7, algo), now_ms=now)]
+        assert a == b, algo
+        assert all(x[3] == "" for x in a)
+        now += 10_000
