@@ -1,4 +1,4 @@
-"""Run every BASELINE benchmark config and write BENCH_r03_*.json.
+"""Run every BASELINE benchmark config and write BENCH_<round>_*.json.
 
 Configs (BASELINE.md / BASELINE.json):
   1. default  — token+leaky mixed, 100k keys, single chip (headline)
@@ -9,7 +9,8 @@ Configs (BASELINE.md / BASELINE.json):
 
 Each config is one bench.py subprocess (fresh backend; a wedged run
 cannot poison the next) with its knobs passed via env.  Artifacts land
-in the repo root as BENCH_r03_<name>.json.
+in the repo root as BENCH_<round>_<name>.json where <round> comes from
+BENCH_ROUND (default "r04").
 
 Usage: python scripts/bench_all.py [name ...]   (default: all)
 """
@@ -22,6 +23,7 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND = os.environ.get("BENCH_ROUND", "r04")
 
 CONFIGS: dict[str, dict] = {
     "default": {},
@@ -61,6 +63,13 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "100000",
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_WIRE_PROCS": "4",
+    },
+    # Thundering herd: 32 concurrent clients, one hot key, single-item
+    # RPCs (reference: benchmark_test.go thundering-herd subtest).
+    "herd": {
+        "BENCH_MODE": "herd",
+        "BENCH_KEYS": "1",
+        "BENCH_CAPACITY": str(1 << 17),
     },
     # The 100M-slot HBM proof (BASELINE config 4 at full scale):
     # 19 arrays x 4B x 100M = 7.6GB of device state on one v5e chip.
@@ -109,7 +118,7 @@ def main() -> int:
     for name in names:
         print(f"=== {name}: {CONFIGS[name]}", file=sys.stderr, flush=True)
         result = run(name, CONFIGS[name])
-        path = os.path.join(ROOT, f"BENCH_r03_{name}.json")
+        path = os.path.join(ROOT, f"BENCH_{ROUND}_{name}.json")
         with open(path, "w") as f:
             json.dump(result, f, indent=1)
             f.write("\n")

@@ -1,0 +1,241 @@
+"""Backend-liveness watchdog: ambush the axon TPU backend.
+
+The tunneled TPU backend (JAX_PLATFORMS=axon) serves in unpredictable
+windows — it was healthy in round 2 and wedged for all of round 3
+(every `jax.devices()` probe hung).  This script loops forever:
+
+  1. probe the backend in a SUBPROCESS with a hard timeout
+  2. on first success, immediately capture the TPU artifacts in order
+     of value (the window may be short):
+       a. scripts/profile_dispatch.py  -> PROFILE_r04_tpu.json
+       b. scripts/bench_all.py, one config per subprocess:
+          default leaky1m zipf wire zipf100m global4hot herd sketch
+  3. commit each artifact AS IT LANDS, using a private git index so a
+     concurrent foreground `git commit` can never be corrupted or have
+     its staged files stolen
+  4. keep looping: re-verify artifacts that came back platform=cpu
+     (the backend can wedge mid-run), stop when every target artifact
+     is platform=tpu
+
+Run detached:  nohup python scripts/tpu_watchdog.py >/tmp/watchdog.log 2>&1 &
+Status file:   /tmp/tpu_watchdog_status.json (atomic rewrite each loop)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND = os.environ.get("BENCH_ROUND", "r04")
+PROBE_TIMEOUT = float(os.environ.get("WATCHDOG_PROBE_TIMEOUT", 120))
+POLL_SECONDS = float(os.environ.get("WATCHDOG_POLL_SECONDS", 180))
+STATUS_PATH = "/tmp/tpu_watchdog_status.json"
+
+# Capture order = value order: dispatch profile first (smallest, most
+# diagnostic), then the headline, then the rest.
+BENCH_ORDER = [
+    "default",
+    "wire",
+    "leaky1m",
+    "zipf",
+    "zipf100m",
+    "global4hot",
+    "global4",
+    "herd",
+]
+
+PROBE_SRC = (
+    "import jax; d = jax.devices();"
+    "print(d[0].platform, len(d), flush=True)"
+)
+
+
+def log(msg: str) -> None:
+    ts = time.strftime("%H:%M:%S")
+    print(f"[{ts}] {msg}", flush=True)
+
+
+def write_status(state: dict) -> None:
+    tmp = STATUS_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, STATUS_PATH)
+
+
+def probe() -> str | None:
+    """Return the live platform name, or None if wedged/dead."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the site hook force axon
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+            cwd=ROOT, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    out = (proc.stdout or "").strip().split()
+    return out[0] if out else None
+
+
+def commit_paths(paths: list[str], message: str) -> bool:
+    """Commit repo-root-relative paths using a PRIVATE index.
+
+    Plumbing only: read-tree HEAD into our own index, add the paths,
+    write-tree, commit-tree with parent HEAD, update-ref with an
+    old-value guard.  Retries on ref races with a concurrent
+    foreground commit.  Never touches .git/index.
+    """
+    env = dict(os.environ)
+    env["GIT_INDEX_FILE"] = os.path.join(ROOT, ".git", "watchdog-index")
+
+    def git(*args: str) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            ["git", *args], cwd=ROOT, env=env,
+            capture_output=True, text=True, timeout=60,
+        )
+
+    for attempt in range(5):
+        head = git("rev-parse", "HEAD").stdout.strip()
+        if not head:
+            return False
+        if git("read-tree", head).returncode != 0:
+            return False
+        if git("add", "--", *paths).returncode != 0:
+            return False
+        tree = git("write-tree").stdout.strip()
+        parent_tree = git("rev-parse", f"{head}^{{tree}}").stdout.strip()
+        if tree == parent_tree:
+            return True  # nothing new to record
+        commit = git(
+            "commit-tree", tree, "-p", head, "-m", message
+        ).stdout.strip()
+        if not commit:
+            return False
+        ref = git("update-ref", "refs/heads/main", commit, head)
+        if ref.returncode == 0:
+            return True
+        time.sleep(1.0 + attempt)  # HEAD moved under us; retry
+    return False
+
+
+def artifact_platform(name: str) -> str | None:
+    path = os.path.join(ROOT, f"BENCH_{ROUND}_{name}.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("platform")
+    except (OSError, ValueError):
+        return None
+
+
+def run_profile() -> bool:
+    out_path = os.path.join(ROOT, f"PROFILE_{ROUND}_tpu.json")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "profile_dispatch.py")],
+            capture_output=True, text=True, timeout=900, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        log("profile_dispatch timed out")
+        return False
+    line = ""
+    for ln in (proc.stdout or "").strip().splitlines():
+        if ln.strip().startswith("{"):
+            line = ln.strip()
+    if not line:
+        log(f"profile_dispatch produced no JSON (rc={proc.returncode}): "
+            f"{(proc.stderr or '')[-300:]}")
+        return False
+    data = json.loads(line)
+    with open(out_path, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    if data.get("platform") not in ("tpu", "axon"):
+        log(f"profile ran on {data.get('platform')}, not committing as TPU")
+        return False
+    commit_paths([os.path.basename(out_path)],
+                 f"TPU dispatch profile ({ROUND}): captured live-backend numbers")
+    log(f"profile committed: {data}")
+    return True
+
+
+def run_bench(name: str) -> str | None:
+    env = dict(os.environ)
+    env["BENCH_ROUND"] = ROUND
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "bench_all.py"), name],
+            capture_output=True, text=True, timeout=1800, cwd=ROOT, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench {name}: timed out")
+        return None
+    plat = artifact_platform(name)
+    log(f"bench {name}: rc={proc.returncode} platform={plat}")
+    if plat in ("tpu", "axon"):
+        commit_paths(
+            [f"BENCH_{ROUND}_{name}.json"],
+            f"TPU bench artifact ({ROUND}): {name} on live backend",
+        )
+        return plat
+    return plat
+
+
+def main() -> None:
+    done: set[str] = set()
+    # Artifacts already on TPU (e.g. watchdog restarted) count as done.
+    for name in BENCH_ORDER:
+        if artifact_platform(name) in ("tpu", "axon"):
+            done.add(name)
+    profile_done = os.path.exists(
+        os.path.join(ROOT, f"PROFILE_{ROUND}_tpu.json"))
+    probes = 0
+    while True:
+        plat = probe()
+        probes += 1
+        write_status({
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "probes": probes,
+            "last_platform": plat,
+            "captured": sorted(done),
+            "profile_done": profile_done,
+        })
+        if plat in ("tpu", "axon"):
+            log(f"BACKEND ALIVE (platform={plat}) — capturing")
+            if not profile_done:
+                profile_done = run_profile()
+            for name in BENCH_ORDER:
+                if name in done:
+                    continue
+                got = run_bench(name)
+                if got in ("tpu", "axon"):
+                    done.add(name)
+                elif got is None or got == "cpu":
+                    # backend may have wedged mid-run; re-probe before
+                    # burning time on the remaining configs
+                    if probe() not in ("tpu", "axon"):
+                        log("backend wedged mid-capture; back to polling")
+                        break
+            if len(done) == len(BENCH_ORDER) and profile_done:
+                log("all TPU artifacts captured — exiting")
+                write_status({
+                    "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "probes": probes,
+                    "complete": True,
+                    "captured": sorted(done),
+                })
+                return
+        else:
+            log(f"backend not serving (probe={plat}); "
+                f"sleeping {POLL_SECONDS:.0f}s")
+        time.sleep(POLL_SECONDS)
+
+
+if __name__ == "__main__":
+    main()
