@@ -41,9 +41,12 @@ class WireWindow:
     """Aggregates DecodedBatch submissions into one columnar engine
     call per window."""
 
-    def __init__(self, engine, wait: float):
+    def __init__(self, engine, wait: float, follower_grace: float = 5.0):
         self.engine = engine
         self.wait = wait
+        # How long past the expected window a follower waits before
+        # concluding the leader died (tests shrink this).
+        self.follower_grace = follower_grace
         self._lock = threading.Lock()
         self._pending: List[_Entry] = []
         self._leader_active = False
@@ -61,9 +64,46 @@ class WireWindow:
             if lead:
                 self._leader_active = True
         if not lead:
+            # Bounded wait: if the leader dies before completing the
+            # window, fall back to the protobuf path instead of
+            # hanging the server's wire threads forever.
+            if entry.event.wait(timeout=self.wait * 10 + self.follower_grace):
+                return entry.result
+            with self._lock:
+                if entry in self._pending:
+                    # Leader never swapped the batch out — this entry
+                    # was never applied, so a caller-side fallback
+                    # cannot double-count.  The leader is presumed
+                    # dead: release leadership so the NEXT submit can
+                    # lead instead of every future request eating this
+                    # timeout (any still-live slow leader swapping
+                    # later just takes whatever remains — the swap is
+                    # atomic under the lock, so nothing double-applies).
+                    self._pending.remove(entry)
+                    self._leader_active = False
+                    return None
+            # A leader already took the batch: the hits WILL be applied
+            # (or failed — _run always signals via its finally), so a
+            # caller-side fallback here would double-count.  Wait for
+            # the signal however long the apply takes; the only way it
+            # never arrives is a hard-killed leader thread, at which
+            # point the process is dying anyway.
             entry.event.wait()
             return entry.result
-        time.sleep(self.wait)
+        try:
+            time.sleep(self.wait)
+        except BaseException:
+            # Injected exception mid-window (interpreter shutdown,
+            # etc.): release leadership and fail our batch so no
+            # follower blocks on a window that will never run.
+            with self._lock:
+                batch = self._pending
+                self._pending = []
+                self._leader_active = False
+            for e in batch:
+                e.result = None
+                e.event.set()
+            raise
         with self._lock:
             batch = self._pending
             self._pending = []

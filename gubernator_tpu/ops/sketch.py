@@ -104,14 +104,24 @@ def _sketch_step_impl(
         pos = pin[2 + 3 * r + 2]  # lane → position into idx/new counts
         row_cur = counts[cur, r]
         row_prev = counts[prev, r]
-        new_row = row_cur.at[idx].add(
-            add, mode="drop", indices_are_sorted=True, unique_indices=True
-        )
-        counts = counts.at[cur, r].set(new_row)
-        g_cur = new_row.at[idx].get(
+        # Saturating add: gather current counters, add in int64, clamp
+        # to the int32 range, scatter-set.  A plain int32 scatter-add
+        # would wrap a saturated counter negative and silently turn the
+        # one-sided "never under-counts" guarantee into under-counting.
+        g0 = row_cur.at[idx].get(
             mode="fill", fill_value=0, indices_are_sorted=True,
             unique_indices=True,
         )
+        new_vals = jnp.clip(
+            g0.astype(_I64) + add.astype(_I64),
+            -(2**31), 2**31 - 1,
+        ).astype(_I32)
+        new_row = row_cur.at[idx].set(
+            new_vals, mode="drop", indices_are_sorted=True,
+            unique_indices=True,
+        )
+        counts = counts.at[cur, r].set(new_row)
+        g_cur = new_vals
         g_prev = row_prev.at[idx].get(
             mode="fill", fill_value=0, indices_are_sorted=True,
             unique_indices=True,
@@ -199,21 +209,27 @@ class SketchLimiter:
         pin[0, 0] = np.int32(epoch >> 32)
         pin[0, 1] = np.int64(epoch).astype(np.int32)
         pin[0, 2] = frac
-        pin[1, :n] = np.minimum(hits64, np.int64(2**31 - 1)).astype(np.int32)
+        pin[1, :n] = np.clip(hits64, -(2**31), 2**31 - 1).astype(np.int32)
         for r in range(self.depth):
             idx = rows[r]
             # Host pre-combine: unique sorted indexes + summed hits,
             # plus each lane's position into the unique array.
             uniq, inv = np.unique(idx, return_inverse=True)
-            sums = np.bincount(inv, weights=hits64.astype(np.float64))
             m = len(uniq)
+            # Exact int64 per-index sums, clamped to int32: a hot key's
+            # combined hits must not wrap negative in the int32 lane
+            # (that would decrement the counter — under-counting, which
+            # the one-sided error contract forbids).
+            sums = np.zeros(m, dtype=np.int64)
+            np.add.at(sums, inv, hits64)
+            sums = np.clip(sums, -(2**31), 2**31 - 1)
             pin[2 + 3 * r, :m] = uniq.astype(np.int32)
             if size > m:
                 pin[2 + 3 * r, m:] = (
                     np.arange(self.width, self.width + (size - m), dtype=np.int64)
                     .astype(np.int32)
                 )
-            pin[2 + 3 * r + 1, :m] = sums.astype(np.int64).astype(np.int32)
+            pin[2 + 3 * r + 1, :m] = sums.astype(np.int32)
             pin[2 + 3 * r + 2, :n] = inv.astype(np.int32)
 
         self._state, out = self._step(self._state, jnp.asarray(pin))

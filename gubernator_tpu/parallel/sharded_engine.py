@@ -646,6 +646,34 @@ class ShardedDecisionEngine:
                     now_ms=now,
                 )
                 width *= 2
+            # Duplicate-key ladder: hot-key batches run the per-shard
+            # collapsed-segment program, a SEPARATE compile family from
+            # the packed step (see DecisionEngine.warmup's
+            # b'__warmup__dup' batches) — without it the first hot-key
+            # batch on a mesh deployment pays the multi-second XLA
+            # compile inside the serving path.  One hot key per shard,
+            # reusing the rejection-sampled per-shard keys (the same
+            # encoding the columnar ladder above proved routes to each
+            # shard), keeps the padded [n_shards, width] shapes
+            # identical to serving.
+            dup_key = [
+                f"__warmup___{ks[0]}".encode() for ks in per_shard
+            ]
+            width = 64
+            while width <= max_width:
+                keys = [k for k in dup_key for _ in range(width)]
+                n = len(keys)
+                self.apply_columnar(
+                    keys,
+                    np.zeros(n, dtype=_I32),
+                    np.zeros(n, dtype=_I32),
+                    np.zeros(n, dtype=_I64),
+                    np.ones(n, dtype=_I64),
+                    np.ones(n, dtype=_I64),
+                    np.zeros(n, dtype=_I64),
+                    now_ms=now,
+                )
+                width *= 2
             csize = 16
             cap = self.shard_capacity
             while csize <= max_width:

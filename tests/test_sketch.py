@@ -75,3 +75,21 @@ def test_overcount_is_one_sided():
     limit = np.full(n, 10**9, dtype=np.int64)
     _, est = lim.apply(keys, hits, limit, 0)
     assert (est >= 1).all()
+
+
+def test_hot_key_saturates_instead_of_wrapping():
+    """A hot key whose combined hits exceed int32 must saturate the
+    counter at 2^31-1, never wrap negative (ADVICE r3: wrapping would
+    under-count, violating the one-sided error contract)."""
+    lim = SketchLimiter(window_ms=1_000, depth=2, width=1 << 10)
+    big = 2**30
+    keys = [b"hot"] * 4  # combined 4*2^30 = 2^32 > int32 max
+    hits = np.full(4, big, dtype=np.int64)
+    limit = np.full(4, 10**6, dtype=np.int64)
+    over, est = lim.apply(keys, hits, limit, 0)
+    assert (est == 2**31 - 1).all()
+    assert over.all()
+    # A second saturated batch must stay saturated, not wrap.
+    over, est = lim.apply(keys, hits, limit, 10)
+    assert (est >= 2**31 - 1).all()
+    assert over.all()

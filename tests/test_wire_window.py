@@ -1,0 +1,136 @@
+"""WireWindow leader/follower failure paths (ADVICE r3).
+
+The group-commit window must never hang the server's wire threads or
+double-apply hits: a follower whose leader died falls back (None) only
+when its entry was never taken; once a leader has swapped the batch
+out, the follower waits for the apply however long it takes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.net.wire_window import WireWindow
+
+
+class _Dec:
+    """Minimal DecodedBatch stand-in (one key, one lane)."""
+
+    def __init__(self, key=b"k"):
+        self.n = 1
+        self.key_buf = np.frombuffer(key, dtype=np.uint8).copy()
+        self.key_offsets = np.asarray([0, len(key)], dtype=np.int64)
+        for f in ("algo", "behavior"):
+            setattr(self, f, np.zeros(1, dtype=np.int32))
+        for f in ("hits", "limit", "duration", "burst"):
+            setattr(self, f, np.ones(1, dtype=np.int64))
+        self.fnv1a = np.zeros(1, dtype=np.uint64)
+
+
+class _Engine:
+    """Fake engine: counts applies; can stall inside the apply."""
+
+    def __init__(self, stall: float = 0.0):
+        self.stall = stall
+        self.applies = 0
+        self.lanes = 0
+
+    def apply_columnar(self, packed, algo, behavior, hits, limit,
+                       duration, burst):
+        if self.stall:
+            time.sleep(self.stall)
+        self.applies += 1
+        n = len(algo)
+        self.lanes += n
+        z = np.zeros(n, dtype=np.int64)
+        return z, z, z, z
+
+
+def test_follower_timeout_dead_leader_falls_back():
+    """Leader died before swapping the batch: the follower must remove
+    its (never-applied) entry and return None so the caller can use
+    the protobuf path without double-counting."""
+    ww = WireWindow(_Engine(), wait=0.01, follower_grace=0.05)
+    ww._leader_active = True  # simulate a leader that died post-claim
+    t0 = time.monotonic()
+    assert ww.submit(_Dec()) is None
+    assert time.monotonic() - t0 < 5.0
+    assert ww._pending == []  # entry removed, not leaked
+    assert ww.engine.applies == 0
+    # Leadership was released: the next request leads a fresh window
+    # immediately instead of eating the follower timeout forever.
+    assert not ww._leader_active
+    t0 = time.monotonic()
+    assert ww.submit(_Dec()) is not None
+    assert time.monotonic() - t0 < 0.05 + 1.0
+    assert ww.engine.applies == 1
+
+
+def test_follower_waits_out_inflight_apply_no_double_count():
+    """Once a leader swapped the batch out, a slow engine apply must
+    NOT push the follower to the fallback path (that would apply the
+    same hits twice); it waits and gets the windowed result."""
+    eng = _Engine(stall=0.5)
+    ww = WireWindow(eng, wait=0.05, follower_grace=0.01)
+    results = {}
+
+    def caller(name):
+        results[name] = ww.submit(_Dec())
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+        time.sleep(0.005)  # deterministic leader, followers join window
+    for t in threads:
+        t.join(timeout=10)
+    assert all(not t.is_alive() for t in threads)
+    # grace (0.01+wait*10=0.51... make stall dominate) — every caller
+    # got a real result and the engine ran exactly one window.
+    assert all(r is not None for r in results.values())
+    assert eng.applies == 1
+    assert eng.lanes == 3
+
+
+def test_leader_exception_during_window_releases_leadership():
+    """An injected exception while the leader sleeps must fail the
+    pending entries (followers unblock with None) and release
+    _leader_active so the next request can lead."""
+    eng = _Engine()
+    ww = WireWindow(eng, wait=0.05, follower_grace=0.2)
+    orig_sleep = time.sleep
+    fired = [False]
+
+    def boom(secs):
+        if secs == ww.wait and not fired[0]:
+            fired[0] = True
+            orig_sleep(0.1)  # let the follower join the window first
+            raise KeyboardInterrupt("injected")
+        orig_sleep(secs)
+
+    follower_res = []
+
+    def follower():
+        orig_sleep(0.01)  # join after the leader claims the window
+        follower_res.append(ww.submit(_Dec()))
+
+    th = threading.Thread(target=follower)
+    time.sleep = boom
+    try:
+        th.start()
+        with pytest.raises(KeyboardInterrupt):
+            ww.submit(_Dec())
+    finally:
+        time.sleep = orig_sleep
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert not ww._leader_active
+    assert ww._pending == []
+    # Both entries failed closed (None → caller falls back); since the
+    # engine never ran, the fallback cannot double-count.
+    assert follower_res == [None]
+    assert eng.applies == 0
+    # The window is usable again: a fresh submit leads and applies.
+    assert ww.submit(_Dec()) is not None
+    assert eng.applies == 1
