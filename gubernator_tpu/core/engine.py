@@ -141,8 +141,12 @@ class PendingColumnar:
         o_status = np.empty(n, dtype=np.int32)
         o_remaining = np.empty(n, dtype=_I64)
         o_reset = np.empty(n, dtype=_I64)
-        for packed, dst_idx, m, _size in self._pieces:
-            arr = np.asarray(packed)  # one transfer per piece
+        for piece in self._pieces:
+            packed, dst_idx, m, _size = piece[:4]
+            # Narrow-format pieces carry their own unpacker (uniform
+            # batches, bucket_kernel.unpack_uniform_out_host).
+            unpack = piece[4] if len(piece) > 4 else unpack_out_host
+            arr = packed.fetch()  # combined transfer (core/readback.py)
             if isinstance(dst_idx, list):
                 # Sharded piece: arr is [n_shards, PACKED_OUT_ROWS,
                 # width]; dst_idx/m are per-shard request-index rows /
@@ -156,7 +160,7 @@ class PendingColumnar:
                     o_remaining[idxs] = rem
                     o_reset[idxs] = rst
             else:
-                st, rem, rst = unpack_out_host(arr, m)
+                st, rem, rst = unpack(arr, m)
                 o_status[dst_idx] = st
                 o_remaining[dst_idx] = rem
                 o_reset[dst_idx] = rst
@@ -322,13 +326,36 @@ class DecisionEngine:
             self._noop_clear = jnp.asarray(
                 np.arange(capacity, capacity + 16, dtype=np.int64).astype(_I32)
             )
-        self._lock = threading.Lock()
+        # RLock: PumpTicket.fetch may flush from a thread already
+        # inside the engine (dataclass-path dispatch fetches inline).
+        self._lock = threading.RLock()
         self._sweep_cursor = 0  # next window start for incremental sweep
         # ONE device op per round when XLA compiles the donated
         # gather→update→scatter in place; otherwise the split pair
         # (packed_compute + scatter_store, two ops) — probed once per
         # capacity via XLA's memory analysis (see fused_step_ok).
         self._fused = fused_step_ok(capacity)
+        # Cross-call dispatch batching (core/pump.py): queue packed
+        # rounds, run ≤16 of them per execute RPC via lax.scan.  Only
+        # when the scanned program keeps the donated state in place,
+        # and only on accelerator backends — the pump amortizes
+        # per-RPC transfer/execute overhead that the in-process CPU
+        # backend does not have (GUBER_PUMP=1/0 overrides).
+        import os as _os
+
+        from gubernator_tpu.ops.bucket_kernel import multi_step_ok
+
+        pump_env = _os.environ.get("GUBER_PUMP", "")
+        want_pump = (
+            pump_env == "1"
+            or (pump_env != "0" and jax.default_backend() != "cpu")
+        )
+        if want_pump and self._fused and multi_step_ok(capacity):
+            from gubernator_tpu.core.pump import StepPump
+
+            self._pump: Optional["StepPump"] = StepPump(self)
+        else:
+            self._pump = None
         # Metrics (reference: gubernator.go:59-113 catalog; wired to
         # prometheus in gubernator_tpu.utils.metrics).
         self.requests_total = 0
@@ -338,6 +365,13 @@ class DecisionEngine:
         from gubernator_tpu.utils.metrics import DurationStat
 
         self.round_duration = DurationStat()
+        # Engine-wide d2h transfer batching (core/readback.py): every
+        # dispatched output registers a ticket; readers share one
+        # stacked transfer RPC instead of paying the tunnel's fixed
+        # per-transfer cost each.
+        from gubernator_tpu.core.readback import ReadbackCombiner
+
+        self.readback = ReadbackCombiner()
 
     # ------------------------------------------------------------------
 
@@ -506,14 +540,37 @@ class DecisionEngine:
         return pout
 
     def _dispatch_collapsed(self, buf: np.ndarray):
+        # The collapsed program reads state directly: queued pump
+        # rounds must land first (ordering contract, core/pump.py).
+        self._flush_pump()
         return self._dispatch(buf, collapsed_step, collapsed_compute)
+
+    def _dispatch_uniform(self, buf: np.ndarray):
+        """Narrow uniform-batch step (pump-only: requires the fused
+        in-place program family)."""
+        import time as _time
+
+        from gubernator_tpu.ops.bucket_kernel import uniform_step
+
+        t0 = _time.monotonic()
+        pin = jnp.asarray(buf)
+        self._state, pout = uniform_step(self._state, pin)
+        self.round_duration.observe(_time.monotonic() - t0)
+        return pout
 
     def _dispatch_packed(self, buf: np.ndarray):
         return self._dispatch(buf, fused_step, packed_compute)
 
+    def _flush_pump(self) -> None:
+        """Apply queued pump rounds before any OTHER state access (see
+        core/pump.py ordering contract).  Caller holds the lock."""
+        if self._pump is not None:
+            self._pump.flush_locked()
+
     def _apply_clears(self, cleared: np.ndarray) -> None:
         """Eviction clears: a separate tiny scatter so the apply
         kernel's compiled shapes never depend on eviction pressure."""
+        self._flush_pump()
         csize = _pad_size(len(cleared), floor=16)
         c = np.arange(
             self.capacity, self.capacity + csize, dtype=np.int64
@@ -526,6 +583,7 @@ class DecisionEngine:
     def _apply_restores(self, restores: List[tuple]) -> None:
         """Hydrate store-provided bucket values into fresh slots —
         one batched device scatter (see build_restore_record)."""
+        self._flush_pump()
         rec = build_restore_record(restores, self.capacity)
         self._state = load_slots(
             self._state,
@@ -614,9 +672,11 @@ class DecisionEngine:
             c_gdur[sort_idx],
             c_gexp[sort_idx],
         )
-        pout = self._dispatch_packed(buf)
-
-        o_status, o_rem, o_reset = unpack_out_host(np.asarray(pout), m)
+        if self._pump is not None:
+            ticket = self._pump.submit(buf)
+        else:
+            ticket = self.readback.register(self._dispatch_packed(buf))
+        o_status, o_rem, o_reset = unpack_out_host(ticket.fetch(), m)
         over = 0
         for pos, sj in enumerate(sort_idx.tolist()):
             j = members[sj]
@@ -660,6 +720,7 @@ class DecisionEngine:
             return c
 
         with self._lock, span("engine.sweep") as s:
+            self._flush_pump()
             freed = windowed_sweep(self, self.capacity, now_ms, max_windows, release)
             if s is not None:
                 s.set_attribute("freed", freed)
@@ -788,6 +849,38 @@ class DecisionEngine:
         self.table.set_expiry(slots, expires.astype(_I64))
         return PendingColumnar(self, pieces, limit, n)
 
+    def _uniform_params(
+        self, algo, behavior, hits, limit, duration, burst
+    ) -> Optional[tuple]:
+        """Gate for the narrow uniform-batch format (bucket_kernel
+        UNIFORM_IN_ROWS): one limit config across the batch, 32-bit-
+        safe values, no Gregorian.  ~µs of numpy checks buy an 8×
+        smaller uplink payload on the transfer-bound backend."""
+        if self._pump is None or len(algo) == 0:
+            return None
+        a0 = int(algo[0])
+        b0 = int(behavior[0])
+        h0 = int(hits[0])
+        l0 = int(limit[0])
+        d0 = int(duration[0])
+        u0 = int(burst[0])
+        # Gregorian needs per-lane fields; RESET_REMAINING responds
+        # with reset_time=0 (reference semantics), which the narrow
+        # (reset - now) int32 delta cannot represent.
+        if b0 & (_GREG | int(Behavior.RESET_REMAINING)):
+            return None
+        if not (0 <= l0 < 2**31 and 0 <= u0 < 2**31 and 0 < d0 < 2**31):
+            return None
+        if not -(2**31) < h0 < 2**31:
+            return None
+        if (
+            (algo != a0).any() or (behavior != b0).any()
+            or (hits != h0).any() or (limit != l0).any()
+            or (duration != d0).any() or (burst != u0).any()
+        ):
+            return None
+        return (a0, b0, h0, l0, d0, u0)
+
     def _dispatch_rounds(
         self, slots, rounds_arr, max_round, algo, behavior, hits, limit,
         duration, burst, greg_dur, greg_exp, now_ms, evicted,
@@ -817,6 +910,16 @@ class DecisionEngine:
         # the packed outputs.  Materialization happens in
         # PendingColumnar.get(), so the caller can overlap this batch's
         # readback with the next batch's dispatch.
+        uni = self._uniform_params(algo, behavior, hits, limit, duration, burst)
+        if uni is not None:
+            from gubernator_tpu.ops.bucket_kernel import (
+                pack_uniform_host,
+                unpack_uniform_out_host,
+            )
+
+            def unpack_uni(arr, m, _now=now_ms):
+                return unpack_uniform_out_host(arr, m, _now)
+
         pieces: List[tuple] = []
         for k, members in round_members:
             cleared = clear_by_round.get(k)
@@ -839,22 +942,43 @@ class DecisionEngine:
                 m = hi - lo
                 size = _pad_size(m)
                 sort_idx = np.argsort(c_slot[lo:hi], kind="stable")
-                buf = pack_batch_host(
-                    size,
-                    now_ms,
-                    self.capacity,
-                    np.ascontiguousarray(c_slot[lo:hi][sort_idx], dtype=_I32),
-                    *(a[lo:hi][sort_idx] for a in cols),
-                )
-                pout = self._dispatch_packed(buf)
-                pout.copy_to_host_async()
+                if uni is not None:
+                    buf = pack_uniform_host(
+                        size,
+                        now_ms,
+                        self.capacity,
+                        np.ascontiguousarray(
+                            c_slot[lo:hi][sort_idx], dtype=_I32
+                        ),
+                        *uni,
+                    )
+                    ticket = self._pump.submit(buf)
+                else:
+                    buf = pack_batch_host(
+                        size,
+                        now_ms,
+                        self.capacity,
+                        np.ascontiguousarray(
+                            c_slot[lo:hi][sort_idx], dtype=_I32
+                        ),
+                        *(a[lo:hi][sort_idx] for a in cols),
+                    )
+                    if self._pump is not None:
+                        ticket = self._pump.submit(buf)
+                    else:
+                        ticket = self.readback.register(
+                            self._dispatch_packed(buf)
+                        )
                 self.rounds_total += 1
                 # Request indices of the sorted lanes, for unpermuting.
                 if members is None:
                     dst_idx = sort_idx + lo if lo else sort_idx
                 else:
                     dst_idx = members[lo:hi][sort_idx]
-                pieces.append((pout, dst_idx, m, size))
+                if uni is not None:
+                    pieces.append((ticket, dst_idx, m, size, unpack_uni))
+                else:
+                    pieces.append((ticket, dst_idx, m, size))
         return pieces
 
     def _collapse_dataclass(
@@ -909,7 +1033,7 @@ class DecisionEngine:
             return False
         over = 0
         for pout, dst_idx, m, _size in pieces:
-            st, rem, rst = unpack_out_host(np.asarray(pout), m)
+            st, rem, rst = unpack_out_host(pout.fetch(), m)
             for pos, j in enumerate(dst_idx.tolist()):
                 i = valid_idx[j]
                 s = int(st[pos])
@@ -1002,9 +1126,10 @@ class DecisionEngine:
                 c_pos.astype(_I32),
             )
             pout = self._dispatch_collapsed(buf)
-            pout.copy_to_host_async()
             self.rounds_total += 1
-            pieces.append((pout, order[lo:hi], m, size))
+            pieces.append(
+                (self.readback.register(pout), order[lo:hi], m, size)
+            )
         return pieces
 
     # ------------------------------------------------------------------
@@ -1033,6 +1158,7 @@ class DecisionEngine:
                 pending_slots.clear()
 
         with self._lock:
+            self._flush_pump()
             for item in loader.load():
                 if item.value is None or not item.key:
                     continue
@@ -1064,6 +1190,7 @@ class DecisionEngine:
         from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
 
         with self._lock:
+            self._flush_pump()
             s = self._state
             occ = np.asarray(s.occupied)
             algo = np.asarray(s.algo)
@@ -1182,6 +1309,23 @@ class DecisionEngine:
                     occupied=clear_occupied(self._state.occupied, dummy)
                 )
                 csize *= 2
+            # Readback-combiner stack ladder: concurrent/pipelined
+            # callers share one stacked d2h transfer; precompile the
+            # stack programs per output width (core/readback.py).
+            from gubernator_tpu.ops.bucket_kernel import PACKED_OUT_ROWS
+
+            width = 64
+            while width <= max_width:
+                self.readback.warmup_stacks((PACKED_OUT_ROWS, width), jnp.int32)
+                width *= 2
+            # Step-pump scan ladder: fused multi-round programs per
+            # width (core/pump.py) — the serving path under concurrent
+            # load groups cross-call rounds into these.
+            if self._pump is not None:
+                width = 64
+                while width <= max_width:
+                    self._pump.warmup(width)
+                    width *= 2
             self.sweep(now_ms=now + 2)
             (
                 self.requests_total,

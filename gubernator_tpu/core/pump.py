@@ -1,0 +1,246 @@
+"""Step pump: cross-call device dispatch batching.
+
+The readback combiner (core/readback.py) collapses d2h RPCs; this
+module collapses the OTHER two per-step RPCs — h2d upload and program
+execute — by queueing packed round buffers across apply calls and
+running up to MAX_GROUP of them through ONE `multi_fused_step`
+(lax.scan) dispatch: one h2d of [R, 16, W], one execute, one
+prefetched d2h of [R, 5, W].  Measured on the tunneled backend
+(scripts/probe_engine_pipe.py): 16 individually dispatched steps cost
+~180ms of execute wait + ~130ms readback; the same 16 rounds fused
+cost one ~15ms execute + one readback.
+
+Ordering contract: buffers are applied in submission order (scan
+order = queue order), so per-slot sequential semantics are exactly
+those of the per-round path.  Any OTHER state access (clears,
+restores, collapse dispatch, sweep, bulk load/save) must call
+`flush_locked()` first — the engine does, under its lock — so state
+mutations interleave in program order.
+
+Queued work is applied lazily: every observation of engine state
+(ticket fetch, sweep, save) forces a flush, so results are never
+stale; `now_ms` rides inside each packed buffer, so delayed
+application cannot shift timestamps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+MAX_GROUP = 16
+
+
+class _Group:
+    """Shared host-side result of one flushed multi-step dispatch."""
+
+    __slots__ = ("handle", "host", "error", "lock")
+
+    def __init__(self, handle) -> None:
+        self.handle = handle  # device [R, 5, W] (or [5, W] singles)
+        self.host: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.lock = threading.Lock()
+
+    def materialize(self) -> np.ndarray:
+        if self.host is None and self.error is None:
+            with self.lock:
+                if self.host is None and self.error is None:
+                    try:
+                        # Prefetched at flush: usually a cache hit.
+                        self.host = np.asarray(self.handle)
+                        self.handle = None
+                    except BaseException as e:  # noqa: BLE001
+                        self.error = e
+                        raise
+        if self.error is not None:
+            raise self.error
+        return self.host
+
+
+class PumpTicket:
+    """One queued packed round.  `fetch()` → host [rows, W] output."""
+
+    __slots__ = ("pump", "buf", "group", "index", "error")
+
+    def __init__(self, pump: "StepPump", buf: np.ndarray) -> None:
+        self.pump = pump
+        self.buf: Optional[np.ndarray] = buf  # until dispatched
+        self.group: Optional[_Group] = None
+        self.index: Optional[int] = None
+        self.error: Optional[BaseException] = None
+
+    def fetch(self) -> np.ndarray:
+        if self.group is None and self.error is None:
+            self.pump.flush_for(self)
+        if self.error is not None:
+            raise self.error
+        arr = self.group.materialize()
+        return arr if self.index is None else arr[self.index]
+
+
+class StepPump:
+    """Per-engine queue of packed rounds awaiting a fused dispatch."""
+
+    def __init__(self, engine, max_group: int = MAX_GROUP) -> None:
+        self.engine = engine
+        self.max_group = max_group
+        self._queue: List[PumpTicket] = []
+        self._noop: Dict[int, np.ndarray] = {}  # width → no-op buffer
+        # Telemetry (PERF.md).
+        self.submitted = 0
+        self.flushes = 0
+        self.fused_rounds = 0
+
+    # -- engine-lock-held API ------------------------------------------
+
+    def submit(self, buf: np.ndarray) -> PumpTicket:
+        """Queue one packed [PACKED_IN_ROWS, W] round.  Caller holds
+        the engine lock (dispatch order = queue order)."""
+        t = PumpTicket(self, buf)
+        self._queue.append(t)
+        self.submitted += 1
+        if len(self._queue) >= self.max_group:
+            self.flush_locked()
+        return t
+
+    def flush_locked(self) -> None:
+        """Dispatch everything queued, in order, grouping maximal runs
+        of equal shape (width AND format: the 16-row general and 2-row
+        uniform buffers run different programs).  Caller holds the
+        engine lock."""
+        q, self._queue = self._queue, []
+        i = 0
+        while i < len(q):
+            j = i + 1
+            shape = q[i].buf.shape
+            while (
+                j < len(q)
+                and j - i < self.max_group
+                and q[j].buf.shape == shape
+            ):
+                j += 1
+            try:
+                self._flush_group(q[i:j])
+            except BaseException as e:  # noqa: BLE001
+                # The donated state went into the failed dispatch —
+                # every swapped-out ticket (this group AND the ones
+                # behind it) must fail closed rather than strand
+                # fetchers on group=None.
+                for t in q[i:]:
+                    if t.group is None and t.error is None:
+                        t.error = e
+                raise
+            i = j
+
+    # -- leader path (engine lock held) --------------------------------
+
+    def _noop_buf(self, shape) -> np.ndarray:
+        buf = self._noop.get(shape)
+        if buf is None:
+            from gubernator_tpu.ops.bucket_kernel import (
+                UNIFORM_IN_ROWS,
+                pack_batch_host,
+                pack_uniform_host,
+            )
+
+            width = shape[1]
+            if shape[0] == UNIFORM_IN_ROWS:
+                buf = pack_uniform_host(
+                    width, 0, self.engine.capacity,
+                    np.empty(0, dtype=np.int32), 0, 0, 0, 1, 1, 0,
+                )
+            else:
+                e64 = np.empty(0, dtype=np.int64)
+                buf = pack_batch_host(
+                    width, 0, self.engine.capacity,
+                    np.empty(0, dtype=np.int32),
+                    e64, e64, e64, e64, e64, e64, e64, e64,
+                )
+            self._noop[shape] = buf
+        return buf
+
+    def _flush_group(self, group: List[PumpTicket]) -> None:
+        from gubernator_tpu.ops.bucket_kernel import (
+            UNIFORM_IN_ROWS,
+            multi_fused_step,
+            multi_uniform_step,
+        )
+
+        eng = self.engine
+        self.flushes += 1
+        shape = group[0].buf.shape
+        is_uniform = shape[0] == UNIFORM_IN_ROWS
+        if len(group) == 1:
+            t = group[0]
+            pout = (
+                eng._dispatch_uniform(t.buf) if is_uniform
+                else eng._dispatch_packed(t.buf)
+            )
+            pout.copy_to_host_async()
+            t.group = _Group(pout)
+            t.index = None
+            t.buf = None
+            return
+        k = len(group)
+        r = 2
+        while r < k:
+            r *= 2
+        bufs = [t.buf for t in group]
+        bufs += [self._noop_buf(shape)] * (r - k)
+        import time as _time
+
+        t0 = _time.monotonic()
+        pins = jnp.asarray(np.stack(bufs))
+        step = multi_uniform_step if is_uniform else multi_fused_step
+        eng._state, pouts = step(eng._state, pins)
+        eng.round_duration.observe(_time.monotonic() - t0)
+        pouts.copy_to_host_async()  # background transfer starts now
+        self.fused_rounds += k
+        g = _Group(pouts)
+        for i, t in enumerate(group):
+            t.group = g
+            t.index = i
+            t.buf = None
+
+    # -- lock-free API -------------------------------------------------
+
+    def flush_for(self, ticket: PumpTicket) -> None:
+        """Called from fetch() without the engine lock."""
+        with self.engine._lock:
+            if ticket.group is None:
+                self.flush_locked()
+
+    # -- warmup --------------------------------------------------------
+
+    def warmup(self, width: int) -> None:
+        """Precompile the multi-step scan families {2,4,8,16} at one
+        width — general AND uniform formats — plus the single uniform
+        step (engine warmup calls this per ladder width)."""
+        from gubernator_tpu.ops.bucket_kernel import (
+            PACKED_IN_ROWS,
+            UNIFORM_IN_ROWS,
+            multi_fused_step,
+            multi_uniform_step,
+        )
+
+        eng = self.engine
+        pout = eng._dispatch_uniform(
+            self._noop_buf((UNIFORM_IN_ROWS, width))
+        )
+        np.asarray(pout)
+        for rows, step in (
+            (PACKED_IN_ROWS, multi_fused_step),
+            (UNIFORM_IN_ROWS, multi_uniform_step),
+        ):
+            r = 2
+            while r <= self.max_group:
+                pins = jnp.asarray(
+                    np.stack([self._noop_buf((rows, width))] * r)
+                )
+                eng._state, pouts = step(eng._state, pins)
+                np.asarray(pouts)
+                r *= 2

@@ -1,0 +1,192 @@
+"""Readback combiner: many device→host copies, ONE transfer RPC.
+
+The tunneled TPU backend charges a large FIXED cost per device→host
+transfer (~25-40ms per RPC regardless of payload — measured in
+scripts/probe_d2h.py: 16 separate [5,8192] int32 reads cost 1140ms,
+the same data device-stacked into one array reads in 123ms).  Host→
+device is ~1GB/s with a ~0.2ms floor and compute is microseconds, so
+readback RPC count IS the serving throughput ceiling.
+
+This module batches outstanding readbacks engine-wide: every dispatched
+step output registers a Ticket instead of calling `np.asarray` itself;
+the first caller that needs a result becomes the LEADER, stacks all
+outstanding same-shape outputs on device with one tiny jitted
+`jnp.stack` program, pulls the stack across the tunnel in ONE transfer,
+and distributes host slices to every ticket it covered.
+
+Group shapes are bounded for XLA: stacks cover pow-of-two counts
+(1..MAX_GROUP) of identical [rows, width] outputs (counts are rounded
+up by repeating the last handle — duplicate transfer bytes are ~free
+next to the per-RPC fixed cost), so the program universe is
+{widths} × {2,4,8,16}, all precompilable in warmup.
+
+The reference has no analog: its decisions are host-memory reads
+(lrucache.go); this is the TPU-first replacement for "the cache is in
+HBM on the far side of a high-latency link".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_GROUP = 16
+
+
+class Ticket:
+    """One registered readback.  `fetch()` returns the host ndarray."""
+
+    __slots__ = ("handle", "host", "error", "combiner", "event")
+
+    def __init__(self, combiner: "ReadbackCombiner", handle) -> None:
+        self.combiner = combiner
+        self.handle = handle  # device array until materialized
+        self.host: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+
+    def fetch(self) -> np.ndarray:
+        if self.host is None and self.error is None:
+            self.combiner._fetch(self)
+        if self.error is not None:
+            raise self.error
+        return self.host
+
+
+class ReadbackCombiner:
+    """Engine-wide queue of pending device→host readbacks."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._queue: List[Ticket] = []
+        self._stack_cache: Dict[Tuple, object] = {}
+        # Telemetry (PERF.md): transfer RPCs saved = registered -
+        # transfers.
+        self.registered = 0
+        self.transfers = 0
+        self.stacked = 0
+
+    def register(self, handle) -> Ticket:
+        """Called at dispatch time (engine lock held is fine — this
+        only appends).  The handle's transfer is DEFERRED: no
+        copy_to_host_async here, the stacked read would transfer the
+        same bytes twice."""
+        t = Ticket(self, handle)
+        with self._lock:
+            self._queue.append(t)
+            self.registered += 1
+            overflow = len(self._queue) > 4 * MAX_GROUP
+        if overflow:
+            # Fire-and-forget callers never fetch; bound device memory
+            # by draining the oldest group on their behalf.
+            self._drain_oldest()
+        return t
+
+    # -- leader path ---------------------------------------------------
+
+    def _stack_program(self, count: int, shape, dtype):
+        key = (count, tuple(shape), str(dtype))
+        prog = self._stack_cache.get(key)
+        if prog is None:
+            prog = jax.jit(lambda *xs: jnp.stack(xs))
+            self._stack_cache[key] = prog
+        return prog
+
+    def _take_group_locked(self, want: Optional[Ticket]) -> List[Ticket]:
+        """Pick up to MAX_GROUP queued tickets sharing one shape class
+        (the caller's if it is still queued, else the oldest entry's)
+        and remove them from the queue.  Caller holds the lock."""
+        anchor = want if want in self._queue else (
+            self._queue[0] if self._queue else None
+        )
+        if anchor is None:
+            return []
+        shape, dtype = anchor.handle.shape, anchor.handle.dtype
+        group = [
+            t for t in self._queue
+            if t.handle.shape == shape and t.handle.dtype == dtype
+        ][:MAX_GROUP]
+        if want is not None and want in self._queue and want not in group:
+            # More than MAX_GROUP older same-shape entries: make sure
+            # the caller's own ticket rides this transfer.
+            group[-1] = want
+        taken = set(map(id, group))
+        self._queue = [t for t in self._queue if id(t) not in taken]
+        return group
+
+    def _fetch(self, ticket: Ticket) -> None:
+        while ticket.host is None and ticket.error is None:
+            with self._lock:
+                if ticket.host is not None or ticket.error is not None:
+                    return
+                in_queue = ticket in self._queue
+                group = self._take_group_locked(ticket) if in_queue else None
+            if group is None:
+                # Another leader holds this ticket in its group: its
+                # materialize ALWAYS sets host or error, then the
+                # event.  Wait outside the lock.
+                ticket.event.wait()
+                continue
+            self._materialize(group)
+            # Our group may not have included `ticket` only if shapes
+            # raced; loop re-checks.
+
+    def _drain_oldest(self) -> None:
+        with self._lock:
+            group = self._take_group_locked(None)
+        if group:
+            self._materialize(group)
+
+    def _materialize(self, group: List[Ticket]) -> None:
+        try:
+            self._materialize_inner(group)
+        except BaseException as e:  # noqa: BLE001
+            for t in group:
+                if t.host is None:
+                    t.error = e
+            raise
+        finally:
+            for t in group:
+                t.event.set()
+
+    def _materialize_inner(self, group: List[Ticket]) -> None:
+        k = len(group)
+        self.transfers += 1
+        if k == 1:
+            group[0].host = np.asarray(group[0].handle)
+            group[0].handle = None
+            return
+        # Round the stack fan-in up to a power of two by repeating the
+        # last handle — bounded program universe (see module doc).
+        size = 2
+        while size < k:
+            size *= 2
+        handles = [t.handle for t in group]
+        handles += [handles[-1]] * (size - k)
+        prog = self._stack_program(
+            size, handles[0].shape, handles[0].dtype
+        )
+        stacked = prog(*handles)
+        host = np.asarray(stacked)  # ONE transfer for the whole group
+        self.stacked += k
+        for i, t in enumerate(group):
+            t.host = host[i]
+            t.handle = None
+
+    # -- warmup --------------------------------------------------------
+
+    def warmup_stacks(self, shape, dtype) -> None:
+        """Precompile the stack programs for one output shape (called
+        from engine warmup per ladder width so serving never pays an
+        XLA compile)."""
+        z = jnp.zeros(shape, dtype=dtype)
+        size = 2
+        while size <= MAX_GROUP:
+            np.asarray(self._stack_program(size, shape, dtype)(
+                *([z] * size)
+            ))
+            size *= 2

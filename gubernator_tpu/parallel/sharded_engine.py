@@ -101,6 +101,11 @@ class ShardedDecisionEngine:
         from gubernator_tpu.utils.metrics import DurationStat
 
         self.round_duration = DurationStat()
+        # Shared d2h transfer batching across concurrent callers
+        # (core/readback.py — the mesh outputs combine the same way).
+        from gubernator_tpu.core.readback import ReadbackCombiner
+
+        self.readback = ReadbackCombiner()
 
         state_spec = jax.tree.map(lambda _: keys_sharding(self.mesh), make_state(0))
         # Allocate the sharded state: [n_shards, shard_capacity] blocks.
@@ -521,7 +526,7 @@ class ShardedDecisionEngine:
             self._state = self._step_scatter(self._state, slot_dev, vals)
         self.round_duration.observe(_time.monotonic() - t0)
 
-        arr = np.asarray(pout)
+        arr = self.readback.register(pout).fetch()
         for sh in range(n_sh):
             mm = len(members[sh])
             if mm == 0:
@@ -687,6 +692,15 @@ class ShardedDecisionEngine:
                     occupied=self._clear_step(self._state.occupied, dummy)
                 )
                 csize *= 2
+            # Readback-combiner stack ladder (see DecisionEngine.warmup).
+            from gubernator_tpu.ops.bucket_kernel import PACKED_OUT_ROWS
+
+            width = 64
+            while width <= max_width:
+                self.readback.warmup_stacks(
+                    (self.n_shards, PACKED_OUT_ROWS, width), jnp.int32
+                )
+                width *= 2
             self.sweep(now_ms=now + 2)
             (
                 self.requests_total,
@@ -979,7 +993,7 @@ class ShardedDecisionEngine:
             return False
         over = 0
         for pout, dst_rows, chunk_m, _width in pieces:
-            arr = np.asarray(pout)
+            arr = pout.fetch()
             for sh in range(n_sh):
                 mm = chunk_m[sh]
                 if mm == 0:
@@ -1120,9 +1134,10 @@ class ShardedDecisionEngine:
                 )
                 self._state = self._step_scatter(self._state, slot_dev, vals2)
             self.round_duration.observe(_time.monotonic() - t0)
-            pout.copy_to_host_async()
             self.rounds_total += 1
-            pieces.append((pout, dst_rows, chunk_m, width))
+            pieces.append(
+                (self.readback.register(pout), dst_rows, chunk_m, width)
+            )
         return pieces
 
     def _dispatch_sorted_chunk(
@@ -1186,8 +1201,10 @@ class ShardedDecisionEngine:
             slot_dev, vals, pout = self._packed_compute(self._state, pin)
             self._state = self._step_scatter(self._state, slot_dev, vals)
         self.round_duration.observe(_time.monotonic() - t0)
-        pout.copy_to_host_async()
-        return (pout, dst_rows, [len(m) for m in members], width)
+        return (
+            self.readback.register(pout), dst_rows,
+            [len(m) for m in members], width,
+        )
 
     # ------------------------------------------------------------------
     # Bulk persistence (Loader; reference: store.go:69-78).  Load/save

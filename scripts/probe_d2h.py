@@ -1,0 +1,144 @@
+"""d2h readback strategy probe: rows/dtype scaling + device-side
+stacking of K step outputs into ONE transfer (the readback combiner
+design candidate).  Prints one JSON."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("GUBERNATOR_TPU_X64", "1")
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+res: dict = {}
+
+
+def report(k, v):
+    res[k] = round(v, 4) if isinstance(v, float) else v
+    print(f"{k}: {res[k]}", file=sys.stderr, flush=True)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def gen(seed, rows, b):
+    return (
+        jnp.arange(rows * b, dtype=jnp.int32).reshape(rows, b) * seed
+    )
+
+
+def main():
+    dev = jax.devices()[0]
+    report("platform", dev.platform)
+    B = 8192
+
+    # Warm the d2h path overall (first transfer pays extra).
+    np.asarray(gen(jnp.int32(7), 5, B))
+
+    # --- d2h vs rows at fixed B ---
+    for rows in (1, 2, 5, 10, 40):
+        arrs = [gen(jnp.int32(i + 1), rows, B) for i in range(6)]
+        jax.block_until_ready(arrs)
+        np.asarray(arrs[0])  # per-shape warmup
+        t0 = time.perf_counter()
+        for i in range(12):
+            np.asarray(arrs[i % 6])
+        report(f"d2h_rows{rows}_ms", (time.perf_counter() - t0) / 12 * 1e3)
+
+    # --- K separate [5,B] transfers vs ONE stacked [K*5,B] ---
+    for K in (4, 8, 16):
+        arrs = [gen(jnp.int32(i + 1), 5, B) for i in range(K)]
+        jax.block_until_ready(arrs)
+        t0 = time.perf_counter()
+        for a in arrs:
+            np.asarray(a)
+        sep = (time.perf_counter() - t0) * 1e3
+        report(f"d2h_K{K}_separate_ms", sep)
+
+        stack_j = jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
+        st = stack_j(*arrs)
+        st.block_until_ready()
+        np.asarray(st)  # shape warmup
+        st2 = stack_j(*[gen(jnp.int32(i + 31), 5, B) for i in range(K)])
+        st2.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(st2)
+        one = (time.perf_counter() - t0) * 1e3
+        report(f"d2h_K{K}_stacked_ms", one)
+
+    # --- dtype check: float32 vs int32 vs int64 on [5,B] ---
+    for dt, name in ((jnp.float32, "f32"), (jnp.int64, "i64")):
+        arrs = [
+            (gen(jnp.int32(i + 3), 5, B)).astype(dt) for i in range(6)
+        ]
+        jax.block_until_ready(arrs)
+        np.asarray(arrs[0])
+        t0 = time.perf_counter()
+        for i in range(12):
+            np.asarray(arrs[i % 6])
+        report(f"d2h_5rows_{name}_ms", (time.perf_counter() - t0) / 12 * 1e3)
+
+    # --- does copy_to_host_async prefetch make np.asarray cheap? ---
+    arrs = [gen(jnp.int32(i + 11), 5, B) for i in range(8)]
+    jax.block_until_ready(arrs)
+    for a in arrs:
+        a.copy_to_host_async()
+    time.sleep(1.0)  # let the background transfers finish (if real)
+    t0 = time.perf_counter()
+    for a in arrs:
+        np.asarray(a)
+    report("d2h_after_async_prefetch_each_ms",
+           (time.perf_counter() - t0) / 8 * 1e3)
+
+    # --- full pipeline with stacked flush every K=8 steps ---
+    cap = 1 << 21
+
+    def step(stmat, pin):
+        slot = pin[0]
+        rows = stmat.at[slot].get(mode="fill", fill_value=0,
+                                  indices_are_sorted=True,
+                                  unique_indices=True)
+        upd = rows + pin[3][:, None]
+        newm = stmat.at[slot].set(upd, mode="drop",
+                                  indices_are_sorted=True,
+                                  unique_indices=True)
+        return newm, jnp.stack([upd[:, i] for i in range(5)])
+
+    step_j = jax.jit(step, donate_argnums=(0,))
+    rng = np.random.default_rng(0)
+    stmat = jax.device_put(jnp.zeros((cap, 20), jnp.int32), dev)
+    ins = []
+    for i in range(8):
+        a = np.zeros((15, B), np.int32)
+        a[0] = np.sort(rng.choice(cap, B, replace=False)).astype(np.int32)
+        a[3] = 1
+        ins.append(a)
+    K = 8
+    stack_j = jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
+    stmat, out = step_j(stmat, jnp.asarray(ins[0]))
+    np.asarray(stack_j(*[out] * K))  # warm both programs
+    NIT = 64
+    t0 = time.perf_counter()
+    pend = []
+    for i in range(NIT):
+        stmat, out = step_j(stmat, jnp.asarray(ins[i % 8]))
+        pend.append(out)
+        if len(pend) == K:
+            st = stack_j(*pend)
+            st.copy_to_host_async()
+            pend = [st]  # keep handle; flush next round reads it
+            np.asarray(st)
+            pend = []
+    dt = (time.perf_counter() - t0) / NIT
+    report("step_stackedK8_ms", dt * 1e3)
+    report("step_stackedK8_decs_per_s", B / dt)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

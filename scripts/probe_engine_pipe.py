@@ -1,0 +1,99 @@
+"""Phase-level timing of the pipelined columnar loop on the live
+backend: dispatch wall time per batch vs stacked-fetch wall time per
+group, to find where the per-batch 27ms goes."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("GUBERNATOR_TPU_X64", "1")
+import numpy as np
+
+from gubernator_tpu.core.engine import DecisionEngine
+
+B = 8192
+engine = DecisionEngine(capacity=131072, max_kernel_width=8192)
+
+batches = []
+for b in range(8):
+    idx = (np.arange(B, dtype=np.int64) + b * B) % 100000
+    batches.append(dict(
+        keys=[b"bench_k%d" % i for i in idx.tolist()],
+        algo=(idx % 2).astype(np.int32),
+        behavior=np.zeros(B, dtype=np.int32),
+        hits=np.ones(B, dtype=np.int64),
+        limit=np.full(B, 1_000_000, dtype=np.int64),
+        duration=np.full(B, 3_600_000, dtype=np.int64),
+        burst=np.full(B, 1_000_000, dtype=np.int64),
+    ))
+
+for i in range(3):
+    engine.apply_columnar(**batches[i % 8])
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.bucket_kernel import PACKED_OUT_ROWS
+
+engine.readback.warmup_stacks((PACKED_OUT_ROWS, B), jnp.int32)
+if engine._pump is not None:
+    engine._pump.warmup(B)
+
+disp = []
+fetch = []
+from collections import deque
+
+pending = deque()
+t_start = time.perf_counter()
+N = 64
+for i in range(N):
+    t0 = time.perf_counter()
+    p = engine.apply_columnar(**batches[i % 8], want_async=True)
+    disp.append(time.perf_counter() - t0)
+    pending.append(p)
+    if len(pending) > 16:
+        t0 = time.perf_counter()
+        pending.popleft().get()
+        fetch.append(time.perf_counter() - t0)
+while pending:
+    t0 = time.perf_counter()
+    pending.popleft().get()
+    fetch.append(time.perf_counter() - t0)
+total = time.perf_counter() - t_start
+
+disp = np.asarray(disp) * 1e3
+fetch = np.asarray(fetch) * 1e3
+print("dispatch ms: mean=%.2f p50=%.2f max=%.2f sum=%.1f"
+      % (disp.mean(), np.percentile(disp, 50), disp.max(), disp.sum()))
+print("fetch ms: mean=%.2f p50=%.2f max=%.2f sum=%.1f"
+      % (fetch.mean(), np.percentile(fetch, 50), fetch.max(), fetch.sum()))
+print("total %.1f ms for %d batches -> %.2f ms/batch, %.0f dec/s"
+      % (total * 1e3, N, total * 1e3 / N, N * B / total))
+print("combiner: registered=%d transfers=%d stacked=%d"
+      % (engine.readback.registered, engine.readback.transfers,
+         engine.readback.stacked))
+
+# --- phase split: execution wait vs stacked transfer ---
+import jax
+
+pending = deque()
+waits = []
+reads = []
+for rep in range(4):
+    t0 = time.perf_counter()
+    ps = [engine.apply_columnar(**batches[i % 8], want_async=True)
+          for i in range(16)]
+    t_disp = time.perf_counter() - t0
+    with engine._lock:
+        engine._flush_pump()
+    tk = ps[-1]._pieces[0][0]
+    last = tk.group.handle if hasattr(tk, 'group') else tk.handle
+    t0 = time.perf_counter()
+    jax.block_until_ready(last)
+    waits.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for p in ps:
+        p.get()
+    reads.append(time.perf_counter() - t0)
+    print("rep%d: disp16=%.1fms exec_wait=%.1fms stacked_read=%.1fms"
+          % (rep, t_disp * 1e3, waits[-1] * 1e3, reads[-1] * 1e3))

@@ -1,0 +1,136 @@
+"""Pure device-compute time of the fused bucket step on the live
+backend, split by algorithm mix — checks whether int64/f64 emulation
+dominates (TPU has no native 64-bit)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("GUBERNATOR_TPU_X64", "1")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.ops.bucket_kernel import (
+    PACKED_IN_ROWS,
+    fused_step,
+    make_state,
+    multi_fused_step,
+    pack_batch_host,
+)
+
+CAP = 131072
+B = 8192
+
+
+def mkbuf(algo_val, seed):
+    rng = np.random.default_rng(seed)
+    slots = np.sort(rng.choice(CAP, B, replace=False)).astype(np.int32)
+    n = B
+    return pack_batch_host(
+        B, 1_000_000 + seed, CAP, slots,
+        np.full(n, algo_val, dtype=np.int64),
+        np.zeros(n, dtype=np.int64),
+        np.ones(n, dtype=np.int64),
+        np.full(n, 1_000_000, dtype=np.int64),
+        np.full(n, 3_600_000, dtype=np.int64),
+        np.full(n, 1_000_000, dtype=np.int64),
+        np.zeros(n, dtype=np.int64),
+        np.zeros(n, dtype=np.int64),
+    )
+
+
+def chain(name, algo_val):
+    state = make_state(CAP)
+    bufs = [jnp.asarray(mkbuf(algo_val, s)) for s in range(8)]
+    jax.block_until_ready(bufs)
+    state, out = fused_step(state, bufs[0])
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(20):
+        state, out = fused_step(state, bufs[i % 8])
+        outs.append(out)
+    jax.block_until_ready(state)
+    dt = (time.perf_counter() - t0) / 20 * 1e3
+    print(f"{name}: fused_step {dt:.2f} ms/step", flush=True)
+    del outs, state
+
+
+chain("token", 0)
+chain("leaky", 1)
+
+# multi (scan) at R=16, token-only
+state = make_state(CAP)
+pins = jnp.asarray(np.stack([mkbuf(0, 100 + s) for s in range(16)]))
+jax.block_until_ready(pins)
+state, outs = multi_fused_step(state, pins)
+jax.block_until_ready(outs)
+t0 = time.perf_counter()
+for rep in range(4):
+    state, outs = multi_fused_step(state, pins)
+jax.block_until_ready(state)
+print("multi R16 token: %.2f ms/flush (%.2f ms/round)"
+      % ((time.perf_counter() - t0) / 4 * 1e3,
+         (time.perf_counter() - t0) / 4 / 16 * 1e3), flush=True)
+
+# --- honest scan timing: DISTINCT pins per rep (defeat memoization) ---
+state = make_state(CAP)
+pin_sets = [
+    jnp.asarray(np.stack([mkbuf(0, 1000 * r + s) for s in range(16)]))
+    for r in range(4)
+]
+jax.block_until_ready(pin_sets)
+state, outs = multi_fused_step(state, pin_sets[0])
+jax.block_until_ready(outs)
+t0 = time.perf_counter()
+for rep in range(4):
+    state, outs = multi_fused_step(state, pin_sets[rep])
+jax.block_until_ready(state)
+dt = (time.perf_counter() - t0) / 4
+print("multi R16 distinct pins: %.2f ms/flush (%.2f ms/round) [h2d prepaid]"
+      % (dt * 1e3, dt / 16 * 1e3), flush=True)
+
+# --- same but WITH h2d per flush (engine-realistic) ---
+host_sets = [np.stack([mkbuf(0, 5000 * r + s) for s in range(16)])
+             for r in range(4)]
+t0 = time.perf_counter()
+for rep in range(4):
+    state, outs = multi_fused_step(state, jnp.asarray(host_sets[rep]))
+jax.block_until_ready(state)
+dt = (time.perf_counter() - t0) / 4
+print("multi R16 +h2d: %.2f ms/flush (%.2f ms/round)"
+      % (dt * 1e3, dt / 16 * 1e3), flush=True)
+
+# --- gather+scatter only over the real state arrays (no bucket math) ---
+from gubernator_tpu.ops.bucket_kernel import BucketState
+
+def gs_only(state, pins):
+    def body(st, pin):
+        slot = pin[1]
+        leaves = list(st)
+        outs = []
+        for a in leaves[:5]:
+            g = a.at[slot].get(mode="fill", fill_value=0,
+                               indices_are_sorted=True, unique_indices=True)
+            outs.append(g)
+        new = [a.at[slot].set(
+                   (o + 1).astype(a.dtype), mode="drop",
+                   indices_are_sorted=True, unique_indices=True)
+               for a, o in zip(leaves[:5], outs)] + leaves[5:]
+        return type(st)(*new), jnp.stack(outs[:5])
+    return jax.lax.scan(body, state, pins)
+
+gs_j = jax.jit(gs_only, donate_argnums=(0,))
+state2 = make_state(CAP)
+state2, outs = gs_j(state2, pin_sets[0])
+jax.block_until_ready(outs)
+t0 = time.perf_counter()
+for rep in range(4):
+    state2, outs = gs_j(state2, pin_sets[rep])
+jax.block_until_ready(state2)
+dt = (time.perf_counter() - t0) / 4
+print("scan gather/scatter-only (5 arrays): %.2f ms/flush (%.2f ms/round)"
+      % (dt * 1e3, dt / 16 * 1e3), flush=True)

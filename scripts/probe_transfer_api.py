@@ -1,0 +1,79 @@
+"""Does the transfer API choice change tunnel bandwidth?
+h2d: jnp.asarray vs jax.device_put (same 8MB payload).
+d2h: cold np.asarray vs copy_to_host_async-then-wait."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("GUBERNATOR_TPU_X64", "1")
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+dev = jax.devices()[0]
+print("platform:", dev.platform, flush=True)
+rng = np.random.default_rng(0)
+
+MB8 = [rng.integers(0, 1 << 30, (16, 16, 8192)).astype(np.int32)
+       for _ in range(6)]
+
+# warm
+jnp.asarray(MB8[0]).block_until_ready()
+jax.device_put(MB8[0], dev).block_until_ready()
+
+t0 = time.perf_counter()
+for i in range(6):
+    jnp.asarray(MB8[i]).block_until_ready()
+print("h2d jnp.asarray 8MB: %.1f ms" % ((time.perf_counter() - t0) / 6 * 1e3),
+      flush=True)
+
+t0 = time.perf_counter()
+for i in range(6):
+    jax.device_put(MB8[i], dev).block_until_ready()
+print("h2d device_put 8MB: %.1f ms" % ((time.perf_counter() - t0) / 6 * 1e3),
+      flush=True)
+
+# does device_put REALLY move the bytes? consume on device and check
+x = jax.device_put(MB8[0], dev)
+s = jnp.sum(x.astype(jnp.int64))
+t0 = time.perf_counter()
+s.block_until_ready()
+print("consume after device_put: %.1f ms (sum=%d)" %
+      ((time.perf_counter() - t0) * 1e3, int(s)), flush=True)
+
+y = [jax.device_put(MB8[i], dev) for i in range(6)]
+t0 = time.perf_counter()
+ss = [jnp.sum(v.astype(jnp.int64)) for v in y]
+jax.block_until_ready(ss)
+print("consume 6x device_put: %.1f ms each" %
+      ((time.perf_counter() - t0) / 6 * 1e3), flush=True)
+
+# d2h comparison on 2.6MB [16,5,8192]
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(1,))
+def gen(seed, n):
+    return (jnp.arange(n, dtype=jnp.int32) * seed).reshape(16, 5, 8192)
+
+
+arrs = [gen(jnp.int32(i + 1), 16 * 5 * 8192) for i in range(8)]
+jax.block_until_ready(arrs)
+np.asarray(arrs[0])
+t0 = time.perf_counter()
+for i in range(1, 4):
+    np.asarray(arrs[i])
+print("d2h cold np.asarray 2.6MB: %.1f ms" %
+      ((time.perf_counter() - t0) / 3 * 1e3), flush=True)
+
+for i in range(4, 8):
+    arrs[i].copy_to_host_async()
+t0 = time.perf_counter()
+for i in range(4, 8):
+    np.asarray(arrs[i])
+print("d2h after async prefetch (no wait): %.1f ms" %
+      ((time.perf_counter() - t0) / 4 * 1e3), flush=True)

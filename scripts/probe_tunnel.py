@@ -1,0 +1,187 @@
+"""Tunnel transport characterization for the axon TPU backend.
+
+Splits the packed step's per-step cost into: h2d fixed+bandwidth,
+d2h fixed+bandwidth, pure dispatch (no transfers), and checks whether
+h2d/d2h/compute overlap across pipelined steps.  Inputs VARY per call
+(the axon terminal memoizes identical executions).  Prints one JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("GUBERNATOR_TPU_X64", "1")
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+res: dict = {}
+
+
+def report(k, v):
+    res[k] = round(v, 4) if isinstance(v, float) else v
+    print(f"{k}: {res[k]}", file=sys.stderr, flush=True)
+
+
+def timed(fn, iters):
+    t0 = time.perf_counter()
+    for i in range(iters):
+        fn(i)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    dev = jax.devices()[0]
+    report("platform", dev.platform)
+    rng = np.random.default_rng(0)
+
+    # --- h2d: varying payloads, blocking ---
+    for kb in (16, 64, 512, 2048):
+        n = kb * 256  # int32 words
+        bufs = [rng.integers(0, 1000, n).astype(np.int32) for _ in range(8)]
+        jax.device_put(bufs[0], dev).block_until_ready()
+        ms = timed(lambda i: jax.device_put(bufs[i % 8], dev).block_until_ready(), 16)
+        report(f"h2d_{kb}KB_ms", ms)
+
+    # --- d2h: varying on-device payloads ---
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def gen(seed, n):
+        return (jnp.arange(n, dtype=jnp.int32) * seed)
+
+    for kb in (16, 64, 512, 2048):
+        n = kb * 256
+        arrs = [gen(jnp.int32(i + 1), n) for i in range(8)]
+        jax.block_until_ready(arrs)
+        ms = timed(lambda i: np.asarray(arrs[i % 8]), 16)
+        report(f"d2h_{kb}KB_ms", ms)
+
+    # --- pure dispatch: donated state chain, zero host transfer ---
+    cap = 1 << 21
+
+    def rmw(state, i):
+        idx = (jnp.arange(8192, dtype=jnp.int32) * (i + 1)) % cap
+        return state.at[idx].add(1, mode="drop")
+
+    rmw_j = jax.jit(rmw, donate_argnums=(0,))
+    st = jax.device_put(jnp.zeros((cap,), jnp.int32), dev)
+    st = rmw_j(st, jnp.int32(1)).block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(100):
+        st = rmw_j(st, jnp.int32(i))
+    st.block_until_ready()
+    report("pure_dispatch_chain_ms", (time.perf_counter() - t0) / 100 * 1e3)
+
+    # --- full step anatomy at B=8192, rows like the engine (15 in/5 out) ---
+    B = 8192
+
+    def step(stmat, pin):
+        slot = pin[0] % cap
+        rows = stmat.at[slot].get(mode="fill", fill_value=0)
+        upd = rows + pin[3][:, None]
+        newm = stmat.at[slot].set(upd, mode="drop")
+        return newm, jnp.stack([upd[:, i] for i in range(5)])
+
+    step_j = jax.jit(step, donate_argnums=(0,))
+    stmat = jax.device_put(jnp.zeros((cap, 20), jnp.int32), dev)
+    ins = [rng.integers(0, cap, (15, B)).astype(np.int32) for _ in range(8)]
+    stmat, out = step_j(stmat, jnp.asarray(ins[0]))
+    np.asarray(out)
+
+    # (a) blocking every step (no pipeline)
+    t0 = time.perf_counter()
+    for i in range(20):
+        stmat, out = step_j(stmat, jnp.asarray(ins[i % 8]))
+        np.asarray(out)
+    report("step_blocking_ms", (time.perf_counter() - t0) / 20 * 1e3)
+
+    # (b) pipeline depths 2/4/8
+    for depth in (2, 4, 8):
+        pend = []
+        t0 = time.perf_counter()
+        NIT = 40
+        for i in range(NIT):
+            stmat, out = step_j(stmat, jnp.asarray(ins[i % 8]))
+            out.copy_to_host_async()
+            pend.append(out)
+            if len(pend) > depth:
+                np.asarray(pend.pop(0))
+        for p in pend:
+            np.asarray(p)
+        report(f"step_pipe{depth}_ms", (time.perf_counter() - t0) / NIT * 1e3)
+
+    # (c) h2d only (no readback): does input transfer dominate?
+    t0 = time.perf_counter()
+    for i in range(20):
+        stmat, out = step_j(stmat, jnp.asarray(ins[i % 8]))
+    jax.block_until_ready(stmat)
+    report("step_no_readback_ms", (time.perf_counter() - t0) / 20 * 1e3)
+
+    # (d) narrow payload: 6 rows in, 3 rows out
+    def step6(stmat, pin):
+        slot = pin[0] % cap
+        rows = stmat.at[slot].get(mode="fill", fill_value=0)
+        upd = rows + pin[3][:, None]
+        newm = stmat.at[slot].set(upd, mode="drop")
+        return newm, jnp.stack([upd[:, 0], upd[:, 1], upd[:, 2]])
+
+    step6_j = jax.jit(step6, donate_argnums=(0,))
+    ins6 = [rng.integers(0, cap, (6, B)).astype(np.int32) for _ in range(8)]
+    stmat2 = jax.device_put(jnp.zeros((cap, 20), jnp.int32), dev)
+    stmat2, out = step6_j(stmat2, jnp.asarray(ins6[0]))
+    np.asarray(out)
+    pend = []
+    t0 = time.perf_counter()
+    NIT = 40
+    for i in range(NIT):
+        stmat2, out = step6_j(stmat2, jnp.asarray(ins6[i % 8]))
+        out.copy_to_host_async()
+        pend.append(out)
+        if len(pend) > 4:
+            np.asarray(pend.pop(0))
+    for p in pend:
+        np.asarray(p)
+    report("step_narrow_pipe4_ms", (time.perf_counter() - t0) / NIT * 1e3)
+
+    # (e) pre-staged input: device_put committed ahead from a second
+    # thread, then consumed — measures whether h2d can overlap h2d.
+    import threading
+    from queue import Queue
+
+    q: Queue = Queue(maxsize=4)
+
+    def feeder():
+        for i in range(40):
+            q.put(jax.device_put(ins[i % 8], dev))
+        q.put(None)
+
+    th = threading.Thread(target=feeder)
+    pend = []
+    t0 = time.perf_counter()
+    th.start()
+    NIT = 0
+    while True:
+        pin = q.get()
+        if pin is None:
+            break
+        stmat, out = step_j(stmat, pin)
+        out.copy_to_host_async()
+        pend.append(out)
+        NIT += 1
+        if len(pend) > 4:
+            np.asarray(pend.pop(0))
+    for p in pend:
+        np.asarray(p)
+    th.join()
+    report("step_threaded_feed_ms", (time.perf_counter() - t0) / NIT * 1e3)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

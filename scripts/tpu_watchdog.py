@@ -189,11 +189,14 @@ def run_bench(name: str) -> str | None:
 
 def main() -> None:
     done: set[str] = set()
-    # Artifacts already on TPU (e.g. watchdog restarted) count as done.
-    for name in BENCH_ORDER:
-        if artifact_platform(name) in ("tpu", "axon"):
-            done.add(name)
-    profile_done = os.path.exists(
+    force = os.environ.get("WATCHDOG_FORCE", "") == "1"
+    # Artifacts already on TPU (e.g. watchdog restarted) count as done
+    # — unless forced (recapture after a serving-path improvement).
+    if not force:
+        for name in BENCH_ORDER:
+            if artifact_platform(name) in ("tpu", "axon"):
+                done.add(name)
+    profile_done = not force and os.path.exists(
         os.path.join(ROOT, f"PROFILE_{ROUND}_tpu.json"))
     probes = 0
     while True:

@@ -12,6 +12,13 @@ from gubernator_tpu.platform_guard import force_cpu_platform
 
 force_cpu_platform(8)
 
+# The step pump auto-disables on the CPU backend (no per-RPC overhead
+# to amortize); tests force it ON so the pump/uniform machinery is
+# exercised exactly as it runs on TPU.
+import os
+
+os.environ.setdefault("GUBER_PUMP", "1")
+
 import pytest
 
 from gubernator_tpu.clock import Clock

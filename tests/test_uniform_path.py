@@ -1,0 +1,136 @@
+"""Uniform narrow-format path (bucket_kernel UNIFORM_IN_ROWS) vs the
+general packed path: bit-equal decisions on identical traffic.
+
+The uniform format ships 4B/decision uphill and 8B down (vs 64/20) on
+the transfer-bound backend; its gate (engine._uniform_params) and the
+scalar-broadcast kernel must preserve exact semantics — fuzzed here
+across algorithms, behaviors (incl. RESET_REMAINING), negative hits,
+duplicate keys (rounds), state evolution, and the int32-range gate
+boundaries."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.core.engine import DecisionEngine
+
+
+def _apply(engine, keys, now, **cfg):
+    n = len(keys)
+    cols = dict(
+        algo=np.full(n, cfg.get("algo", 0), dtype=np.int32),
+        behavior=np.full(n, cfg.get("behavior", 0), dtype=np.int32),
+        hits=np.full(n, cfg.get("hits", 1), dtype=np.int64),
+        limit=np.full(n, cfg.get("limit", 100), dtype=np.int64),
+        duration=np.full(n, cfg.get("duration", 60_000), dtype=np.int64),
+        burst=np.full(n, cfg.get("burst", 0), dtype=np.int64),
+    )
+    return engine.apply_columnar(list(keys), now_ms=now, **cols)
+
+
+@pytest.fixture
+def engines():
+    e_uni = DecisionEngine(capacity=4096)
+    e_gen = DecisionEngine(capacity=4096)
+    e_gen._pump = None  # force the general packed path
+    if e_uni._pump is None:
+        pytest.skip("pump unavailable (split-pair platform)")
+    return e_uni, e_gen
+
+
+def _check_equal(r1, r2, ctx):
+    for a, b, name in zip(r1, r2, ("status", "limit", "remaining", "reset")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"{name} @ {ctx}"
+        )
+
+
+def test_uniform_fuzz_vs_general(engines):
+    e_uni, e_gen = engines
+    rng = np.random.default_rng(42)
+    for step in range(25):
+        b = int(rng.integers(2, 300))
+        keys = [b"f%d" % i for i in rng.integers(0, 80, b)]
+        cfg = dict(
+            algo=int(rng.integers(0, 2)),
+            behavior=[0, 0, 8, 0][step % 4],  # RESET_REMAINING mixed in
+            hits=int(rng.integers(-2, 6)),
+            limit=int(rng.integers(0, 60)),
+            duration=int(rng.integers(1, 90_000)),
+            burst=int(rng.integers(0, 70)),
+        )
+        now = 5_000_000 + step * int(rng.integers(0, 40_000))
+        r1 = _apply(e_uni, keys, now, **cfg)
+        r2 = _apply(e_gen, keys, now, **cfg)
+        _check_equal(r1, r2, f"step={step} cfg={cfg}")
+
+
+def test_uniform_gate_boundaries(engines):
+    """Values at/over the int32 gate fall back to the general format
+    and still agree with the forced-general engine."""
+    e_uni, e_gen = engines
+    shapes = []
+    orig = e_uni._pump.submit
+    e_uni._pump.submit = lambda buf: (shapes.append(buf.shape), orig(buf))[1]
+    cases = [
+        dict(limit=2**31 - 1),            # at the edge: general path
+        dict(limit=2**31 + 5),            # over: general path
+        dict(duration=2**31 + 1),         # over: general path
+        dict(hits=2**31),                 # over: general path
+        dict(limit=2**31 - 2, burst=2**30),  # within: uniform ok
+    ]
+    for i, cfg in enumerate(cases):
+        keys = [b"g%d_%d" % (i, j) for j in range(10)]
+        r1 = _apply(e_uni, keys, 7_000_000, **cfg)
+        r2 = _apply(e_gen, keys, 7_000_000, **cfg)
+        _check_equal(r1, r2, f"case={cfg}")
+    from gubernator_tpu.ops.bucket_kernel import UNIFORM_IN_ROWS
+
+    uniform_used = [s for s in shapes if s[0] == UNIFORM_IN_ROWS]
+    general_used = [s for s in shapes if s[0] != UNIFORM_IN_ROWS]
+    assert general_used, "out-of-range configs must use the general path"
+    assert uniform_used, "in-range config must use the uniform path"
+
+
+def test_uniform_pipelined_cross_batch_state(engines):
+    """Queued uniform batches across async calls apply sequentially
+    (scan order) — shared-key accounting must be exact."""
+    e_uni, e_gen = engines
+    ps1, ps2 = [], []
+    for r in range(10):
+        ps1.append(
+            e_uni.apply_columnar(
+                [b"shared"], np.zeros(1, np.int32), np.zeros(1, np.int32),
+                np.ones(1, np.int64), np.full(1, 1000, np.int64),
+                np.full(1, 60_000, np.int64), np.zeros(1, np.int64),
+                now_ms=9_000_000, want_async=True,
+            )
+        )
+        ps2.append(
+            e_gen.apply_columnar(
+                [b"shared"], np.zeros(1, np.int32), np.zeros(1, np.int32),
+                np.ones(1, np.int64), np.full(1, 1000, np.int64),
+                np.full(1, 60_000, np.int64), np.zeros(1, np.int64),
+                now_ms=9_000_000, want_async=True,
+            )
+        )
+    rems1 = [int(p.get()[2][0]) for p in ps1]
+    rems2 = [int(p.get()[2][0]) for p in ps2]
+    assert rems1 == rems2 == list(range(999, 989, -1))
+
+
+def test_reset_remaining_reset_time_zero_not_wrapped(engines):
+    """RESET_REMAINING responds reset_time=0 (reference semantics); the
+    narrow (reset-now) delta cannot encode that, so the gate must route
+    such batches to the general format (code-review r4 repro: the
+    uniform path returned now+wrap instead of 0)."""
+    e_uni, e_gen = engines
+    keys = [b"rr%d" % i for i in range(8)]
+    # Seed existing buckets, then hit them again with RESET_REMAINING.
+    _apply(e_uni, keys, 1_700_000_000_000, hits=3, limit=10)
+    _apply(e_gen, keys, 1_700_000_000_000, hits=3, limit=10)
+    r1 = _apply(e_uni, keys, 1_700_000_000_500, hits=1, limit=10,
+                behavior=8)
+    r2 = _apply(e_gen, keys, 1_700_000_000_500, hits=1, limit=10,
+                behavior=8)
+    _check_equal(r1, r2, "reset-remaining")
+    assert (np.asarray(r1[3]) == 0).all(), "reset_time must be 0"
