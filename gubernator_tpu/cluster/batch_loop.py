@@ -34,12 +34,20 @@ class IntervalBatcher(Generic[K, V]):
         flush: Callable[[Dict[K, V]], None],
         *,
         name: str = "batcher",
+        chunked: bool = False,
     ):
         self.sync_wait = sync_wait
         self.batch_limit = batch_limit
         self._combine = combine
         self._flush = flush
+        # chunked=True: the flush callable accepts (dict, chunks) and
+        # add_chunk is available — the columnar wire path queues whole
+        # column slices in O(1) instead of per-item dict merges, and
+        # the flush thread does the per-key work off the serving path.
+        self._chunked = chunked
         self._items: Dict[K, V] = {}
+        self._chunks: list = []
+        self._chunk_count = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closing = False
@@ -56,7 +64,7 @@ class IntervalBatcher(Generic[K, V]):
     def pending(self) -> int:
         """Items currently queued for the next flush (metrics gauge)."""
         with self._lock:
-            return len(self._items)
+            return len(self._items) + self._chunk_count
 
     def add_many(self, pairs) -> None:
         """Batch enqueue under ONE lock acquisition — a 1000-item wire
@@ -70,23 +78,43 @@ class IntervalBatcher(Generic[K, V]):
                 items[key] = combine(items.get(key), item)
             self._cv.notify()
 
+    def add_chunk(self, chunk, count: int) -> None:
+        """Queue one columnar chunk (O(1): stores references only).
+        Requires chunked=True."""
+        assert self._chunked
+        with self._lock:
+            if self._closing:
+                return
+            self._chunks.append(chunk)
+            self._chunk_count += count
+            self._cv.notify()
+
     def _run(self) -> None:
         while True:
             with self._lock:
-                while not self._items and not self._closing:
+                while not self._items and not self._chunks and not self._closing:
                     self._cv.wait()
-                if self._closing and not self._items:
+                if self._closing and not self._items and not self._chunks:
                     return
                 deadline = time.monotonic() + self.sync_wait
-                while len(self._items) < self.batch_limit and not self._closing:
+                while (
+                    len(self._items) + self._chunk_count < self.batch_limit
+                    and not self._closing
+                ):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cv.wait(remaining)
                 batch = self._items
                 self._items = {}
+                chunks = self._chunks
+                self._chunks = []
+                self._chunk_count = 0
             try:
-                self._flush(batch)
+                if self._chunked:
+                    self._flush(batch, chunks)
+                else:
+                    self._flush(batch)
             except Exception:  # noqa: BLE001 — loop must survive flush errors
                 import logging
 

@@ -73,6 +73,7 @@ class GlobalManager:
             _combine_hits,
             self._send_hits,
             name="guber-global-hits",
+            chunked=True,
         )
         self._updates = IntervalBatcher(
             conf.global_sync_wait,
@@ -80,6 +81,7 @@ class GlobalManager:
             _combine_updates,
             self._broadcast_peers,
             name="guber-global-bcast",
+            chunked=True,
         )
 
     def queue_hit(self, r: RateLimitReq) -> None:
@@ -99,9 +101,61 @@ class GlobalManager:
         a lock per item contends with the flush thread)."""
         self._updates.add_many((r.hash_key(), r) for r in reqs)
 
+    # -- columnar enqueue (the wire fast path: O(1) per batch) ---------
+
+    def queue_hits_chunk(self, dec, idx) -> None:
+        """Queue (DecodedBatch, index array) — no per-item Python on
+        the serving thread; the flush aggregates vectorized."""
+        self._hits.add_chunk((dec, idx), len(idx))
+
+    def queue_updates_chunk(self, dec, idx) -> None:
+        self._updates.add_chunk((dec, idx), len(idx))
+
+    # -- chunk aggregation (flush threads, window-amortized) -----------
+
+    @staticmethod
+    def _aggregate_chunks(chunks, sum_hits: bool) -> Dict[str, RateLimitReq]:
+        """Per-key aggregation of queued (dec, idx) chunks: one linear
+        pass with a bytes-keyed dict — hits summed (hits loop) or
+        latest-wins (broadcast dedupe, reference: global.go:92-95,
+        176).  RateLimitReq objects are built once per UNIQUE key at
+        the end, never per item."""
+        if not chunks:
+            return {}
+        # key bytes → [hits_sum, dec, last_j] (dec/last_j = latest
+        # occurrence, whose config fields win).
+        agg: Dict[bytes, list] = {}
+        for dec, idx in chunks:
+            raw = dec.key_buf.tobytes()
+            off = dec.key_offsets
+            hits = dec.hits
+            for j in idx.tolist():
+                kb = raw[off[j]:off[j + 1]]
+                e = agg.get(kb)
+                if e is None:
+                    agg[kb] = [int(hits[j]), dec, j]
+                else:
+                    e[0] += int(hits[j])
+                    e[1] = dec
+                    e[2] = j
+        out: Dict[str, RateLimitReq] = {}
+        for kb, (hits_sum, dec, j) in agg.items():
+            nl = int(dec.name_len[j])
+            out[kb.decode()] = RateLimitReq(
+                name=kb[:nl].decode(),
+                unique_key=kb[nl + 1:].decode(),
+                hits=hits_sum if sum_hits else int(dec.hits[j]),
+                limit=int(dec.limit[j]),
+                duration=int(dec.duration[j]),
+                algorithm=int(dec.algo[j]),
+                behavior=int(dec.behavior[j]),
+                burst=int(dec.burst[j]),
+            )
+        return out
+
     # -- flush paths (run on batcher threads) --------------------------
 
-    def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+    def _send_hits(self, hits: Dict[str, RateLimitReq], chunks=None) -> None:
         """Group aggregated hits per owner and forward.
 
         reference: global.go:124-164 (sendHits).
@@ -110,6 +164,10 @@ class GlobalManager:
 
         from gubernator_tpu.utils.tracing import span
 
+        for k, r in self._aggregate_chunks(chunks or [], sum_hits=True).items():
+            hits[k] = _combine_hits(hits.get(k), r)
+        if not hits:
+            return
         t0 = time.monotonic()
         with span("global.hits_window", keys=len(hits)):
             self._send_hits_traced(hits)
@@ -118,14 +176,19 @@ class GlobalManager:
     def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
         by_peer: Dict[str, List[RateLimitReq]] = {}
         clients = {}
-        for key, r in hits.items():
-            try:
-                peer = self.instance.get_peer(key)
-            except Exception as e:  # noqa: BLE001
-                log.error("while getting peer for hash key '%s': %s", key, e)
+        keys = list(hits.keys())
+        try:
+            # ONE ring lookup pass for the window (a per-key get_peer
+            # burned ~27% of the cluster tier's core — PERF.md r4).
+            peers = self.instance.get_peer_batch(keys)
+        except Exception as e:  # noqa: BLE001
+            log.error("while getting peers for hit window: %s", e)
+            return
+        for key, peer in zip(keys, peers):
+            if peer is None:
                 continue
             addr = peer.info.grpc_address
-            by_peer.setdefault(addr, []).append(r)
+            by_peer.setdefault(addr, []).append(hits[key])
             clients[addr] = peer
         for addr, reqs in by_peer.items():
             peer = clients[addr]
@@ -140,7 +203,7 @@ class GlobalManager:
                     # distinct keys than one RPC may carry; chunk to
                     # the wire's hard batch limit (gubernator.go:41).
                     for lo in range(0, len(reqs), MAX_BATCH_SIZE):
-                        peer.get_peer_rate_limits(
+                        peer.send_peer_hits(
                             reqs[lo : lo + MAX_BATCH_SIZE],
                             timeout=self.conf.global_timeout,
                         )
@@ -149,7 +212,7 @@ class GlobalManager:
                 continue
         self.async_sends += 1
 
-    def _broadcast_peers(self, updates: Dict[str, RateLimitReq]) -> None:
+    def _broadcast_peers(self, updates: Dict[str, RateLimitReq], chunks=None) -> None:
         """Re-read own state and push it to every peer.
 
         reference: global.go:205-250 (broadcastPeers).
@@ -158,12 +221,41 @@ class GlobalManager:
 
         from gubernator_tpu.utils.tracing import span
 
+        updates.update(self._aggregate_chunks(chunks or [], sum_hits=False))
+        if not updates:
+            return
         t0 = time.monotonic()
         with span("global.broadcast", keys=len(updates)):
             self._broadcast_peers_traced(updates)
         self.broadcast_duration.observe(time.monotonic() - t0)
 
     def _broadcast_peers_traced(self, updates: Dict[str, RateLimitReq]) -> None:
+        payloads = self._reread_encoded(updates)
+        if payloads is not None:
+            # Native plane: one C-encoded UpdatePeerGlobalsReq per
+            # MAX_BATCH chunk, pushed raw to every peer (the broadcast
+            # fires every sync window — the pb path's per-item objects
+            # were ~25% of the cluster tier's core, PERF.md r4).
+            if not payloads:
+                return
+            for peer in self.instance.get_peer_list():
+                if peer.info.is_owner:  # exclude ourselves
+                    continue
+                try:
+                    for raw in payloads:
+                        peer.update_peer_globals_raw(
+                            raw, timeout=self.conf.global_timeout
+                        )
+                except PeerError as e:
+                    if not e.not_ready:
+                        log.error(
+                            "while broadcasting global updates to '%s': %s",
+                            peer.info.grpc_address,
+                            e,
+                        )
+                    continue
+            self.broadcasts += 1
+            return
         globals_ = self._reread_own_state(updates)
         if not globals_:
             return
@@ -187,6 +279,56 @@ class GlobalManager:
                     )
                 continue
         self.broadcasts += 1
+
+    def _reread_encoded(self, updates: Dict[str, RateLimitReq]):
+        """Columnar re-read + native encode: returns a list of
+        UpdatePeerGlobalsReq payload chunks, or None to use the pb
+        fallback (codec unavailable, store attached, Gregorian keys)."""
+        from gubernator_tpu.net import wire_codec
+
+        if wire_codec.load() is None:
+            return None
+        eng = self.instance.engine
+        if getattr(eng, "apply_columnar", None) is None or getattr(
+            eng, "store", None
+        ) is not None:
+            return None
+        import numpy as np
+
+        items = list(updates.values())
+        n = len(items)
+        if n == 0:
+            return []
+        keys_b = [r.hash_key().encode() for r in items]
+        algo = np.fromiter((int(r.algorithm) for r in items), np.int32, n)
+        behavior = np.fromiter(
+            (int(r.behavior) & ~int(Behavior.GLOBAL) for r in items),
+            np.int32, n,
+        )
+        limit = np.fromiter((r.limit for r in items), np.int64, n)
+        duration = np.fromiter((r.duration for r in items), np.int64, n)
+        burst = np.fromiter((r.burst for r in items), np.int64, n)
+        try:
+            st, lim, rem, rst = eng.apply_columnar(
+                keys_b, algo, behavior,
+                np.zeros(n, dtype=np.int64),  # hits=0: report-only
+                limit, duration, burst,
+            )
+        except Exception:  # noqa: BLE001 — e.g. invalid Gregorian
+            return None
+        key_buf = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
+        key_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(k) for k in keys_b], out=key_off[1:])
+        payloads = []
+        for lo in range(0, n, MAX_BATCH_SIZE):
+            hi = min(lo + MAX_BATCH_SIZE, n)
+            sub_off = (key_off[lo:hi + 1] - key_off[lo])
+            payloads.append(wire_codec.encode_globals(
+                key_buf[key_off[lo]:key_off[hi]], sub_off,
+                algo[lo:hi], st[lo:hi], lim[lo:hi], rem[lo:hi],
+                rst[lo:hi],
+            ))
+        return payloads
 
     def _reread_own_state(
         self, updates: Dict[str, RateLimitReq]

@@ -168,6 +168,44 @@ class ReplicatedConsistentHash(Generic[T]):
         return [self._member_list[i] for i in owners]
 
 
+class ConsistentHash(ReplicatedConsistentHash[T]):
+    """Non-replicated picker: ONE ring point per peer (the point is
+    hash(grpc_address)) — the reference's legacy 'consistent-hash'
+    GUBER_PEER_PICKER choice (config.go:395-417).  Cheaper rebuilds,
+    lumpier key distribution; replicated-hash remains the default."""
+
+    def __init__(self, hash_name: str = "fnv1"):
+        super().__init__(hash_name, replicas=1)
+
+    def new(self) -> "ConsistentHash[T]":
+        picker = ConsistentHash(self.hash_name)
+        picker._points = dict(self._points)
+        return picker
+
+    def _member_points(self, address: str) -> np.ndarray:
+        points = self._points.get(address)
+        if points is None:
+            points = np.asarray(
+                [self._hash(address.encode())], dtype=np.uint64
+            )
+            self._points[address] = points
+        return points
+
+
+def make_picker(
+    picker: str, hash_name: str, replicas: int = DEFAULT_REPLICAS
+):
+    """GUBER_PEER_PICKER → picker instance (reference config.go:395-417)."""
+    if picker in ("", "replicated-hash"):
+        return ReplicatedConsistentHash(hash_name, replicas)
+    if picker == "consistent-hash":
+        return ConsistentHash(hash_name)
+    raise ValueError(
+        f"GUBER_PEER_PICKER={picker!r} is invalid; choices are "
+        "['replicated-hash', 'consistent-hash']"
+    )
+
+
 class RegionPicker(Generic[T]):
     """One consistent-hash ring per datacenter.
 

@@ -83,6 +83,8 @@ class PeerClient:
         self._flush_stat = flush_stat
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
+        self._raw_get_peer = None
+        self._raw_update_globals = None
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
         self._queue_cv = threading.Condition(self._lock)
@@ -105,6 +107,20 @@ class PeerClient:
                     self.info.grpc_address, credentials=self._credentials
                 )
                 self._stub = PeersV1Stub(self._channel)
+                from gubernator_tpu.net.grpc_service import PEERS_SERVICE
+
+                # Raw variants: no per-item pb objects on the GLOBAL
+                # planes (see send_peer_hits / update_peer_globals_raw).
+                self._raw_get_peer = self._channel.unary_unary(
+                    f"/{PEERS_SERVICE}/GetPeerRateLimits",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                self._raw_update_globals = self._channel.unary_unary(
+                    f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
                 self._flusher = ThreadPoolExecutor(
                     max_workers=4,
                     thread_name_prefix=f"guber-flush-{self.info.grpc_address}",
@@ -192,6 +208,38 @@ class PeerClient:
             raise PeerError(err)
         return [serde.rate_limit_resp_from_pb(r) for r in resp.rate_limits]
 
+    def send_peer_hits(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> None:
+        """GLOBAL hit forwarding: same RPC as get_peer_rate_limits but
+        the responses are ignored by contract (reference global.go:
+        124-164 discards them), so skip the per-item response parse —
+        the owner's authoritative answer arrives via the broadcast."""
+        stub = self._connect()
+        msg = peers_pb.GetPeerRateLimitsReq(
+            requests=[serde.rate_limit_req_to_pb(r) for r in reqs]
+        )
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            raw = self._raw_get_peer
+            self._inflight += 1
+        try:
+            raw(
+                msg.SerializeToString(),
+                timeout=timeout or self.behaviors.global_timeout,
+            )
+        except grpc.RpcError as e:
+            err = f"GetPeerRateLimits(hits) to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
     def update_peer_globals(
         self, globals_: Sequence[UpdatePeerGlobal], timeout: Optional[float] = None
     ) -> None:
@@ -211,6 +259,31 @@ class PeerClient:
             stub.UpdatePeerGlobals(
                 msg, timeout=timeout or self.behaviors.global_timeout
             )
+        except grpc.RpcError as e:
+            err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
+    def update_peer_globals_raw(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> None:
+        """Push one pre-encoded UpdatePeerGlobalsReq (native broadcast
+        plane — the payload is C-encoded once per window and shared by
+        every peer push)."""
+        self._connect()
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            raw = self._raw_update_globals
+            self._inflight += 1
+        try:
+            raw(payload, timeout=timeout or self.behaviors.global_timeout)
         except grpc.RpcError as e:
             err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)

@@ -36,6 +36,10 @@ def main(argv=None) -> int:
 
     init_tracing()
     conf = setup_daemon_config(args.config or None)
+    if conf.debug and not args.debug:
+        # GUBER_DEBUG=true matches the -debug flag
+        # (reference: config.go:275 DebugEnabled).
+        configure_logging(debug=True)
     daemon = spawn_daemon(conf)
     log = logging.getLogger("gubernator_tpu")
     log.info(

@@ -59,6 +59,12 @@ class Config:
     # Consistent-hash function for the cluster ring ("fnv1" | "fnv1a").
     # reference: config.go:395-417
     hash_algorithm: str = "fnv1"
+    # Picker type: "replicated-hash" (default) | "consistent-hash"
+    # (GUBER_PEER_PICKER; reference: config.go:395-417).
+    peer_picker: str = "replicated-hash"
+    # Virtual ring points per peer for replicated-hash
+    # (GUBER_REPLICATED_HASH_REPLICAS; reference default 512).
+    picker_replicas: int = 512
     # This node's datacenter (MULTI_REGION routing).
     data_center: str = ""
     # Local peer identity; set by the daemon once listeners are bound.
@@ -182,9 +188,30 @@ class DaemonConfig:
     # DNS discovery.
     dns_fqdn: str = ""
     dns_poll_interval: float = 300.0
-    # etcd discovery.
+    # etcd discovery (auth/TLS block — reference: config.go:363-370,
+    # 440-496).
     etcd_endpoints: List[str] = field(default_factory=list)
     etcd_key_prefix: str = "/gubernator/peers/"
+    etcd_dial_timeout: float = 5.0
+    etcd_user: str = ""
+    etcd_password: str = ""
+    etcd_advertise_address: str = ""  # default: the node advertise addr
+    etcd_data_center: str = ""  # default: the node data center
+    etcd_tls_ca: str = ""
+    etcd_tls_cert: str = ""
+    etcd_tls_key: str = ""
+    etcd_tls_skip_verify: bool = False
+
+    # Picker selection (see Config.peer_picker / picker_replicas).
+    peer_picker: str = "replicated-hash"
+    picker_replicas: int = 512
+
+    # gRPC keepalive: close server connections older than this many
+    # seconds (0 = never; reference: daemon.go:110-115).
+    grpc_max_conn_age_sec: int = 0
+
+    # Debug logging (GUBER_DEBUG; reference: config.go:275).
+    debug: bool = False
 
     # TLS (None = plaintext); see gubernator_tpu.net.tls.
     tls: Optional["object"] = None
@@ -229,11 +256,20 @@ def setup_daemon_config(
         multi_region_batch_limit=_env_int(d, "GUBER_MULTI_REGION_BATCH_LIMIT", 1000),
     )
 
-    hash_algorithm = _env(d, "GUBER_PEER_PICKER_HASH", "fnv1")
+    peer_picker = _env(d, "GUBER_PEER_PICKER", "replicated-hash")
+    # Validate via the single source of truth (cluster.hash_ring).
+    from gubernator_tpu.cluster.hash_ring import make_picker
+
+    make_picker(peer_picker, "fnv1")
+    # When the picker is selected explicitly, the reference defaults
+    # its hash to fnv1a (config.go:403); otherwise fnv1.
+    hash_default = "fnv1a" if _env(d, "GUBER_PEER_PICKER") else "fnv1"
+    hash_algorithm = _env(d, "GUBER_PEER_PICKER_HASH", hash_default)
     if hash_algorithm not in ("fnv1", "fnv1a"):
         raise ValueError(
             f"GUBER_PEER_PICKER_HASH={hash_algorithm!r}: want fnv1 or fnv1a"
         )
+    picker_replicas = _env_int(d, "GUBER_REPLICATED_HASH_REPLICAS", 512)
     discovery = _env(d, "GUBER_PEER_DISCOVERY_TYPE", "none")
     if discovery not in ("none", "member-list", "etcd", "dns", "k8s"):
         raise ValueError(
@@ -285,6 +321,20 @@ def setup_daemon_config(
             if h.strip()
         ],
         etcd_key_prefix=_env(d, "GUBER_ETCD_KEY_PREFIX", "/gubernator/peers/"),
+        etcd_dial_timeout=_env_float_seconds(d, "GUBER_ETCD_DIAL_TIMEOUT", 5.0),
+        etcd_user=_env(d, "GUBER_ETCD_USER"),
+        etcd_password=_env(d, "GUBER_ETCD_PASSWORD"),
+        etcd_advertise_address=_env(d, "GUBER_ETCD_ADVERTISE_ADDRESS"),
+        etcd_data_center=_env(d, "GUBER_ETCD_DATA_CENTER", dc),
+        etcd_tls_ca=_env(d, "GUBER_ETCD_TLS_CA"),
+        etcd_tls_cert=_env(d, "GUBER_ETCD_TLS_CERT"),
+        etcd_tls_key=_env(d, "GUBER_ETCD_TLS_KEY"),
+        etcd_tls_skip_verify=_env(d, "GUBER_ETCD_TLS_SKIP_VERIFY")
+        in ("1", "true", "yes"),
+        peer_picker=peer_picker,
+        picker_replicas=picker_replicas,
+        grpc_max_conn_age_sec=_env_int(d, "GUBER_GRPC_MAX_CONN_AGE_SEC", 0),
+        debug=_env(d, "GUBER_DEBUG") in ("1", "true", "yes"),
         tls=tls,
         device_count=device_count,
         sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),

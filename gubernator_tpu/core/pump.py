@@ -202,9 +202,11 @@ class StepPump:
         self.fused_rounds += k
         g = _Group(pouts)
         for i, t in enumerate(group):
-            t.group = g
+            # index BEFORE group: fetch()'s lock-free fast path keys on
+            # `group is not None`, so group must be the LAST field set.
             t.index = i
             t.buf = None
+            t.group = g
 
     # -- lock-free API -------------------------------------------------
 

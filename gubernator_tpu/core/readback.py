@@ -63,6 +63,7 @@ class ReadbackCombiner:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._queue: List[Ticket] = []
+        self._draining = False
         self._stack_cache: Dict[Tuple, object] = {}
         # Telemetry (PERF.md): transfer RPCs saved = registered -
         # transfers.
@@ -79,12 +80,29 @@ class ReadbackCombiner:
         with self._lock:
             self._queue.append(t)
             self.registered += 1
-            overflow = len(self._queue) > 4 * MAX_GROUP
+            overflow = (
+                len(self._queue) > 4 * MAX_GROUP and not self._draining
+            )
+            if overflow:
+                self._draining = True
         if overflow:
             # Fire-and-forget callers never fetch; bound device memory
-            # by draining the oldest group on their behalf.
-            self._drain_oldest()
+            # by draining the oldest group on their behalf — OFF this
+            # thread, which may hold the engine lock (a blocking d2h
+            # here would stall every serving thread for the RPC).
+            threading.Thread(
+                target=self._drain_detached,
+                name="guber-readback-drain",
+                daemon=True,
+            ).start()
         return t
+
+    def _drain_detached(self) -> None:
+        try:
+            self._drain_oldest()
+        finally:
+            with self._lock:
+                self._draining = False
 
     # -- leader path ---------------------------------------------------
 

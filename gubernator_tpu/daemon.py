@@ -112,6 +112,8 @@ class Daemon:
             behaviors=conf.behaviors,
             cache_size=conf.cache_size,
             hash_algorithm=conf.hash_algorithm,
+            peer_picker=conf.peer_picker,
+            picker_replicas=conf.picker_replicas,
             data_center=conf.data_center,
             peer_credentials=creds,
             local_batch_wait=conf.local_batch_wait,
@@ -134,8 +136,14 @@ class Daemon:
             interceptors=[grpc_stats],
             options=[
                 ("grpc.max_receive_message_length", 1024 * 1024),  # daemon.go:103
-                ("grpc.max_connection_age_ms", 120_000),  # daemon.go:110-115
-            ],
+            ]
+            + (
+                # Only when configured, like the reference
+                # (GUBER_GRPC_MAX_CONN_AGE_SEC; daemon.go:110-115).
+                [("grpc.max_connection_age_ms", conf.grpc_max_conn_age_sec * 1000)]
+                if conf.grpc_max_conn_age_sec > 0
+                else []
+            ),
         )
         add_v1_to_server(GrpcV1Adapter(self.instance), self.grpc_server)
         add_peers_v1_to_server(GrpcPeersV1Adapter(self.instance), self.grpc_server)

@@ -47,10 +47,29 @@ class EtcdPool(DiscoveryBase):
 
             endpoint = (conf.etcd_endpoints or ["localhost:2379"])[0]
             host, _, port = endpoint.rpartition(":")
-            client = etcd3.client(host=host or "localhost", port=int(port or 2379))
+            # Auth/TLS block (GUBER_ETCD_USER/_PASSWORD/_TLS_*;
+            # reference: config.go:363-370, 440-496).
+            kwargs = {
+                "host": host or "localhost",
+                "port": int(port or 2379),
+                "timeout": getattr(conf, "etcd_dial_timeout", 5.0),
+            }
+            if getattr(conf, "etcd_user", ""):
+                kwargs["user"] = conf.etcd_user
+                kwargs["password"] = conf.etcd_password
+            if getattr(conf, "etcd_tls_ca", ""):
+                kwargs["ca_cert"] = conf.etcd_tls_ca
+            if getattr(conf, "etcd_tls_cert", ""):
+                kwargs["cert_cert"] = conf.etcd_tls_cert
+                kwargs["cert_key"] = conf.etcd_tls_key
+            client = etcd3.client(**kwargs)
         self._client = client
         self.keepalive_interval = keepalive_interval
         self.key_prefix = conf.etcd_key_prefix
+        # Optional registration overrides (GUBER_ETCD_ADVERTISE_ADDRESS
+        # / GUBER_ETCD_DATA_CENTER; reference: config.go:369-370).
+        self._advertise_address = getattr(conf, "etcd_advertise_address", "")
+        self._advertise_dc = getattr(conf, "etcd_data_center", "")
         self._lease = None
         self._watch_id = None
         self._peers: Dict[str, PeerInfo] = {}
@@ -58,22 +77,22 @@ class EtcdPool(DiscoveryBase):
             target=self._keepalive_loop, name="guber-etcd-lease", daemon=True
         )
 
+    def _advertised(self):
+        me = self.daemon.peer_info()
+        grpc = self._advertise_address or me.grpc_address
+        dc = self._advertise_dc or me.datacenter
+        return grpc, me.http_address, dc
+
     def _my_key(self) -> str:
-        return self.key_prefix + self.daemon.peer_info().grpc_address
+        return self.key_prefix + self._advertised()[0]
 
     def _register(self) -> None:
         """reference: etcd.go:222-316 (register + keep-alive loop)."""
-        me = self.daemon.peer_info()
+        grpc, http, dc = self._advertised()
         self._lease = self._client.lease(LEASE_TTL_S)
         self._client.put(
             self._my_key(),
-            json.dumps(
-                {
-                    "grpc": me.grpc_address,
-                    "http": me.http_address,
-                    "dc": me.datacenter,
-                }
-            ),
+            json.dumps({"grpc": grpc, "http": http, "dc": dc}),
             lease=self._lease,
         )
 

@@ -93,10 +93,8 @@ def add_peers_v1_to_server(servicer: PeersV1Servicer, server: grpc.Server) -> No
                     "GetPeerRateLimits": _unary_raw(
                         servicer.GetPeerRateLimits
                     ),
-                    "UpdatePeerGlobals": _unary(
-                        servicer.UpdatePeerGlobals,
-                        peers_pb.UpdatePeerGlobalsReq,
-                        peers_pb.UpdatePeerGlobalsResp,
+                    "UpdatePeerGlobals": _unary_raw(
+                        servicer.UpdatePeerGlobals
                     ),
                 },
             ),

@@ -158,6 +158,28 @@ class GrpcPeersV1Adapter:
         return serde.peer_rate_limits_resp_to_pb(resps)
 
     def UpdatePeerGlobals(self, request, context):
+        # Raw-bytes fast path: the broadcast plane is the cluster
+        # tier's highest-rate message; decode straight into status-
+        # cache columns (net/wire_codec.decode_globals).
+        if isinstance(request, (bytes, memoryview)):
+            from gubernator_tpu.net import wire_codec
+            from gubernator_tpu.types import MAX_BATCH_SIZE
+
+            dec = wire_codec.decode_globals(
+                bytes(request), MAX_BATCH_SIZE
+            )
+            if dec is not None:
+                self.instance.update_peer_globals_columns(dec)
+                return b""  # empty UpdatePeerGlobalsResp
+            try:
+                request = peers_pb.UpdatePeerGlobalsReq.FromString(
+                    bytes(request)
+                )
+            except Exception:  # noqa: BLE001 — see GetRateLimits
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    "Exception deserializing request!",
+                )
         self.instance.update_peer_globals(
             [serde.update_peer_global_from_pb(g) for g in request.globals]
         )

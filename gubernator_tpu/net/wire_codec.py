@@ -41,6 +41,7 @@ class DecodedBatch(NamedTuple):
     burst: np.ndarray  # int64 [n]
     fnv1: np.ndarray  # uint64 [n]
     fnv1a: np.ndarray  # uint64 [n]
+    name_len: np.ndarray  # int32 [n] — key_buf item = name + b"_" + key
 
 
 def load():
@@ -63,14 +64,113 @@ def load():
         lib.wire_decode_reqs.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64,
-        ] + [ctypes.c_void_p] * 9
+        ] + [ctypes.c_void_p] * 10
         lib.wire_encode_resps.restype = ctypes.c_int64
         lib.wire_encode_resps.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
         ]
+        lib.wire_encode_resps_owner.restype = ctypes.c_int64
+        # (status, limit, remaining, reset, owner_idx, owner_buf,
+        #  owner_offsets, n, out, out_cap)
+        lib.wire_encode_resps_owner.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.wire_encode_globals.restype = ctypes.c_int64
+        # (key_buf, key_offsets, algo, status, limit, remaining,
+        #  reset, n, out, out_cap)
+        lib.wire_encode_globals.argtypes = (
+            [ctypes.c_void_p] * 7 + [ctypes.c_int64, ctypes.c_void_p,
+                                     ctypes.c_int64]
+        )
+        lib.wire_decode_globals.restype = ctypes.c_int64
+        # (buf, len, max_items, key_buf, key_cap, key_offsets, algo,
+        #  status, limit, remaining, reset, has_status)
+        lib.wire_decode_globals.argtypes = (
+            [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+             ctypes.c_void_p, ctypes.c_int64] + [ctypes.c_void_p] * 7
+        )
         _lib = lib
     return _lib
+
+
+class DecodedGlobals(NamedTuple):
+    n: int
+    key_buf: np.ndarray  # uint8
+    key_offsets: np.ndarray  # int64 [n+1]
+    algo: np.ndarray  # int32 [n]
+    status: np.ndarray  # int32 [n]
+    limit: np.ndarray  # int64 [n]
+    remaining: np.ndarray  # int64 [n]
+    reset_time: np.ndarray  # int64 [n]
+    has_status: np.ndarray  # int32 [n]
+
+
+def encode_globals(
+    key_buf: np.ndarray,
+    key_offsets: np.ndarray,
+    algo: np.ndarray,
+    status: np.ndarray,
+    limit: np.ndarray,
+    remaining: np.ndarray,
+    reset_time: np.ndarray,
+) -> bytes:
+    """Columns → UpdatePeerGlobalsReq bytes (broadcast plane)."""
+    lib = load()
+    assert lib is not None
+    n = len(algo)
+    key_buf = np.ascontiguousarray(key_buf, dtype=np.uint8)
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
+    algo = np.ascontiguousarray(algo, dtype=np.int32)
+    status = np.ascontiguousarray(status, dtype=np.int32)
+    limit = np.ascontiguousarray(limit, dtype=np.int64)
+    remaining = np.ascontiguousarray(remaining, dtype=np.int64)
+    reset_time = np.ascontiguousarray(reset_time, dtype=np.int64)
+    out = np.empty(int(key_offsets[-1]) + n * 64 + 16, dtype=np.uint8)
+    written = lib.wire_encode_globals(
+        _ptr(key_buf), _ptr(key_offsets), _ptr(algo), _ptr(status),
+        _ptr(limit), _ptr(remaining), _ptr(reset_time), n,
+        _ptr(out), len(out),
+    )
+    assert written >= 0
+    return out[:written].tobytes()
+
+
+def decode_globals(raw: bytes, max_items: int) -> Optional[DecodedGlobals]:
+    """UpdatePeerGlobalsReq bytes → columns; None ⇒ pb fallback."""
+    lib = load()
+    if lib is None or not raw:
+        return None
+    key_cap = len(raw)
+    key_buf = np.empty(key_cap, dtype=np.uint8)
+    key_offsets = np.empty(max_items + 1, dtype=np.int64)
+    algo = np.empty(max_items, dtype=np.int32)
+    status = np.empty(max_items, dtype=np.int32)
+    limit = np.empty(max_items, dtype=np.int64)
+    remaining = np.empty(max_items, dtype=np.int64)
+    reset_time = np.empty(max_items, dtype=np.int64)
+    has_status = np.empty(max_items, dtype=np.int32)
+    n = lib.wire_decode_globals(
+        raw, len(raw), max_items, _ptr(key_buf), key_cap,
+        _ptr(key_offsets), _ptr(algo), _ptr(status), _ptr(limit),
+        _ptr(remaining), _ptr(reset_time), _ptr(has_status),
+    )
+    if n < 0:
+        return None
+    return DecodedGlobals(
+        n=int(n),
+        key_buf=key_buf[: key_offsets[n] if n else 0],
+        key_offsets=key_offsets[: n + 1],
+        algo=algo[:n],
+        status=status[:n],
+        limit=limit[:n],
+        remaining=remaining[:n],
+        reset_time=reset_time[:n],
+        has_status=has_status[:n],
+    )
 
 
 def _ptr(a: np.ndarray):
@@ -99,11 +199,12 @@ def decode_reqs(
     burst = np.empty(max_items, dtype=np.int64)
     fnv1 = np.empty(max_items, dtype=np.uint64)
     fnv1a = np.empty(max_items, dtype=np.uint64)
+    name_len = np.empty(max_items, dtype=np.int32)
     n = lib.wire_decode_reqs(
         raw, len(raw), max_items, disqualify_mask,
         _ptr(key_buf), key_cap, _ptr(key_offsets), _ptr(algo),
         _ptr(behavior), _ptr(hits), _ptr(limit), _ptr(duration),
-        _ptr(burst), _ptr(fnv1), _ptr(fnv1a),
+        _ptr(burst), _ptr(fnv1), _ptr(fnv1a), _ptr(name_len),
     )
     if n <= 0:
         # -2 (too many items) must surface as the RPC-level batch error;
@@ -122,6 +223,7 @@ def decode_reqs(
         burst=burst[:n],
         fnv1=fnv1[:n],
         fnv1a=fnv1a[:n],
+        name_len=name_len[:n],
     )
 
 
@@ -143,6 +245,40 @@ def encode_resps(
     out = np.empty(n * 52 + 16, dtype=np.uint8)
     written = lib.wire_encode_resps(
         _ptr(status), _ptr(limit), _ptr(remaining), _ptr(reset_time),
+        n, _ptr(out), len(out),
+    )
+    assert written >= 0
+    return out[:written].tobytes()
+
+
+def encode_resps_owner(
+    status: np.ndarray,
+    limit: np.ndarray,
+    remaining: np.ndarray,
+    reset_time: np.ndarray,
+    owner_idx: np.ndarray,  # int32 [n]; -1 = no metadata
+    owners: list,  # list[bytes] — owner grpc addresses
+) -> bytes:
+    """Columns → response bytes with per-item {"owner": addr} metadata
+    (the GLOBAL non-owner responses — reference: gubernator.go:448-452)."""
+    lib = load()
+    assert lib is not None, "encode_resps_owner requires the native codec"
+    n = len(status)
+    status = np.ascontiguousarray(status, dtype=np.int32)
+    limit = np.ascontiguousarray(limit, dtype=np.int64)
+    remaining = np.ascontiguousarray(remaining, dtype=np.int64)
+    reset_time = np.ascontiguousarray(reset_time, dtype=np.int64)
+    owner_idx = np.ascontiguousarray(owner_idx, dtype=np.int32)
+    owner_buf = np.frombuffer(b"".join(owners), dtype=np.uint8) if owners \
+        else np.empty(0, dtype=np.uint8)
+    owner_offsets = np.zeros(len(owners) + 1, dtype=np.int64)
+    if owners:
+        owner_offsets[1:] = np.cumsum([len(o) for o in owners])
+    max_owner = max((len(o) for o in owners), default=0)
+    out = np.empty(n * (52 + 24 + max_owner) + 16, dtype=np.uint8)
+    written = lib.wire_encode_resps_owner(
+        _ptr(status), _ptr(limit), _ptr(remaining), _ptr(reset_time),
+        _ptr(owner_idx), _ptr(owner_buf), _ptr(owner_offsets),
         n, _ptr(out), len(out),
     )
     assert written >= 0

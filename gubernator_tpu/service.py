@@ -44,6 +44,7 @@ from gubernator_tpu.types import (
     PeerInfo,
     RateLimitReq,
     RateLimitResp,
+    Status,
     UpdatePeerGlobal,
     has_behavior,
 )
@@ -79,11 +80,32 @@ class ServiceError(RuntimeError):
         self.code = code
 
 
+def _slice_key_columns(key_buf: np.ndarray, key_offsets: np.ndarray, idx):
+    """Vectorized sub-selection of a concatenated key buffer: returns
+    (sub_buf, sub_offsets) for the items in `idx` without per-item
+    Python (the GLOBAL wire route partitions batches this way)."""
+    lens = key_offsets[1:] - key_offsets[:-1]
+    sel = lens[idx]
+    sub_off = np.zeros(len(idx) + 1, dtype=np.int64)
+    np.cumsum(sel, out=sub_off[1:])
+    total = int(sub_off[-1])
+    # Gather positions: for each output byte, its source index.
+    starts = key_offsets[:-1][idx]
+    pos = (
+        np.repeat(starts - sub_off[:-1], sel)
+        + np.arange(total, dtype=np.int64)
+    )
+    return key_buf[pos], sub_off
+
+
 @dataclass
 class _GlobalEntry:
     resp: RateLimitResp
     algorithm: int
     expire_at: int  # unix ms (ResetTime of the broadcast status)
+    # (status, limit, remaining, reset) ints, preassembled at put time
+    # so the columnar read does no attribute/enum work per item.
+    cols: tuple = ()
 
 
 class _GlobalStatusCache:
@@ -100,23 +122,56 @@ class _GlobalStatusCache:
         from collections import OrderedDict
 
         self.capacity = capacity
-        self._items: "OrderedDict[str, _GlobalEntry]" = OrderedDict()
+        # Keyed by the hash key BYTES: the columnar wire path reads
+        # keys straight out of the decoded key buffer without ever
+        # materializing Python strings.
+        self._items: "OrderedDict[bytes, _GlobalEntry]" = OrderedDict()
         self._lock = threading.Lock()
 
-    def get(self, key: str, now_ms: int) -> Optional[RateLimitResp]:
+    @staticmethod
+    def _k(key) -> bytes:
+        return key.encode() if isinstance(key, str) else key
+
+    def get(self, key, now_ms: int) -> Optional[RateLimitResp]:
         with self._lock:
-            return self._get_locked(key, now_ms)
+            return self._get_locked(self._k(key), now_ms)
 
     def get_many(
-        self, keys: Sequence[str], now_ms: int
+        self, keys: Sequence, now_ms: int
     ) -> List[Optional[RateLimitResp]]:
         """Batch lookup under ONE lock acquisition (VERDICT r1 weak 8:
         a lock per item on the GLOBAL read path becomes a contention
         point at wire batch sizes)."""
         with self._lock:
-            return [self._get_locked(k, now_ms) for k in keys]
+            return [self._get_locked(self._k(k), now_ms) for k in keys]
 
-    def _get_locked(self, key: str, now_ms: int) -> Optional[RateLimitResp]:
+    def get_columns(self, keys: List[bytes], now_ms: int):
+        """Columnar lookup: (hit bool[n], status i32[n], limit i64[n],
+        remaining i64[n], reset i64[n]) — the GLOBAL wire fast path's
+        read (no response objects, one lock)."""
+        import numpy as np
+
+        n = len(keys)
+        hit = np.zeros(n, dtype=bool)
+        status = np.zeros(n, dtype=np.int32)
+        limit = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        with self._lock:
+            items = self._items
+            for i, k in enumerate(keys):
+                e = items.get(k)
+                if e is None:
+                    continue
+                if e.expire_at and now_ms >= e.expire_at:
+                    del items[k]
+                    continue
+                items.move_to_end(k)
+                hit[i] = True
+                status[i], limit[i], remaining[i], reset[i] = e.cols
+        return hit, status, limit, remaining, reset
+
+    def _get_locked(self, key: bytes, now_ms: int) -> Optional[RateLimitResp]:
         e = self._items.get(key)
         if e is None:
             return None
@@ -124,11 +179,43 @@ class _GlobalStatusCache:
             del self._items[key]
             return None
         self._items.move_to_end(key)
+        if e.resp is None:
+            # Columnar puts (the broadcast wire path) defer the
+            # response object; only the pb read path pays for it.
+            st, lim, rem, rst = e.cols
+            e.resp = RateLimitResp(
+                status=Status(st), limit=lim, remaining=rem,
+                reset_time=rst,
+            )
         return e.resp
 
-    def put(self, key: str, resp: RateLimitResp, algorithm: int) -> None:
+    def put_columns(self, dec) -> None:
+        """Columnar insert from a decoded UpdatePeerGlobalsReq
+        (net/wire_codec.DecodedGlobals) — no response objects."""
+        raw = dec.key_buf.tobytes()
+        off = dec.key_offsets
+        items = self._items
         with self._lock:
-            self._put_locked(key, resp, algorithm)
+            for i in range(dec.n):
+                if not dec.has_status[i]:
+                    continue
+                key = raw[off[i]:off[i + 1]]
+                items[key] = _GlobalEntry(
+                    resp=None,
+                    algorithm=int(dec.algo[i]),
+                    expire_at=int(dec.reset_time[i]),
+                    cols=(
+                        int(dec.status[i]), int(dec.limit[i]),
+                        int(dec.remaining[i]), int(dec.reset_time[i]),
+                    ),
+                )
+                items.move_to_end(key)
+            while len(items) > self.capacity:
+                items.popitem(last=False)
+
+    def put(self, key, resp: RateLimitResp, algorithm: int) -> None:
+        with self._lock:
+            self._put_locked(self._k(key), resp, algorithm)
 
     def put_many(self, entries) -> None:
         """Batch insert under ONE lock acquisition — UpdatePeerGlobals
@@ -136,11 +223,17 @@ class _GlobalStatusCache:
         item contends with the serving path's get_many."""
         with self._lock:
             for key, resp, algorithm in entries:
-                self._put_locked(key, resp, algorithm)
+                self._put_locked(self._k(key), resp, algorithm)
 
-    def _put_locked(self, key: str, resp: RateLimitResp, algorithm: int) -> None:
+    def _put_locked(self, key: bytes, resp: RateLimitResp, algorithm: int) -> None:
         self._items[key] = _GlobalEntry(
-            resp=resp, algorithm=algorithm, expire_at=resp.reset_time
+            resp=resp,
+            algorithm=algorithm,
+            expire_at=resp.reset_time,
+            cols=(
+                int(resp.status), resp.limit, resp.remaining,
+                resp.reset_time,
+            ),
         )
         self._items.move_to_end(key)
         while len(self._items) > self.capacity:
@@ -162,11 +255,15 @@ class V1Instance:
         self.global_cache = _GlobalStatusCache(capacity=conf.cache_size)
         self.global_mgr = GlobalManager(conf.behaviors, self)
         self.multi_region_mgr = MultiRegionManager(conf.behaviors, self)
-        self.local_picker: ReplicatedConsistentHash[PeerClient] = (
-            ReplicatedConsistentHash(conf.hash_algorithm)
+        from gubernator_tpu.cluster.hash_ring import make_picker
+
+        self.local_picker: ReplicatedConsistentHash[PeerClient] = make_picker(
+            getattr(conf, "peer_picker", "replicated-hash"),
+            conf.hash_algorithm,
+            getattr(conf, "picker_replicas", 512),
         )
         self.region_picker: RegionPicker[PeerClient] = RegionPicker(
-            conf.hash_algorithm
+            conf.hash_algorithm, getattr(conf, "picker_replicas", 512)
         )
         self._peer_lock = threading.RLock()
         self._forward_pool = ThreadPoolExecutor(
@@ -417,11 +514,19 @@ class V1Instance:
 
         if wire_codec.load() is None:
             return None
+        # Decode with GLOBAL allowed: all-GLOBAL batches have their own
+        # columnar route below; mixed batches decline to the pb path.
         dec = wire_codec.decode_reqs(
-            bytes(raw), MAX_BATCH_SIZE, COLUMNAR_DISQUALIFIERS
+            bytes(raw), MAX_BATCH_SIZE,
+            COLUMNAR_DISQUALIFIERS & ~_GLOBAL_I,
         )
         if dec is None:
             return None
+        g_mask = (dec.behavior & _GLOBAL_I) != 0
+        if g_mask.any():
+            if not g_mask.all():
+                return None
+            return self._serve_wire_global(dec, check_ownership)
         if check_ownership:
             with self._peer_lock:
                 picker = self.local_picker
@@ -459,6 +564,130 @@ class V1Instance:
                 dec.duration, dec.burst,
             )
         return wire_codec.encode_resps(st, lim, rem, rst)
+
+    def _serve_wire_global(
+        self, dec, check_ownership: bool
+    ) -> Optional[bytes]:
+        """Columnar GLOBAL route (the cluster tier's hot path): owned
+        items run the engine + queue a broadcast chunk; non-owned items
+        queue a hits chunk and answer from the status cache (misses run
+        locally, eventually consistent) — all with O(batch) numpy and
+        zero per-item dataclasses.  Mirrors the pb partitioning at
+        _get_rate_limits step 3 (reference: gubernator.go:426-466)."""
+        from gubernator_tpu.core.engine import PackedKeys
+        from gubernator_tpu.net import wire_codec
+
+        engine = self.engine
+        now_ms = engine.clock.now_ms()
+        n = dec.n
+        if check_ownership:
+            with self._peer_lock:
+                picker = self.local_picker
+            n_peers = picker.size()
+            single_addr = None
+            if n_peers == 0:
+                owned = np.ones(n, dtype=bool)
+                owner_objs = None
+            elif n_peers == 1:
+                me = picker.peers()[0]
+                owned = np.full(n, bool(me.info.is_owner))
+                owner_objs = None
+                single_addr = me.info.grpc_address
+            else:
+                hashes = (
+                    dec.fnv1 if picker.hash_name == "fnv1" else dec.fnv1a
+                )
+                owner_objs = picker.get_batch_hashed(np.asarray(hashes))
+                owned = np.fromiter(
+                    (o.info.is_owner for o in owner_objs), bool, n
+                )
+        else:
+            # Peer-forwarded batch: we are the owner of every item.
+            owned = np.ones(n, dtype=bool)
+            owner_objs = None
+            single_addr = None
+        owned_idx = np.nonzero(owned)[0]
+        non_idx = np.nonzero(~owned)[0]
+
+        status = np.zeros(n, dtype=np.int32)
+        limit = np.asarray(dec.limit).copy()
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        owner_meta_idx = np.full(n, -1, dtype=np.int32)
+        owner_strs: List[bytes] = []
+
+        eng_parts = [owned_idx] if len(owned_idx) else []
+        if len(non_idx):
+            self.counters["global"] += len(non_idx)
+            self.global_mgr.queue_hits_chunk(dec, non_idx)
+            raw_keys = dec.key_buf.tobytes()
+            off = dec.key_offsets
+            keys = [raw_keys[off[i]:off[i + 1]] for i in non_idx.tolist()]
+            hit, c_st, c_lim, c_rem, c_rst = self.global_cache.get_columns(
+                keys, now_ms
+            )
+            hidx = non_idx[hit]
+            midx = non_idx[~hit]
+            status[hidx] = c_st[hit]
+            limit[hidx] = c_lim[hit]
+            remaining[hidx] = c_rem[hit]
+            reset[hidx] = c_rst[hit]
+            if len(midx):
+                eng_parts.append(midx)
+            # Every non-owned response echoes its owner address
+            # (reference: gubernator.go:448-452).
+            addr_index: Dict[str, int] = {}
+            for i in non_idx.tolist():
+                addr = (
+                    single_addr if owner_objs is None
+                    else owner_objs[i].info.grpc_address
+                )
+                k = addr_index.get(addr)
+                if k is None:
+                    k = len(owner_strs)
+                    addr_index[addr] = k
+                    owner_strs.append(addr.encode())
+                owner_meta_idx[i] = k
+        if len(owned_idx):
+            self.counters["local"] += len(owned_idx)
+            # Owner-side GLOBAL items queue the broadcast re-read
+            # (reference: gubernator.go:621-654 via apply_local_batch).
+            self.global_mgr.queue_updates_chunk(dec, owned_idx)
+
+        if eng_parts:
+            eng_idx = (
+                eng_parts[0] if len(eng_parts) == 1
+                else np.sort(np.concatenate(eng_parts))
+            )
+            sub_buf, sub_off = _slice_key_columns(
+                dec.key_buf, dec.key_offsets, eng_idx
+            )
+            packed = PackedKeys(sub_buf, sub_off, len(eng_idx))
+            cols = tuple(
+                np.ascontiguousarray(np.asarray(a)[eng_idx])
+                for a in (dec.algo, dec.behavior, dec.hits, dec.limit,
+                          dec.duration, dec.burst)
+            )
+            if hasattr(engine, "tables"):
+                st, lim, rem, rst = engine.apply_columnar(
+                    packed, *cols, now_ms=now_ms,
+                    route_hashes=np.ascontiguousarray(dec.fnv1a[eng_idx]),
+                )
+            else:
+                st, lim, rem, rst = engine.apply_columnar(
+                    packed, *cols, now_ms=now_ms
+                )
+            status[eng_idx] = st
+            limit[eng_idx] = lim
+            remaining[eng_idx] = rem
+            reset[eng_idx] = rst
+
+        self.counters["columnar"] += n
+        if owner_strs:
+            return wire_codec.encode_resps_owner(
+                status, limit, remaining, reset, owner_meta_idx, owner_strs
+            )
+        return wire_codec.encode_resps(status, limit, remaining, reset)
 
     def apply_columnar_local(
         self,
@@ -504,6 +733,14 @@ class V1Instance:
         self.counters["columnar"] += len(keys_bytes)
         return apply_columnar(keys_bytes, algo, behavior, hits, limit, duration, burst)
 
+    def get_peer_batch(self, keys: Sequence[str]) -> List:
+        """Owner clients for a key list — ONE lock + one vectorized
+        ring pass (the GLOBAL hit windows look up every queued key)."""
+        with self._peer_lock:
+            if self.local_picker.size() == 0:
+                return [None] * len(keys)
+            return self.local_picker.get_batch(list(keys))
+
     def get_peer_rate_limits(
         self, requests: Sequence[RateLimitReq]
     ) -> List[RateLimitResp]:
@@ -534,6 +771,10 @@ class V1Instance:
             for g in globals_
             if g.status is not None
         )
+
+    def update_peer_globals_columns(self, dec) -> None:
+        """Columnar variant (raw wire path — net/server.py)."""
+        self.global_cache.put_columns(dec)
 
     def health_check(self) -> HealthCheckResp:
         """Aggregate recent peer errors. reference: gubernator.go:562-619."""

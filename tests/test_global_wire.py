@@ -1,0 +1,137 @@
+"""Columnar GLOBAL wire plane: codec round-trips and cluster-path
+equivalence with the pb path (service._serve_wire_global,
+wire_codec.encode/decode_globals, GlobalManager chunk queues)."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.net import wire_codec
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.net.pb import peers_pb2 as peers_pb
+
+pytestmark = pytest.mark.skipif(
+    wire_codec.load() is None, reason="native codec unavailable"
+)
+
+
+def _globals_payload(items):
+    msg = peers_pb.UpdatePeerGlobalsReq()
+    for key, algo, st, lim, rem, rst in items:
+        g = msg.globals.add()
+        g.key = key
+        g.algorithm = algo
+        g.status.status = st
+        g.status.limit = lim
+        g.status.remaining = rem
+        g.status.reset_time = rst
+    return msg.SerializeToString()
+
+
+def test_decode_globals_matches_pb():
+    items = [
+        ("a_k1", 0, 1, 100, 0, 999_999),
+        ("b_k2", 1, 0, 50, 49, 123_456),
+        ("c_long_name_key", 0, 0, 0, 0, 0),
+    ]
+    dec = wire_codec.decode_globals(_globals_payload(items), 1000)
+    assert dec is not None and dec.n == 3
+    raw = dec.key_buf.tobytes()
+    keys = [
+        raw[dec.key_offsets[i]:dec.key_offsets[i + 1]].decode()
+        for i in range(3)
+    ]
+    assert keys == [i[0] for i in items]
+    assert dec.algo.tolist() == [0, 1, 0]
+    assert dec.status.tolist() == [1, 0, 0]
+    assert dec.limit.tolist() == [100, 50, 0]
+    assert dec.remaining.tolist() == [0, 49, 0]
+    assert dec.reset_time.tolist() == [999_999, 123_456, 0]
+    assert dec.has_status.tolist() == [1, 1, 1]
+
+
+def test_encode_globals_roundtrip_via_pb_parser():
+    keys = [b"n1_k%d" % i for i in range(50)]
+    key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    off = np.zeros(51, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=off[1:])
+    algo = (np.arange(50) % 2).astype(np.int32)
+    status = (np.arange(50) % 2).astype(np.int32)
+    limit = np.arange(50, dtype=np.int64) * 7
+    remaining = np.arange(50, dtype=np.int64) * 3
+    reset = np.arange(50, dtype=np.int64) + 10**12
+    raw = wire_codec.encode_globals(
+        key_buf, off, algo, status, limit, remaining, reset
+    )
+    msg = peers_pb.UpdatePeerGlobalsReq.FromString(raw)
+    assert len(msg.globals) == 50
+    for i, g in enumerate(msg.globals):
+        assert g.key == keys[i].decode()
+        assert g.algorithm == int(algo[i])
+        assert g.status.status == int(status[i])
+        assert g.status.limit == int(limit[i])
+        assert g.status.remaining == int(remaining[i])
+        assert g.status.reset_time == int(reset[i])
+
+
+def test_encode_resps_owner_metadata_roundtrip():
+    n = 6
+    status = np.array([0, 1, 0, 1, 0, 0], dtype=np.int32)
+    limit = np.full(n, 42, dtype=np.int64)
+    remaining = np.arange(n, dtype=np.int64)
+    reset = np.full(n, 5_000, dtype=np.int64)
+    owner_idx = np.array([0, 0, -1, 1, 1, -1], dtype=np.int32)
+    owners = [b"10.0.0.1:81", b"10.0.0.2:82"]
+    raw = wire_codec.encode_resps_owner(
+        status, limit, remaining, reset, owner_idx, owners
+    )
+    msg = pb.GetRateLimitsResp.FromString(raw)
+    assert len(msg.responses) == n
+    for i, r in enumerate(msg.responses):
+        assert r.status == int(status[i])
+        assert r.remaining == int(remaining[i])
+        if owner_idx[i] >= 0:
+            assert r.metadata["owner"] == owners[owner_idx[i]].decode()
+        else:
+            assert "owner" not in r.metadata
+
+
+def test_global_wire_path_equivalence_single_owner():
+    """A single-node daemon (owner) serving an all-GLOBAL wire batch
+    must give byte-identical decisions to the pb path (which queues
+    updates + runs the engine) — and queue the broadcast."""
+    import jax
+
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.cluster.harness import cluster_behaviors
+    from gubernator_tpu.types import Behavior
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=cluster_behaviors(),
+        cache_size=4096,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+    )
+    d = spawn_daemon(conf)
+    try:
+        reqs = [
+            pb.RateLimitReq(
+                name="gw", unique_key=f"k{i}", hits=1, limit=100,
+                duration=60_000, behavior=int(Behavior.GLOBAL),
+            )
+            for i in range(40)
+        ]
+        raw = pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+        out = d.instance.serve_wire_bytes(raw)
+        assert out is not None, "GLOBAL wire fast path must engage"
+        resp = pb.GetRateLimitsResp.FromString(out)
+        assert len(resp.responses) == 40
+        assert all(r.remaining == 99 for r in resp.responses)
+        assert all(r.error == "" for r in resp.responses)
+        # Broadcast updates were queued columnar.
+        assert d.instance.global_mgr._updates.pending() >= 40
+    finally:
+        d.close()

@@ -94,10 +94,19 @@ def test_threaded_fetch_no_lost_tickets():
 
 
 def test_overflow_drains_fire_and_forget():
+    import time
+
     rc = ReadbackCombiner()
     arrs = [np.full((2, 2), i, dtype=np.int32) for i in range(4 * MAX_GROUP + 8)]
     tickets = [rc.register(_dev(a)) for a in arrs]
-    # Some early tickets were drained on the registrants' behalf.
+    # The drain runs on a DETACHED thread (register must never block
+    # behind a transfer — it is called under the engine lock); wait
+    # for it to cover some early tickets.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not any(
+        t.host is not None for t in tickets[:MAX_GROUP]
+    ):
+        time.sleep(0.01)
     assert any(t.host is not None for t in tickets[:MAX_GROUP])
     # And every ticket still fetches its own bytes.
     for t, a in zip(tickets, arrs):

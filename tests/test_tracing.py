@@ -145,18 +145,26 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         ][:3]
         assert len(fwd) >= 3, "expected remotely-owned keys"
         inst.get_rate_limits(fwd[:3])
+        # Order-independent: ANY batch_rpc span of width 3 qualifies
+        # (background windows may interleave spans under suite load).
         rpc = tracer.spans("peer.batch_rpc")
-        assert rpc and rpc[0].attributes["batch"] == 3 and rpc[0].attributes["peer"]
+        assert any(
+            s.attributes["batch"] == 3 and s.attributes["peer"] for s in rpc
+        ), rpc
 
         inst.get_rate_limits(fwd[:1])  # single item → batcher window
         # The flush span is recorded on the flusher thread just after
-        # the response futures resolve; poll briefly.
-        deadline = time.monotonic() + 20
+        # the response futures resolve; poll generously — the full
+        # suite saturates this one-core host and flusher threads can
+        # starve for tens of seconds.
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline and not tracer.spans("peer.flush"):
             time.sleep(0.02)
         assert tracer.spans("peer.flush"), "forwarding did not trace a flush"
-        flush = tracer.spans("peer.flush")[0]
-        assert flush.attributes["batch"] >= 1 and flush.attributes["peer"]
+        assert any(
+            s.attributes["batch"] >= 1 and s.attributes["peer"]
+            for s in tracer.spans("peer.flush")
+        )
 
         # GLOBAL behavior → async hits window (+ broadcast on owner).
         g = [
@@ -166,7 +174,7 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         ][:3]
         assert g
         inst.get_rate_limits(g)
-        deadline = time.monotonic() + 20
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline and not (
             tracer.spans("global.hits_window")
             and tracer.spans("global.broadcast")
@@ -174,6 +182,9 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
             time.sleep(0.05)
         assert tracer.spans("global.hits_window")
         assert tracer.spans("global.broadcast")
-        assert tracer.spans("global.hits_window")[0].attributes["keys"] >= 1
+        assert any(
+            s.attributes["keys"] >= 1
+            for s in tracer.spans("global.hits_window")
+        )
     finally:
         h.stop()
