@@ -154,7 +154,9 @@ def main() -> int:
 
             force_cpu_platform()
 
-        if MODE == "wire":
+        if MODE == "sketch":
+            result = _run_wire(np, platform, sketch=True)
+        elif MODE == "wire":
             result = _run_wire(np, platform)
         elif MODE == "global":
             result = _run_global(np, platform)
@@ -308,7 +310,7 @@ def _run_engine(np, platform: str) -> dict:
     }
 
 
-def _run_wire(np, platform: str) -> dict:
+def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
     """Loopback-gRPC serving throughput: real daemon, real wire.
 
     Measures the SERVED path — pb decode → columnar fast path →
@@ -316,6 +318,11 @@ def _run_wire(np, platform: str) -> dict:
     VERDICT r1 item 2 is the same engine program as `_run_engine`.
     Client-side encode cost is excluded (payloads pre-serialized);
     responses are received but not parsed.
+
+    sketch=True: BASELINE config 5 — every request carries
+    Behavior.SKETCH, so decisions come from the count-min-sketch
+    approximate limiter (O(1) memory at unbounded key cardinality)
+    instead of the bucket engine.
     """
     import grpc
 
@@ -323,9 +330,11 @@ def _run_wire(np, platform: str) -> dict:
     from gubernator_tpu.daemon import spawn_daemon
     from gubernator_tpu.net.grpc_service import V1_SERVICE
     from gubernator_tpu.net.pb import gubernator_pb2 as pb
+    from gubernator_tpu.types import Behavior
 
     wire_batch = min(BATCH, 1000)  # MAX_BATCH_SIZE on the wire
     n_threads = int(os.environ.get("BENCH_WIRE_THREADS", 8))
+    behavior = int(Behavior.SKETCH) if sketch else 0
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
@@ -339,17 +348,24 @@ def _run_wire(np, platform: str) -> dict:
         n_procs = int(os.environ.get("BENCH_WIRE_PROCS", "0"))
         if n_procs:
             rate, p50_ms, p99_ms = _drive_grpc_procs(
-                np, [daemon.grpc_address], n_procs, wire_batch
+                np, [daemon.grpc_address], n_procs, wire_batch,
+                behavior=behavior,
             )
             n_threads = n_procs  # for the metric label
         else:
-            payloads = _build_payloads(pb, wire_batch, behavior=0)
+            payloads = _build_payloads(pb, wire_batch, behavior=behavior)
             rate, p50_ms, p99_ms = _drive_grpc(
                 np, [daemon.grpc_address], payloads, n_threads, wire_batch
             )
+        label = (
+            "rate-limit decisions/sec, count-min-sketch approximate "
+            "limiter over loopback gRPC "
+            if sketch
+            else "rate-limit decisions/sec, single node, loopback gRPC "
+        )
         return {
-            "metric": "rate-limit decisions/sec, single node, loopback gRPC "
-            f"(batch={wire_batch}, {n_threads} client threads, {N_KEYS} hot keys)",
+            "metric": label
+            + f"(batch={wire_batch}, {n_threads} client threads, {N_KEYS} hot keys)",
             "value": round(rate, 1),
             "unit": "decisions/sec",
             "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),

@@ -77,6 +77,12 @@ class Config:
     # dispatch — the local-tier analog of the peer BatchWait
     # (net/wire_window.py; SURVEY §7.1's batching front-end).
     local_batch_wait: float = 0.0
+    # Count-min-sketch approximate limiter (Behavior.SKETCH;
+    # GUBER_SKETCH_*): window / depth / width of the two-epoch sketch
+    # (ops/sketch.py; BASELINE config 5).
+    sketch_window_ms: int = 1_000
+    sketch_depth: int = 4
+    sketch_width: int = 1 << 20
 
 
 def _env(d: Dict[str, str], key: str, default: str = "") -> str:
@@ -213,6 +219,11 @@ class DaemonConfig:
     # Debug logging (GUBER_DEBUG; reference: config.go:275).
     debug: bool = False
 
+    # Approximate limiter (see Config.sketch_*).
+    sketch_window_ms: int = 1_000
+    sketch_depth: int = 4
+    sketch_width: int = 1 << 20
+
     # TLS (None = plaintext); see gubernator_tpu.net.tls.
     tls: Optional["object"] = None
 
@@ -335,6 +346,11 @@ def setup_daemon_config(
         picker_replicas=picker_replicas,
         grpc_max_conn_age_sec=_env_int(d, "GUBER_GRPC_MAX_CONN_AGE_SEC", 0),
         debug=_env(d, "GUBER_DEBUG") in ("1", "true", "yes"),
+        sketch_window_ms=int(
+            _env_float_seconds(d, "GUBER_SKETCH_WINDOW", 1.0) * 1000
+        ),
+        sketch_depth=_env_int(d, "GUBER_SKETCH_DEPTH", 4),
+        sketch_width=_env_int(d, "GUBER_SKETCH_WIDTH", 1 << 20),
         tls=tls,
         device_count=device_count,
         sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),

@@ -117,6 +117,9 @@ class Daemon:
             data_center=conf.data_center,
             peer_credentials=creds,
             local_batch_wait=conf.local_batch_wait,
+            sketch_window_ms=conf.sketch_window_ms,
+            sketch_depth=conf.sketch_depth,
+            sketch_width=conf.sketch_width,
         )
         self.instance = V1Instance(service_conf, engine)
         self.registry = build_registry(

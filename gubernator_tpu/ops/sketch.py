@@ -32,7 +32,7 @@ rate limiter's fail-closed preference.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -165,6 +165,14 @@ class SketchLimiter:
         self.width = width
         self._seed = np.uint64(seed)
         self._state = make_sketch(depth, width)
+        # Serializes concurrent apply() calls: the step DONATES the
+        # state, so two racing callers would hand the same deleted
+        # buffer to the device (and even without donation the
+        # read-modify-write of self._state would drop updates,
+        # breaking the never-under-count contract).
+        import threading
+
+        self._lock = threading.Lock()
         self._step = jax.jit(
             lambda s, pin: _sketch_step_impl(s, pin, depth),
             donate_argnums=(0,),
@@ -177,10 +185,16 @@ class SketchLimiter:
         from gubernator_tpu.hashing import fnv1a_64_batch, pack_keys
 
         padded, lengths = pack_keys(keys)
-        h1 = fnv1a_64_batch(padded, lengths)
+        return self._indexes_hashed(fnv1a_64_batch(padded, lengths))
+
+    def _indexes_hashed(self, h1: np.ndarray) -> np.ndarray:
+        """Row indexes from precomputed fnv1a-64 key hashes (the wire
+        codec already hashed every key — no re-hash, no key
+        materialization on the served path)."""
+        h1 = np.asarray(h1, dtype=np.uint64)
         # Second hash: one multiply-xor over h1 (splitmix-style).
         h2 = (h1 ^ (h1 >> np.uint64(33))) * self._seed
-        rows = np.empty((self.depth, len(keys)), dtype=np.int64)
+        rows = np.empty((self.depth, len(h1)), dtype=np.int64)
         for r in range(self.depth):
             rows[r] = (
                 (h1 + np.uint64(r) * h2) % np.uint64(self.width)
@@ -193,11 +207,17 @@ class SketchLimiter:
         hits: np.ndarray,
         limit: np.ndarray,
         now_ms: int,
+        *,
+        key_hashes: Optional[np.ndarray] = None,  # fnv1a-64 per key
     ) -> Tuple[np.ndarray, np.ndarray]:
-        n = len(keys)
+        n = len(key_hashes) if key_hashes is not None else len(keys)
         if n == 0:
             return np.zeros(0, dtype=bool), np.zeros(0, dtype=np.int64)
-        rows = self._indexes(keys)
+        rows = (
+            self._indexes_hashed(key_hashes)
+            if key_hashes is not None
+            else self._indexes(keys)
+        )
         hits64 = np.asarray(hits, dtype=np.int64)
 
         size = 64
@@ -232,8 +252,9 @@ class SketchLimiter:
             pin[2 + 3 * r + 1, :m] = sums.astype(np.int32)
             pin[2 + 3 * r + 2, :n] = inv.astype(np.int32)
 
-        self._state, out = self._step(self._state, jnp.asarray(pin))
-        arr = np.asarray(out)
+        with self._lock:
+            self._state, out = self._step(self._state, jnp.asarray(pin))
+            arr = np.asarray(out)
         est = (arr[0, :n].astype(np.int64) << 32) | (
             arr[1, :n].astype(np.int64) & 0xFFFFFFFF
         )

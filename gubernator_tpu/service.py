@@ -55,12 +55,15 @@ log = logging.getLogger("gubernator_tpu.service")
 # core/engine.py note).
 _GLOBAL_I = int(Behavior.GLOBAL)
 _MULTI_REGION_I = int(Behavior.MULTI_REGION)
+_SKETCH_I = int(Behavior.SKETCH)
 
 # Behaviors that need the dataclass path: GLOBAL (status cache + async
 # queues), MULTI_REGION (region queues), Gregorian durations (per-item
-# civil-time validation with error-in-response).
+# civil-time validation with error-in-response), SKETCH (the
+# approximate limiter, not the bucket engine).
 COLUMNAR_DISQUALIFIERS = (
     _GLOBAL_I | _MULTI_REGION_I | int(Behavior.DURATION_IS_GREGORIAN)
+    | _SKETCH_I
 )
 
 HEALTHY = "healthy"
@@ -277,6 +280,7 @@ class V1Instance:
             "columnar": 0,  # items served via the columnar wire fast path
             "forward": 0,
             "global": 0,
+            "sketch": 0,  # items decided by the approximate limiter
             "check_errors": 0,
             "async_retries": 0,
         }
@@ -292,6 +296,45 @@ class V1Instance:
             from gubernator_tpu.net.wire_window import WireWindow
 
             self._wire_window = WireWindow(engine, conf.local_batch_wait)
+        # Count-min-sketch approximate limiter (Behavior.SKETCH),
+        # created lazily on first flagged request (GUBER_SKETCH_*).
+        self._sketch = None
+        self._sketch_lock = threading.Lock()
+
+    def sketch(self):
+        if self._sketch is None:
+            with self._sketch_lock:
+                if self._sketch is None:
+                    from gubernator_tpu.ops.sketch import SketchLimiter
+
+                    self._sketch = SketchLimiter(
+                        window_ms=getattr(self.conf, "sketch_window_ms", 1000),
+                        depth=getattr(self.conf, "sketch_depth", 4),
+                        width=getattr(self.conf, "sketch_width", 1 << 20),
+                    )
+        return self._sketch
+
+    def _apply_sketch(
+        self, keys, hits, limit, now_ms: int, key_hashes=None
+    ):
+        """Run one sketch batch → (status, limit, remaining, reset)
+        columns.  remaining = limit - estimate (floored at 0); reset =
+        end of the current sketch window."""
+        sk = self.sketch()
+        over, est = sk.apply(
+            keys, np.asarray(hits, dtype=np.int64),
+            np.asarray(limit, dtype=np.int64), now_ms,
+            key_hashes=key_hashes,
+        )
+        limit64 = np.asarray(limit, dtype=np.int64)
+        remaining = np.maximum(limit64 - est, 0)
+        reset = np.full(
+            len(est),
+            (now_ms // sk.window_ms + 1) * sk.window_ms,
+            dtype=np.int64,
+        )
+        self.counters["sketch"] += len(est)
+        return over.astype(np.int32), limit64, remaining, reset
 
     # ------------------------------------------------------------------
     # Public API (reference: proto/gubernator.proto service V1)
@@ -317,8 +360,11 @@ class V1Instance:
         responses: List[Optional[RateLimitResp]] = [None] * n
         now_ms = self.engine.clock.now_ms()
 
-        # 1. validate (reference: gubernator.go:231-243)
+        # 1. validate (reference: gubernator.go:231-243).  Sketch items
+        # split off here: the approximate limiter is node-local, so
+        # they must not pay the ring lookup below.
         candidates: List[int] = []
+        sketch_idx: List[int] = []
         for i, r in enumerate(requests):
             if not r.unique_key:
                 self.counters["check_errors"] += 1
@@ -326,6 +372,8 @@ class V1Instance:
             elif not r.name:
                 self.counters["check_errors"] += 1
                 responses[i] = RateLimitResp(error="field 'namespace' cannot be empty")
+            elif int(r.behavior) & _SKETCH_I:
+                sketch_idx.append(i)
             else:
                 candidates.append(i)
 
@@ -373,6 +421,28 @@ class V1Instance:
                     # Cache miss: process locally as a NO_BATCHING copy
                     # (reference: gubernator.go:455-460).
                     global_miss.append((i, owner))
+
+        # 3b. sketch items: one approximate-limiter batch (node-local;
+        # MULTI_REGION-flagged sketch items still queue region
+        # replication so remote DCs' sketches see the hits).
+        if sketch_idx:
+            s_keys = [requests[i].hash_key().encode() for i in sketch_idx]
+            st, lim, rem, rst = self._apply_sketch(
+                s_keys,
+                [requests[i].hits for i in sketch_idx],
+                [requests[i].limit for i in sketch_idx],
+                now_ms,
+            )
+            status_of = {int(s): s for s in Status}
+            for j, i in enumerate(sketch_idx):
+                responses[i] = RateLimitResp(
+                    status=status_of[int(st[j])],
+                    limit=int(lim[j]),
+                    remaining=int(rem[j]),
+                    reset_time=int(rst[j]),
+                )
+                if int(requests[i].behavior) & _MULTI_REGION_I:
+                    self.multi_region_mgr.queue_hits(requests[i])
 
         # 4. local + global-miss items: ONE engine batch
         engine_items = local_idx + [i for i, _ in global_miss]
@@ -514,14 +584,29 @@ class V1Instance:
 
         if wire_codec.load() is None:
             return None
-        # Decode with GLOBAL allowed: all-GLOBAL batches have their own
-        # columnar route below; mixed batches decline to the pb path.
+        # Decode with GLOBAL/SKETCH allowed: all-GLOBAL and all-SKETCH
+        # batches have their own columnar routes below; mixed batches
+        # decline to the pb path.
         dec = wire_codec.decode_reqs(
             bytes(raw), MAX_BATCH_SIZE,
-            COLUMNAR_DISQUALIFIERS & ~_GLOBAL_I,
+            COLUMNAR_DISQUALIFIERS & ~_GLOBAL_I & ~_SKETCH_I,
         )
         if dec is None:
             return None
+        s_mask = (dec.behavior & _SKETCH_I) != 0
+        if s_mask.any():
+            if not s_mask.all():
+                return None  # mixed batch → pb path partitions it
+            # (MULTI_REGION+SKETCH can't reach here: the decode mask
+            # still disqualifies MULTI_REGION → pb path replicates.)
+            # Approximate limiter straight off the decoded hashes — no
+            # key materialization, no engine dispatch.
+            st, lim, rem, rst = self._apply_sketch(
+                None, dec.hits, dec.limit,
+                self.engine.clock.now_ms(), key_hashes=dec.fnv1a,
+            )
+            self.counters["columnar"] += dec.n
+            return wire_codec.encode_resps(st, lim, rem, rst)
         g_mask = (dec.behavior & _GLOBAL_I) != 0
         if g_mask.any():
             if not g_mask.all():

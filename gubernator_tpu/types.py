@@ -35,6 +35,12 @@ class Behavior(enum.IntFlag):
     DURATION_IS_GREGORIAN = 4
     RESET_REMAINING = 8
     MULTI_REGION = 16
+    # Extension (no reference counterpart): route to the node-local
+    # count-min-sketch approximate limiter — O(1) memory at unbounded
+    # key cardinality, one-sided (never-under-count) error
+    # (ops/sketch.py; BASELINE config 5).  Approximate and node-local
+    # by design: no ownership routing, no peer forwarding.
+    SKETCH = 32
 
 
 class Status(enum.IntEnum):

@@ -71,6 +71,15 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "1",
         "BENCH_CAPACITY": str(1 << 17),
     },
+    # BASELINE config 5: count-min-sketch approximate limiter
+    # (Behavior.SKETCH) over the wire — unbounded key cardinality in
+    # O(1) memory, one-sided error (ops/sketch.py).
+    "sketch": {
+        "BENCH_MODE": "sketch",
+        "BENCH_BATCH": "1000",
+        "BENCH_KEYS": "10000000",
+        "BENCH_CAPACITY": str(1 << 17),
+    },
     # The 100M-slot HBM proof (BASELINE config 4 at full scale):
     # 19 arrays x 4B x 100M = 7.6GB of device state on one v5e chip.
     # TPU-only (the CPU fallback would also allocate 7.6GB, fine on

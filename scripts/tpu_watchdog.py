@@ -46,6 +46,7 @@ BENCH_ORDER = [
     "global4hot",
     "global4",
     "herd",
+    "sketch",
 ]
 
 PROBE_SRC = (

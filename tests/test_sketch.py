@@ -93,3 +93,103 @@ def test_hot_key_saturates_instead_of_wrapping():
     over, est = lim.apply(keys, hits, limit, 10)
     assert (est >= 2**31 - 1).all()
     assert over.all()
+
+
+def test_sketch_behavior_end_to_end_grpc():
+    """Behavior.SKETCH routes decisions to the approximate limiter over
+    real gRPC — both the native wire path (all-sketch batch) and the pb
+    dataclass path (mixed batch) — with sketch semantics: estimates
+    never under-count, OVER_LIMIT when estimate exceeds limit."""
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.cluster.harness import cluster_behaviors
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.types import Behavior, RateLimitReq, Status
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        behaviors=cluster_behaviors(),
+        cache_size=2048,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        sketch_window_ms=3_600_000,  # one long window: deterministic
+        sketch_depth=4,
+        sketch_width=1 << 16,
+    )
+    d = spawn_daemon(conf)
+    try:
+        with V1Client(d.grpc_address) as c:
+            # All-sketch batch (native wire route): 5 hits on one key.
+            rs = c.get_rate_limits(
+                [
+                    RateLimitReq(
+                        name="sk", unique_key="hot", hits=1, limit=3,
+                        duration=60_000, behavior=int(Behavior.SKETCH),
+                    )
+                    for _ in range(5)
+                ],
+                timeout=30,
+            )
+            # Batch semantics: every duplicate sees the post-batch
+            # total estimate (5 > 3 -> OVER, remaining 0).
+            assert all(r.status == Status.OVER_LIMIT for r in rs), rs
+            assert all(r.remaining == 0 for r in rs)
+            assert all(r.limit == 3 for r in rs)
+            assert all(r.reset_time > 0 for r in rs)
+            # A different key is unaffected (sketch width is ample).
+            r2 = c.get_rate_limits(
+                [RateLimitReq(name="sk", unique_key="cold", hits=1,
+                              limit=3, duration=60_000,
+                              behavior=int(Behavior.SKETCH))],
+                timeout=30,
+            )[0]
+            assert r2.status == Status.UNDER_LIMIT and r2.remaining == 2
+            # Mixed batch (pb path): sketch + bucket items coexist and
+            # route independently.
+            rs = c.get_rate_limits(
+                [
+                    RateLimitReq(name="sk", unique_key="hot", hits=0,
+                                 limit=3, duration=60_000,
+                                 behavior=int(Behavior.SKETCH)),
+                    RateLimitReq(name="bucket", unique_key="b1", hits=1,
+                                 limit=10, duration=60_000),
+                ],
+                timeout=30,
+            )
+            assert rs[0].status == Status.OVER_LIMIT  # estimate >= 5
+            assert rs[1].remaining == 9  # exact engine decision
+        assert d.instance.counters["sketch"] >= 7
+    finally:
+        d.close()
+
+
+def test_sketch_concurrent_apply_exact_totals():
+    """Racing apply() calls must serialize on the limiter's lock: the
+    donated-state step would otherwise see deleted buffers or drop
+    updates (code-review r4).  Total estimate after N concurrent
+    single-hit batches on one key == N exactly (ample width)."""
+    import threading
+
+    lim = SketchLimiter(window_ms=3_600_000, depth=2, width=1 << 14)
+    n_threads, per_thread = 8, 25
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(per_thread):
+                lim.apply([b"conc"], np.ones(1, dtype=np.int64),
+                          np.full(1, 10**9, dtype=np.int64), 0)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs, errs
+    _, est = lim.apply([b"conc"], np.zeros(1, dtype=np.int64),
+                       np.full(1, 10**9, dtype=np.int64), 0)
+    assert int(est[0]) == n_threads * per_thread
