@@ -122,6 +122,22 @@ class IntervalBatcher(Generic[K, V]):
                     "batcher flush failed"
                 )
 
+    def flush_now(self) -> None:
+        """Flush everything queued immediately, on the caller's thread
+        (operational drains + deterministic tests)."""
+        with self._lock:
+            batch = self._items
+            self._items = {}
+            chunks = self._chunks
+            self._chunks = []
+            self._chunk_count = 0
+        if not batch and not chunks:
+            return
+        if self._chunked:
+            self._flush(batch, chunks)
+        else:
+            self._flush(batch)
+
     def close(self, timeout: float = 5.0) -> None:
         """Stop, flushing anything still queued."""
         with self._lock:

@@ -417,6 +417,13 @@ class GlobalManager:
             )
         return globals_
 
+    def flush_now(self) -> None:
+        """Synchronously drain both windows: forward aggregated hits
+        to owners, then broadcast re-read state to peers.  Bounds the
+        eventually-consistent lag on demand (graceful drains, tests)."""
+        self._hits.flush_now()
+        self._updates.flush_now()
+
     def close(self) -> None:
         self._hits.close()
         self._updates.close()

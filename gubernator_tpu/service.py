@@ -281,6 +281,13 @@ class V1Instance:
             "forward": 0,
             "global": 0,
             "sketch": 0,  # items decided by the approximate limiter
+            # GLOBAL items served by a LOCAL eventually-consistent copy
+            # (status-cache miss on a non-owner).  This is the source
+            # of GLOBAL's bounded over-admission: worst case each
+            # node's local copy admits up to `limit` before the first
+            # broadcast converges the cache (see README, reference:
+            # architecture.md:46-74).
+            "global_miss_local": 0,
             "check_errors": 0,
             "async_retries": 0,
         }
@@ -421,6 +428,7 @@ class V1Instance:
                     # Cache miss: process locally as a NO_BATCHING copy
                     # (reference: gubernator.go:455-460).
                     global_miss.append((i, owner))
+            self.counters["global_miss_local"] += len(global_miss)
 
         # 3b. sketch items: one approximate-limiter batch (node-local;
         # MULTI_REGION-flagged sketch items still queue region
@@ -718,6 +726,7 @@ class V1Instance:
             remaining[hidx] = c_rem[hit]
             reset[hidx] = c_rst[hit]
             if len(midx):
+                self.counters["global_miss_local"] += len(midx)
                 eng_parts.append(midx)
             # Every non-owned response echoes its owner address
             # (reference: gubernator.go:448-452).

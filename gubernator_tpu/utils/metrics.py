@@ -87,6 +87,17 @@ class InstanceCollector(Collector):
         c.add_metric(["local"], inst.counters["local"])
         c.add_metric(["forward"], inst.counters["forward"])
         c.add_metric(["global"], inst.counters["global"])
+        c.add_metric(["sketch"], inst.counters.get("sketch", 0))
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_global_miss_local",
+            "GLOBAL items served by a LOCAL eventually-consistent copy "
+            "(status-cache miss on a non-owner) — the source of "
+            "GLOBAL's bounded over-admission (<= n_nodes * limit per "
+            "broadcast lag window).",
+        )
+        c.add_metric([], inst.counters.get("global_miss_local", 0))
         yield c
 
         c = CounterMetricFamily(

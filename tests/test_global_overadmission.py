@@ -1,0 +1,80 @@
+"""GLOBAL's eventually-consistent over-admission is BOUNDED
+(VERDICT r3 #9; reference trade-off: architecture.md:46-74).
+
+Worst case: with the broadcast fully lagged, every node's local copy
+independently admits up to `limit` — total admitted <= n_nodes * limit.
+One hits-forward + broadcast round trip converges the status cache,
+after which non-owners reject from the cached OVER status and admit
+nothing further.  Deterministic via GlobalManager.flush_now() and
+effectively-infinite sync windows.
+"""
+
+import numpy as np
+
+from gubernator_tpu.cluster.harness import ClusterHarness, cluster_behaviors
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.types import Behavior, RateLimitReq, Status
+
+
+def _greq(key, hits=1, limit=50):
+    return RateLimitReq(
+        name="oa", unique_key=key, hits=hits, limit=limit,
+        duration=3_600_000, behavior=int(Behavior.GLOBAL),
+    )
+
+
+def test_global_over_admission_bounded_and_converges(frozen_clock):
+    # Windows that never fire on their own: the test drives every sync
+    # explicitly, so the lag (and thus over-admission) is exact.
+    behaviors = BehaviorConfig(
+        global_sync_wait=3600.0, global_batch_limit=10**9,
+        batch_wait=cluster_behaviors().batch_wait,
+    )
+    h = ClusterHarness().start(
+        2, clock=frozen_clock, behaviors=behaviors, cache_size=4096
+    )
+    try:
+        limit = 50
+        inst0 = h.daemon_at(0).instance
+        inst1 = h.daemon_at(1).instance
+        # A key owned by node 1 (so node 0 is the non-owner).
+        key = next(
+            f"k{i}" for i in range(500)
+            if not inst0.get_peer(_greq(f"k{i}").hash_key()).info.is_owner
+        )
+
+        def admitted(inst, n):
+            count = 0
+            for _ in range(n):
+                r = inst.get_rate_limits([_greq(key, limit=limit)])[0]
+                assert r.error == ""
+                if r.status == Status.UNDER_LIMIT:
+                    count += 1
+            return count
+
+        # Phase 1 — broadcast fully lagged: each node's local copy
+        # admits EXACTLY `limit`, so the cluster-wide worst case is
+        # n_nodes * limit, not unbounded.
+        a0 = admitted(inst0, 2 * limit)  # non-owner local-miss copies
+        a1 = admitted(inst1, 2 * limit)  # owner authoritative
+        assert a0 == limit, f"non-owner admitted {a0}, bound {limit}"
+        assert a1 == limit, f"owner admitted {a1}, bound {limit}"
+        assert inst0.counters["global_miss_local"] >= 2 * limit
+
+        # Phase 2 — one explicit sync round: non-owner forwards its
+        # aggregated hits, the owner broadcasts authoritative status.
+        inst0.global_mgr.flush_now()  # hits → owner
+        inst1.global_mgr.flush_now()  # broadcast → caches
+        # The owner saw its own 100 hits + the forwarded 100: hard over
+        # limit; its broadcast status must be OVER with remaining 0.
+
+        # Phase 3 — converged: the non-owner now answers OVER from the
+        # cache and admits NOTHING further.
+        a0_post = admitted(inst0, 50)
+        assert a0_post == 0, f"post-convergence admits: {a0_post}"
+        # And the responses come from the cache, not local copies.
+        before = inst0.counters["global_miss_local"]
+        admitted(inst0, 20)
+        assert inst0.counters["global_miss_local"] == before
+    finally:
+        h.stop()

@@ -1,0 +1,129 @@
+"""Sharded-engine concurrency storm over the WIRE columnar path
+(VERDICT r3 weak 7): racing raw-bytes wire clients against the mesh
+engine — the single-device analog lives in test_concurrency.py.
+
+Invariants: no lost/misattributed responses, exact accounting for
+shared keys across racing columnar (serve_wire_bytes) and dataclass
+(get_rate_limits) callers, hot-key collapse included.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.net import wire_codec
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitReq, Status
+
+N_THREADS = 8
+ROUNDS = 12
+
+
+@pytest.fixture
+def sharded_daemon(frozen_clock):
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=8 * 4096,
+        peer_discovery_type="none",
+        device_count=8,  # virtual CPU mesh (tests/conftest.py)
+        sweep_interval=0.0,
+    )
+    d = spawn_daemon(conf, clock=frozen_clock)
+    assert hasattr(d.instance.engine, "tables"), "expected sharded engine"
+    yield d
+    d.close()
+
+
+def _payload(tid, rep, shared_hits=3, privates=20):
+    reqs = [
+        pb.RateLimitReq(
+            name="storm", unique_key="shared", hits=1,
+            limit=10**9, duration=3_600_000,
+        )
+        for _ in range(shared_hits)
+    ] + [
+        pb.RateLimitReq(
+            name="storm", unique_key=f"p{tid}_{rep}_{i}", hits=1,
+            limit=10**9, duration=3_600_000,
+        )
+        for i in range(privates)
+    ]
+    return pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+
+
+@pytest.mark.skipif(
+    wire_codec.load() is None, reason="native codec unavailable"
+)
+def test_sharded_wire_storm_exact_accounting(sharded_daemon):
+    """Racing wire-bytes clients (columnar, route_hashes) + dataclass
+    callers on the SHARDED engine: the shared key consumes exactly the
+    sum of all hits; every response decodes with no errors."""
+    d = sharded_daemon
+    inst = d.instance
+    errs = []
+
+    def wire_worker(tid):
+        try:
+            for rep in range(ROUNDS):
+                out = inst.serve_wire_bytes(_payload(tid, rep))
+                assert out is not None, "columnar wire path must engage"
+                resp = pb.GetRateLimitsResp.FromString(out)
+                assert len(resp.responses) == 23
+                for r in resp.responses:
+                    assert r.error == ""
+                    assert r.status == int(Status.UNDER_LIMIT)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def dataclass_worker(tid):
+        try:
+            for rep in range(ROUNDS):
+                # Duplicate shared keys inside one batch: collapse path.
+                reqs = [
+                    RateLimitReq(
+                        name="storm", unique_key="shared", hits=1,
+                        limit=10**9, duration=3_600_000,
+                    )
+                ] * 2 + [
+                    RateLimitReq(
+                        name="storm", unique_key=f"d{tid}_{rep}", hits=1,
+                        limit=10**9, duration=3_600_000,
+                    )
+                ]
+                resps = inst.get_rate_limits(reqs)
+                assert all(r.error == "" for r in resps)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=wire_worker, args=(t,))
+        for t in range(N_THREADS // 2)
+    ] + [
+        threading.Thread(target=dataclass_worker, args=(t,))
+        for t in range(N_THREADS // 2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errs, errs[:2]
+    assert all(not t.is_alive() for t in threads)
+
+    # Exact accounting: wire workers 4*12*3 + dataclass workers 4*12*2.
+    expected = (N_THREADS // 2) * ROUNDS * 3 + (N_THREADS // 2) * ROUNDS * 2
+    probe = inst.get_rate_limits(
+        [
+            RateLimitReq(
+                name="storm", unique_key="shared", hits=0,
+                limit=10**9, duration=3_600_000,
+            )
+        ]
+    )[0]
+    assert 10**9 - probe.remaining == expected, (
+        f"shared consumed {10**9 - probe.remaining}, want {expected}"
+    )
