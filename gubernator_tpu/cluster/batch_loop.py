@@ -49,6 +49,12 @@ class IntervalBatcher(Generic[K, V]):
         self._chunks: list = []
         self._chunk_count = 0
         self._lock = threading.Lock()
+        # Serializes flush EXECUTION (the queue lock only guards the
+        # swap): flush_now must not race the batcher thread's in-flight
+        # flush — two concurrent broadcast flushes could deliver a
+        # staler state snapshot after a fresher one, regressing peer
+        # caches — and must not return before that flush completes.
+        self._flush_lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closing = False
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
@@ -111,10 +117,11 @@ class IntervalBatcher(Generic[K, V]):
                 self._chunks = []
                 self._chunk_count = 0
             try:
-                if self._chunked:
-                    self._flush(batch, chunks)
-                else:
-                    self._flush(batch)
+                with self._flush_lock:
+                    if self._chunked:
+                        self._flush(batch, chunks)
+                    else:
+                        self._flush(batch)
             except Exception:  # noqa: BLE001 — loop must survive flush errors
                 import logging
 
@@ -124,19 +131,22 @@ class IntervalBatcher(Generic[K, V]):
 
     def flush_now(self) -> None:
         """Flush everything queued immediately, on the caller's thread
-        (operational drains + deterministic tests)."""
+        (operational drains + deterministic tests).  Returns only after
+        any in-flight batcher-thread flush AND this drain complete
+        (the shared _flush_lock serializes both)."""
         with self._lock:
             batch = self._items
             self._items = {}
             chunks = self._chunks
             self._chunks = []
             self._chunk_count = 0
-        if not batch and not chunks:
-            return
-        if self._chunked:
-            self._flush(batch, chunks)
-        else:
-            self._flush(batch)
+        with self._flush_lock:
+            if not batch and not chunks:
+                return
+            if self._chunked:
+                self._flush(batch, chunks)
+            else:
+                self._flush(batch)
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop, flushing anything still queued."""
