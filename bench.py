@@ -247,9 +247,10 @@ def _run_engine(np, platform: str) -> dict:
 
     for i in range(WARMUP_BATCHES):
         engine.apply_columnar(**batches[i % len(batches)])
-    # Warm the readback-combiner stack programs for this batch width so
-    # the pipelined throughput loop never pays an XLA compile
-    # mid-measurement (core/readback.py).
+    # Warm the readback-combiner stack programs AND the step pump's
+    # scan families for this batch width so the pipelined throughput
+    # loop never pays an XLA compile mid-measurement
+    # (core/readback.py, core/pump.py).
     import jax.numpy as jnp
 
     from gubernator_tpu.core.engine import _pad_size
@@ -258,6 +259,8 @@ def _run_engine(np, platform: str) -> dict:
     engine.readback.warmup_stacks(
         (PACKED_OUT_ROWS, _pad_size(BATCH)), jnp.int32
     )
+    if engine._pump is not None:
+        engine._pump.warmup(_pad_size(BATCH))
 
     # Latency: synchronous dispatch→readback per batch (what one
     # 500µs serving window pays end to end).  Target: p99 < 2ms

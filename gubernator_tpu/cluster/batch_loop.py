@@ -116,18 +116,25 @@ class IntervalBatcher(Generic[K, V]):
                 chunks = self._chunks
                 self._chunks = []
                 self._chunk_count = 0
+                # Hand-over-hand: take the flush lock BEFORE releasing
+                # the queue lock, so snapshot order == flush order (a
+                # later flush_now snapshot must never broadcast before
+                # this older one — lock order is always _lock →
+                # _flush_lock, so no deadlock).
+                self._flush_lock.acquire()
             try:
-                with self._flush_lock:
-                    if self._chunked:
-                        self._flush(batch, chunks)
-                    else:
-                        self._flush(batch)
+                if self._chunked:
+                    self._flush(batch, chunks)
+                else:
+                    self._flush(batch)
             except Exception:  # noqa: BLE001 — loop must survive flush errors
                 import logging
 
                 logging.getLogger("gubernator_tpu").exception(
                     "batcher flush failed"
                 )
+            finally:
+                self._flush_lock.release()
 
     def flush_now(self) -> None:
         """Flush everything queued immediately, on the caller's thread
@@ -140,13 +147,18 @@ class IntervalBatcher(Generic[K, V]):
             chunks = self._chunks
             self._chunks = []
             self._chunk_count = 0
-        with self._flush_lock:
+            # Same hand-over-hand as _run: snapshot order == flush
+            # order across the batcher thread and drain callers.
+            self._flush_lock.acquire()
+        try:
             if not batch and not chunks:
                 return
             if self._chunked:
                 self._flush(batch, chunks)
             else:
                 self._flush(batch)
+        finally:
+            self._flush_lock.release()
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop, flushing anything still queued."""

@@ -1,0 +1,51 @@
+"""Step pump failure paths (core/pump.py): a dispatch exception must
+fail every swapped-out ticket closed (no fetch() hangs or AttributeError
+masking), and the queue keeps working afterwards."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.core.engine import DecisionEngine
+
+
+def _cols(n, start=0):
+    return dict(
+        algo=np.zeros(n, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.ones(n, dtype=np.int64),
+        limit=np.full(n, 1000, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+    )
+
+
+def test_flush_exception_fails_tickets_closed():
+    eng = DecisionEngine(capacity=2048)
+    if eng._pump is None:
+        pytest.skip("pump unavailable")
+    p1 = eng.apply_columnar([b"a%d" % i for i in range(10)], **_cols(10),
+                            want_async=True)
+    p2 = eng.apply_columnar([b"b%d" % i for i in range(10)], **_cols(10),
+                            want_async=True)
+
+    boom = RuntimeError("injected dispatch failure")
+    orig = eng._pump._flush_group
+
+    def failing(group):
+        raise boom
+
+    eng._pump._flush_group = failing
+    with pytest.raises(RuntimeError, match="injected"):
+        with eng._lock:
+            eng._pump.flush_locked()
+    eng._pump._flush_group = orig
+
+    # Both queued batches fail closed with the REAL error, not an
+    # AttributeError on group=None.
+    for p in (p1, p2):
+        with pytest.raises(RuntimeError, match="injected"):
+            p.get()
+
+    # The pump (and engine) keep serving after the failure.
+    out = eng.apply_columnar([b"c%d" % i for i in range(10)], **_cols(10))
+    assert (np.asarray(out[2]) == 999).all()
