@@ -32,46 +32,24 @@ if os.environ.get("GUBERNATOR_TPU_X64", "1") != "0":  # pragma: no branch
 if os.environ.get("GUBERNATOR_TPU_COMPILE_CACHE", "1") != "0":
     import jax
 
-    def _cpu_tag() -> str:
-        """Fingerprint of this host's CPU features.  XLA:CPU AOT
-        executables embed the COMPILE machine's feature set; loading
-        one on a host missing those features is a fatal abort (seen
-        when the cache directory outlives a VM migration to a
-        different CPU model).  Partitioning the cache per CPU
-        fingerprint makes foreign entries unreachable."""
-        import hashlib
-        import platform
-
-        try:
-            with open("/proc/cpuinfo") as f:
-                for line in f:
-                    if line.startswith(("flags", "Features")):
-                        return hashlib.sha256(
-                            line.encode()
-                        ).hexdigest()[:10]
-        except OSError:
-            pass
-        return platform.machine() or "unknown"
-
+    # NOTE: the cache is for the multi-second TPU compiles; whenever
+    # the effective backend turns out to be CPU it is switched OFF
+    # (platform_guard.disable_cpu_persistent_cache) — serializing some
+    # XLA:CPU executables segfaults jaxlib's AOT export, and entries
+    # written by a different CPU model abort on load.
     _repo_root = os.path.dirname(os.path.dirname(__file__))
-    _cache_dir = os.environ.get("GUBERNATOR_TPU_COMPILE_CACHE_DIR")
-    if not _cache_dir:
-        # Default locations get the per-CPU partition; an EXPLICIT
-        # override is used verbatim (operators may prewarm it).
-        _cache_base = (
-            os.path.join(_repo_root, ".jax_cache")
-            # Source checkout: cache next to the code.  Installed
-            # package: the parent is site-packages — use the user
-            # cache dir instead.
-            if os.path.isdir(os.path.join(_repo_root, ".git"))
-            else os.path.join(
-                os.environ.get("XDG_CACHE_HOME")
-                or os.path.join(os.path.expanduser("~"), ".cache"),
-                "gubernator_tpu",
-                "jax",
-            )
+    _cache_dir = os.environ.get("GUBERNATOR_TPU_COMPILE_CACHE_DIR") or (
+        os.path.join(_repo_root, ".jax_cache")
+        # Source checkout: cache next to the code.  Installed package:
+        # the parent is site-packages — use the user cache dir instead.
+        if os.path.isdir(os.path.join(_repo_root, ".git"))
+        else os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "gubernator_tpu",
+            "jax",
         )
-        _cache_dir = os.path.join(_cache_base, _cpu_tag())
+    )
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -49,12 +49,15 @@ class IntervalBatcher(Generic[K, V]):
         self._chunks: list = []
         self._chunk_count = 0
         self._lock = threading.Lock()
-        # Serializes flush EXECUTION (the queue lock only guards the
-        # swap): flush_now must not race the batcher thread's in-flight
-        # flush — two concurrent broadcast flushes could deliver a
-        # staler state snapshot after a fresher one, regressing peer
-        # caches — and must not return before that flush completes.
-        self._flush_lock = threading.Lock()
+        # Flush ORDERING without blocking producers: each snapshot
+        # takes a turn number under the queue lock; flushes then run
+        # strictly in turn order, coordinated on a separate condition
+        # so add()/add_many()/add_chunk() never wait on an in-flight
+        # flush (a later flush_now snapshot broadcasting before an
+        # older batcher snapshot would regress peer caches).
+        self._turn_cv = threading.Condition(threading.Lock())
+        self._next_turn = 0  # next turn number to hand out
+        self._done_turn = 0  # turns fully flushed
         self._cv = threading.Condition(self._lock)
         self._closing = False
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
@@ -116,49 +119,54 @@ class IntervalBatcher(Generic[K, V]):
                 chunks = self._chunks
                 self._chunks = []
                 self._chunk_count = 0
-                # Hand-over-hand: take the flush lock BEFORE releasing
-                # the queue lock, so snapshot order == flush order (a
-                # later flush_now snapshot must never broadcast before
-                # this older one — lock order is always _lock →
-                # _flush_lock, so no deadlock).
-                self._flush_lock.acquire()
+                turn = self._take_turn()
             try:
-                if self._chunked:
-                    self._flush(batch, chunks)
-                else:
-                    self._flush(batch)
+                self._flush_in_turn(turn, batch, chunks)
             except Exception:  # noqa: BLE001 — loop must survive flush errors
                 import logging
 
                 logging.getLogger("gubernator_tpu").exception(
                     "batcher flush failed"
                 )
-            finally:
-                self._flush_lock.release()
+
+    def _take_turn(self) -> int:
+        """Reserve the next flush turn.  Caller holds the queue lock —
+        the snapshot and its turn number are taken atomically."""
+        with self._turn_cv:
+            turn = self._next_turn
+            self._next_turn += 1
+        return turn
+
+    def _flush_in_turn(self, turn: int, batch, chunks) -> None:
+        """Run the flush when (and only when) its turn comes up, so
+        snapshot order == delivery order; always advances the turn."""
+        with self._turn_cv:
+            while self._done_turn != turn:
+                self._turn_cv.wait()
+        try:
+            if batch or chunks:
+                if self._chunked:
+                    self._flush(batch, chunks)
+                else:
+                    self._flush(batch)
+        finally:
+            with self._turn_cv:
+                self._done_turn = turn + 1
+                self._turn_cv.notify_all()
 
     def flush_now(self) -> None:
         """Flush everything queued immediately, on the caller's thread
         (operational drains + deterministic tests).  Returns only after
-        any in-flight batcher-thread flush AND this drain complete
-        (the shared _flush_lock serializes both)."""
+        every OLDER snapshot's flush AND this drain complete (turn
+        ordering); producers never wait on flush execution."""
         with self._lock:
             batch = self._items
             self._items = {}
             chunks = self._chunks
             self._chunks = []
             self._chunk_count = 0
-            # Same hand-over-hand as _run: snapshot order == flush
-            # order across the batcher thread and drain callers.
-            self._flush_lock.acquire()
-        try:
-            if not batch and not chunks:
-                return
-            if self._chunked:
-                self._flush(batch, chunks)
-            else:
-                self._flush(batch)
-        finally:
-            self._flush_lock.release()
+            turn = self._take_turn()
+        self._flush_in_turn(turn, batch, chunks)
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop, flushing anything still queued."""

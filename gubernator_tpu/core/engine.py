@@ -308,6 +308,11 @@ class DecisionEngine:
                 "are int64); do not set GUBERNATOR_TPU_X64=0 when using "
                 "the engine"
             )
+        # Persisting XLA:CPU executables is unsafe; no-op on TPU (see
+        # platform_guard.disable_cpu_persistent_cache).
+        from gubernator_tpu.platform_guard import disable_cpu_persistent_cache
+
+        disable_cpu_persistent_cache()
         self.capacity = capacity
         self.clock = clock
         self._device = device

@@ -81,6 +81,9 @@ class ShardedDecisionEngine:
     ):
         if not jax.config.jax_enable_x64:
             raise RuntimeError("gubernator_tpu requires jax x64")
+        from gubernator_tpu.platform_guard import disable_cpu_persistent_cache
+
+        disable_cpu_persistent_cache()
         self.store = store
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.shape[KEYS_AXIS]

@@ -41,3 +41,35 @@ def force_cpu_platform(n_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    disable_cpu_persistent_cache()
+
+
+def disable_cpu_persistent_cache() -> None:
+    """Turn the persistent compile cache OFF when the effective
+    backend is CPU.
+
+    Serializing certain XLA:CPU executables (the pump's donated
+    lax.scan programs) SEGFAULTS in jaxlib's AOT export, and loading
+    entries written by a different CPU model is a fatal abort — both
+    hit this build mid-suite.  The cache exists for the multi-second
+    TPU compiles; CPU compiles are cheap, so the safe configuration is
+    cache-off whenever the effective backend is CPU.  Called by
+    force_cpu_platform and by engine construction (which also covers
+    the in-process wedged-TPU fallback path).
+
+    Updating the config alone is NOT enough once anything compiled:
+    jax memoizes the cache-enabled decision — reset it too."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001 — older jax without the knob
+        pass
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API; best effort
+        pass
