@@ -221,7 +221,18 @@ class StepPump:
     def warmup(self, width: int) -> None:
         """Precompile the multi-step scan families {2,4,8,16} at one
         width — general AND uniform formats — plus the single uniform
-        step (engine warmup calls this per ladder width)."""
+        step (engine warmup calls this per ladder width).
+
+        Skipped on the CPU backend: the pump is disabled there in
+        production (no RPCs to amortize), and this rapid-fire ~12
+        scan-compile sequence per daemon spawn is where the full test
+        suite intermittently segfaulted inside XLA:CPU's compiler —
+        the same programs compile lazily without issue when tests
+        force GUBER_PUMP=1."""
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return
         from gubernator_tpu.ops.bucket_kernel import (
             PACKED_IN_ROWS,
             UNIFORM_IN_ROWS,
