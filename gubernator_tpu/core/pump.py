@@ -86,10 +86,25 @@ class StepPump:
     """Per-engine queue of packed rounds awaiting a fused dispatch."""
 
     def __init__(self, engine, max_group: int = MAX_GROUP) -> None:
+        import jax
+
         self.engine = engine
         self.max_group = max_group
         self._queue: List[PumpTicket] = []
         self._noop: Dict[int, np.ndarray] = {}  # width → no-op buffer
+        # The fused lax.scan dispatch exists to amortize per-RPC
+        # overhead that only accelerator backends have; on CPU, groups
+        # dispatch as ordered singles — same semantics, and none of
+        # the scan compiles that intermittently segfault XLA:CPU under
+        # full-suite load (both scan programs are pinned by dedicated
+        # equality tests).  GUBER_PUMP_SCAN=1 forces the scan path on
+        # for targeted CPU testing of the grouped dispatch.
+        import os
+
+        self._scan_ok = (
+            jax.default_backend() != "cpu"
+            or os.environ.get("GUBER_PUMP_SCAN") == "1"
+        )
         # Telemetry (PERF.md).
         self.submitted = 0
         self.flushes = 0
@@ -174,16 +189,16 @@ class StepPump:
         self.flushes += 1
         shape = group[0].buf.shape
         is_uniform = shape[0] == UNIFORM_IN_ROWS
-        if len(group) == 1:
-            t = group[0]
-            pout = (
-                eng._dispatch_uniform(t.buf) if is_uniform
-                else eng._dispatch_packed(t.buf)
-            )
-            pout.copy_to_host_async()
-            t.group = _Group(pout)
-            t.index = None
-            t.buf = None
+        if len(group) == 1 or not self._scan_ok:
+            for t in group:
+                pout = (
+                    eng._dispatch_uniform(t.buf) if is_uniform
+                    else eng._dispatch_packed(t.buf)
+                )
+                pout.copy_to_host_async()
+                t.index = None
+                t.buf = None
+                t.group = _Group(pout)
             return
         k = len(group)
         r = 2
@@ -223,16 +238,15 @@ class StepPump:
         width — general AND uniform formats — plus the single uniform
         step (engine warmup calls this per ladder width).
 
-        Skipped on the CPU backend: the pump is disabled there in
-        production (no RPCs to amortize), and this rapid-fire ~12
-        scan-compile sequence per daemon spawn is where the full test
-        suite intermittently segfaulted inside XLA:CPU's compiler —
-        the same programs compile lazily without issue when tests
-        force GUBER_PUMP=1."""
-        import jax
-
-        if jax.default_backend() == "cpu":
-            return
+        The SCAN families are skipped on the CPU backend: the pump is
+        disabled there in production (no RPCs to amortize), and that
+        rapid-fire ~8 scan-compile sequence per daemon spawn is where
+        the full test suite intermittently segfaulted inside XLA:CPU's
+        compiler — the same programs compile lazily without issue when
+        tests force GUBER_PUMP=1.  The SINGLE uniform step still warms
+        everywhere: with the pump forced on, the first forwarded
+        request otherwise pays its compile inside the peer batch
+        window ("timeout waiting for batched response")."""
         from gubernator_tpu.ops.bucket_kernel import (
             PACKED_IN_ROWS,
             UNIFORM_IN_ROWS,
@@ -245,6 +259,10 @@ class StepPump:
             self._noop_buf((UNIFORM_IN_ROWS, width))
         )
         np.asarray(pout)
+        if not self._scan_ok:
+            # Same gate as _flush_group: never warm programs the
+            # dispatch path will not run.
+            return
         for rows, step in (
             (PACKED_IN_ROWS, multi_fused_step),
             (UNIFORM_IN_ROWS, multi_uniform_step),
