@@ -115,36 +115,57 @@ class GlobalManager:
 
     @staticmethod
     def _aggregate_chunks(chunks, sum_hits: bool) -> Dict[str, RateLimitReq]:
-        """Per-key aggregation of queued (dec, idx) chunks: one linear
-        pass with a bytes-keyed dict — hits summed (hits loop) or
-        latest-wins (broadcast dedupe, reference: global.go:92-95,
-        176).  RateLimitReq objects are built once per UNIQUE key at
-        the end, never per item."""
+        """Per-key aggregation of queued (dec, idx) chunks, grouped by
+        the decoded (fnv1a, fnv1) hash PAIR with numpy — hits summed
+        (hits loop) or latest-wins (broadcast dedupe, reference:
+        global.go:92-95, 176).  Python runs once per UNIQUE key, not
+        per item: hot-key windows aggregate thousands of occurrences
+        into a handful of groups entirely in numpy.  Key identity by
+        two independent 64-bit FNV variants — a pair collision within
+        one sync window is ~2^-128, far below memory-error rates."""
+        import numpy as np
+
         if not chunks:
             return {}
-        # key bytes → [hits_sum, dec, last_j] (dec/last_j = latest
-        # occurrence, whose config fields win).
-        agg: Dict[bytes, list] = {}
-        for dec, idx in chunks:
-            raw = dec.key_buf.tobytes()
-            off = dec.key_offsets
-            hits = dec.hits
-            for j in idx.tolist():
-                kb = raw[off[j]:off[j + 1]]
-                e = agg.get(kb)
-                if e is None:
-                    agg[kb] = [int(hits[j]), dec, j]
-                else:
-                    e[0] += int(hits[j])
-                    e[1] = dec
-                    e[2] = j
+        h_a = np.concatenate([dec.fnv1a[idx] for dec, idx in chunks])
+        if len(h_a) == 0:
+            return {}
+        h_b = np.concatenate([dec.fnv1[idx] for dec, idx in chunks])
+        hits = np.concatenate([dec.hits[idx] for dec, idx in chunks])
+        # Flat source refs so the per-unique pass can reach the latest
+        # occurrence's full row.
+        chunk_id = np.repeat(
+            np.arange(len(chunks), dtype=np.int64),
+            [len(idx) for _, idx in chunks],
+        )
+        flat_j = np.concatenate([idx for _, idx in chunks])
+
+        order = np.lexsort((h_b, h_a))
+        sa, sb = h_a[order], h_b[order]
+        new_group = np.empty(len(order), dtype=bool)
+        new_group[0] = True
+        new_group[1:] = (sa[1:] != sa[:-1]) | (sb[1:] != sb[:-1])
+        starts = np.nonzero(new_group)[0]
+        sums = np.add.reduceat(hits[order], starts)
+        # Latest occurrence per group = the max original position in
+        # the run (order is stable on position within equal keys).
+        ends = np.append(starts[1:], len(order))
+        last_flat = order[ends - 1]
+
         out: Dict[str, RateLimitReq] = {}
-        for kb, (hits_sum, dec, j) in agg.items():
+        raws = [dec.key_buf.tobytes() for dec, _ in chunks]
+        for g in range(len(starts)):
+            fl = int(last_flat[g])
+            dec, _ = chunks[int(chunk_id[fl])]
+            raw = raws[int(chunk_id[fl])]
+            j = int(flat_j[fl])
+            a, b = int(dec.key_offsets[j]), int(dec.key_offsets[j + 1])
+            kb = raw[a:b]
             nl = int(dec.name_len[j])
             out[kb.decode()] = RateLimitReq(
                 name=kb[:nl].decode(),
                 unique_key=kb[nl + 1:].decode(),
-                hits=hits_sum if sum_hits else int(dec.hits[j]),
+                hits=int(sums[g]) if sum_hits else int(dec.hits[j]),
                 limit=int(dec.limit[j]),
                 duration=int(dec.duration[j]),
                 algorithm=int(dec.algo[j]),
