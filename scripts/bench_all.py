@@ -71,6 +71,14 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "1",
         "BENCH_CAPACITY": str(1 << 17),
     },
+    # Throughput-optimal operating point: batch 32768 amortizes the
+    # tunneled backend's per-RPC fixed costs 4x deeper than the
+    # default-config batch 8192 (PERF.md §9 transport arithmetic).
+    "bulk": {
+        "BENCH_BATCH": "32768",
+        "BENCH_KEYS": "1000000",
+        "BENCH_CAPACITY": str(1 << 21),
+    },
     # BASELINE config 5: count-min-sketch approximate limiter
     # (Behavior.SKETCH) over the wire — unbounded key cardinality in
     # O(1) memory, one-sided error (ops/sketch.py).

@@ -47,6 +47,7 @@ BENCH_ORDER = [
     "global4",
     "herd",
     "sketch",
+    "bulk",
 ]
 
 PROBE_SRC = (
