@@ -64,6 +64,18 @@ CONFIGS: dict[str, dict] = {
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_WIRE_PROCS": "4",
     },
+    # Single-client baseline for the lock-split criterion (VERDICT r3
+    # #3): multi-client p50 within ~1.5x of this proves host
+    # scheduling overlaps device work.  Only meaningful where the
+    # server has idle host capacity (TPU); on the one-core CPU host
+    # closed-loop p50 scales with concurrency by queueing physics.
+    "wire1": {
+        "BENCH_MODE": "wire",
+        "BENCH_BATCH": "1000",
+        "BENCH_KEYS": "100000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_WIRE_PROCS": "1",
+    },
     # Thundering herd: 32 concurrent clients, one hot key, single-item
     # RPCs (reference: benchmark_test.go thundering-herd subtest).
     "herd": {

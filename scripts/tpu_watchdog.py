@@ -48,6 +48,7 @@ BENCH_ORDER = [
     "herd",
     "sketch",
     "bulk",
+    "wire1",
 ]
 
 PROBE_SRC = (
