@@ -127,11 +127,10 @@ class GlobalManager:
 
         if not chunks:
             return {}
-        h_a = np.concatenate([dec.fnv1a[idx] for dec, idx in chunks])
-        if len(h_a) == 0:
+        groups = GlobalManager._hash_pair_groups(chunks)
+        if groups is None:
             return {}
-        h_b = np.concatenate([dec.fnv1[idx] for dec, idx in chunks])
-        hits = np.concatenate([dec.hits[idx] for dec, idx in chunks])
+        sums, last_flat = groups
         # Flat source refs so the per-unique pass can reach the latest
         # occurrence's full row.
         chunk_id = np.repeat(
@@ -140,21 +139,9 @@ class GlobalManager:
         )
         flat_j = np.concatenate([idx for _, idx in chunks])
 
-        order = np.lexsort((h_b, h_a))
-        sa, sb = h_a[order], h_b[order]
-        new_group = np.empty(len(order), dtype=bool)
-        new_group[0] = True
-        new_group[1:] = (sa[1:] != sa[:-1]) | (sb[1:] != sb[:-1])
-        starts = np.nonzero(new_group)[0]
-        sums = np.add.reduceat(hits[order], starts)
-        # Latest occurrence per group = the max original position in
-        # the run (order is stable on position within equal keys).
-        ends = np.append(starts[1:], len(order))
-        last_flat = order[ends - 1]
-
         out: Dict[str, RateLimitReq] = {}
         raws = [dec.key_buf.tobytes() for dec, _ in chunks]
-        for g in range(len(starts)):
+        for g in range(len(sums)):
             fl = int(last_flat[g])
             dec, _ = chunks[int(chunk_id[fl])]
             raw = raws[int(chunk_id[fl])]
@@ -185,6 +172,14 @@ class GlobalManager:
 
         from gubernator_tpu.utils.tracing import span
 
+        if not hits and chunks:
+            # Hot case (all traffic arrived via the wire fast path):
+            # aggregate, route, encode and send entirely columnar —
+            # zero request objects per key (VERDICT r3 #2).
+            t0 = time.monotonic()
+            if self._send_hits_columnar(chunks):
+                self.hits_duration.observe(time.monotonic() - t0)
+                return
         for k, r in self._aggregate_chunks(chunks or [], sum_hits=True).items():
             hits[k] = _combine_hits(hits.get(k), r)
         if not hits:
@@ -193,6 +188,176 @@ class GlobalManager:
         with span("global.hits_window", keys=len(hits)):
             self._send_hits_traced(hits)
         self.hits_duration.observe(time.monotonic() - t0)
+
+    def _send_hits_columnar(self, chunks) -> bool:
+        """Columnar hits fan-out: returns False to use the dataclass
+        fallback (codec unavailable / empty picker)."""
+        import numpy as np
+
+        from gubernator_tpu.net import wire_codec
+        from gubernator_tpu.utils.tracing import span
+
+        if wire_codec.load() is None:
+            return False
+        agg = self._aggregate_chunk_columns(chunks)
+        if agg is None:
+            return True  # nothing queued
+        (key_buf, starts, lens, name_len, algo, behavior, hits_col,
+         limit, duration, burst, h1, h1a) = agg
+        owners = self.instance.get_peer_batch_hashed(h1, h1a)
+        if owners is None:
+            return False
+        n = len(algo)
+        with span("global.hits_window", keys=n):
+            by_addr: Dict[str, list] = {}
+            clients = {}
+            for i, peer in enumerate(owners):
+                addr = peer.info.grpc_address
+                by_addr.setdefault(addr, []).append(i)
+                clients[addr] = peer
+            for addr, idx_list in by_addr.items():
+                peer = clients[addr]
+                idx = np.asarray(idx_list, dtype=np.int64)
+                try:
+                    if peer.info.is_owner:
+                        # Ownership moved to us between queue and
+                        # flush (rare): behave like the owner path —
+                        # materialize just this group.
+                        self.instance.apply_local_batch(
+                            [
+                                self._req_from_columns(
+                                    key_buf, starts, lens, name_len,
+                                    algo, behavior, hits_col, limit,
+                                    duration, burst, int(i),
+                                )
+                                for i in idx_list
+                            ]
+                        )
+                        continue
+                    for lo in range(0, len(idx), MAX_BATCH_SIZE):
+                        sub = idx[lo:lo + MAX_BATCH_SIZE]
+                        sel_lens = lens[sub]
+                        sub_off = np.zeros(len(sub) + 1, dtype=np.int64)
+                        np.cumsum(sel_lens, out=sub_off[1:])
+                        total = int(sub_off[-1])
+                        pos = (
+                            np.repeat(
+                                starts[sub] - sub_off[:-1], sel_lens
+                            )
+                            + np.arange(total, dtype=np.int64)
+                        )
+                        payload = wire_codec.encode_peer_reqs(
+                            key_buf[pos], sub_off, name_len[sub],
+                            algo[sub], behavior[sub], hits_col[sub],
+                            limit[sub], duration[sub], burst[sub],
+                        )
+                        peer.send_peer_hits_raw(
+                            payload, timeout=self.conf.global_timeout
+                        )
+                except PeerError as e:
+                    log.error(
+                        "error sending global hits to '%s': %s", addr, e
+                    )
+                    continue
+        self.async_sends += 1
+        return True
+
+    @staticmethod
+    def _req_from_columns(key_buf, starts, lens, name_len, algo,
+                          behavior, hits, limit, duration, burst,
+                          i: int) -> RateLimitReq:
+        a = int(starts[i])
+        kb = key_buf[a:a + int(lens[i])].tobytes()
+        nl = int(name_len[i])
+        return RateLimitReq(
+            name=kb[:nl].decode(),
+            unique_key=kb[nl + 1:].decode(),
+            hits=int(hits[i]),
+            limit=int(limit[i]),
+            duration=int(duration[i]),
+            algorithm=int(algo[i]),
+            behavior=int(behavior[i]),
+            burst=int(burst[i]),
+        )
+
+    @staticmethod
+    def _hash_pair_groups(chunks):
+        """Shared grouping core for both flush aggregations: group the
+        queued occurrences by the (fnv1a, fnv1) pair and return
+        (summed hits per group, flat index of each group's LATEST
+        occurrence) — or None when nothing is queued.  The latest-
+        occurrence trick depends on lexsort's stability (positions
+        ascend within equal keys)."""
+        import numpy as np
+
+        if not chunks:
+            return None
+        h_a = np.concatenate([dec.fnv1a[idx] for dec, idx in chunks])
+        if len(h_a) == 0:
+            return None
+        h_b = np.concatenate([dec.fnv1[idx] for dec, idx in chunks])
+        hits = np.concatenate([dec.hits[idx] for dec, idx in chunks])
+        order = np.lexsort((h_b, h_a))
+        sa, sb = h_a[order], h_b[order]
+        new_group = np.empty(len(order), dtype=bool)
+        new_group[0] = True
+        new_group[1:] = (sa[1:] != sa[:-1]) | (sb[1:] != sb[:-1])
+        starts = np.nonzero(new_group)[0]
+        sums = np.add.reduceat(hits[order], starts)
+        ends = np.append(starts[1:], len(order))
+        return sums, order[ends - 1]
+
+    @staticmethod
+    def _aggregate_chunk_columns(chunks):
+        """Vectorized per-key aggregation to COLUMNS (no request
+        objects): returns (union key_buf, per-unique starts/lens,
+        name_len, algo, behavior, summed hits, limit, duration, burst,
+        fnv1, fnv1a) with latest-occurrence config fields, or None if
+        nothing is queued.  Grouping identity: the (fnv1a, fnv1) hash
+        pair (see _aggregate_chunks)."""
+        import numpy as np
+
+        groups = GlobalManager._hash_pair_groups(chunks)
+        if groups is None:
+            return None
+        sums, sel = groups
+        h_a = np.concatenate([dec.fnv1a[idx] for dec, idx in chunks])
+        h_b = np.concatenate([dec.fnv1[idx] for dec, idx in chunks])
+        algo = np.concatenate([dec.algo[idx] for dec, idx in chunks])
+        behavior = np.concatenate(
+            [dec.behavior[idx] for dec, idx in chunks]
+        )
+        limit = np.concatenate([dec.limit[idx] for dec, idx in chunks])
+        duration = np.concatenate(
+            [dec.duration[idx] for dec, idx in chunks]
+        )
+        burst = np.concatenate([dec.burst[idx] for dec, idx in chunks])
+        name_len = np.concatenate(
+            [dec.name_len[idx] for dec, idx in chunks]
+        )
+        # Union key buffer + per-flat-item start/len.
+        bufs = [dec.key_buf for dec, _ in chunks]
+        bases = np.zeros(len(bufs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in bufs], out=bases[1:])
+        union = np.concatenate(bufs) if len(bufs) > 1 else bufs[0]
+        starts = np.concatenate(
+            [
+                dec.key_offsets[:-1][idx] + bases[c]
+                for c, (dec, idx) in enumerate(chunks)
+            ]
+        )
+        lens = np.concatenate(
+            [
+                (dec.key_offsets[1:] - dec.key_offsets[:-1])[idx]
+                for dec, idx in chunks
+            ]
+        )
+
+        return (
+            union, starts[sel], lens[sel], name_len[sel], algo[sel],
+            behavior[sel], sums, limit[sel], duration[sel], burst[sel],
+            h_b[sel], h_a[sel],
+        )
 
     def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
         by_peer: Dict[str, List[RateLimitReq]] = {}

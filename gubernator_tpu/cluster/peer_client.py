@@ -215,10 +215,19 @@ class PeerClient:
         the responses are ignored by contract (reference global.go:
         124-164 discards them), so skip the per-item response parse —
         the owner's authoritative answer arrives via the broadcast."""
-        stub = self._connect()
-        msg = peers_pb.GetPeerRateLimitsReq(
-            requests=[serde.rate_limit_req_to_pb(r) for r in reqs]
+        self.send_peer_hits_raw(
+            peers_pb.GetPeerRateLimitsReq(
+                requests=[serde.rate_limit_req_to_pb(r) for r in reqs]
+            ).SerializeToString(),
+            timeout=timeout,
         )
+
+    def send_peer_hits_raw(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> None:
+        """Pre-encoded GetPeerRateLimitsReq bytes (the columnar hit
+        windows C-encode straight from their aggregation columns)."""
+        self._connect()
         with self._lock:
             if self._closing:
                 raise PeerError("already disconnecting", not_ready=True)
@@ -226,7 +235,7 @@ class PeerClient:
             self._inflight += 1
         try:
             raw(
-                msg.SerializeToString(),
+                payload,
                 timeout=timeout or self.behaviors.global_timeout,
             )
         except grpc.RpcError as e:

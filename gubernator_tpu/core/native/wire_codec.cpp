@@ -325,6 +325,74 @@ int64_t wire_encode_resps_owner(const int32_t* status, const int64_t* limit,
   return p - out;
 }
 
+// Encode a GetPeerRateLimitsReq straight from columns — the GLOBAL
+// hits-forward plane (owner fan-out windows).  Each item's joined key
+// (key_buf slice) splits back into name/unique_key via name_lens.
+// Returns bytes written, or -1 if out_cap is too small.
+int64_t wire_encode_reqs(const uint8_t* key_buf, const int64_t* key_offsets,
+                         const int32_t* name_lens, const int32_t* algo,
+                         const int32_t* behavior, const int64_t* hits,
+                         const int64_t* limit, const int64_t* duration,
+                         const int64_t* burst, int64_t n, uint8_t* out,
+                         int64_t out_cap) {
+  uint8_t* p = out;
+  uint8_t* end = out + out_cap;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* key = key_buf + key_offsets[i];
+    int64_t klen = key_offsets[i + 1] - key_offsets[i];
+    int64_t nlen = name_lens[i];
+    int64_t ulen = klen - nlen - 1;  // joined = name + '_' + unique
+    if (nlen < 0 || ulen < 0) return -1;
+    int msize = 0;
+    msize += 1 + varint_size((uint64_t)nlen) + (int)nlen;  // name = 1
+    msize += 1 + varint_size((uint64_t)ulen) + (int)ulen;  // unique_key = 2
+    if (hits[i]) msize += 1 + varint_size((uint64_t)hits[i]);
+    if (limit[i]) msize += 1 + varint_size((uint64_t)limit[i]);
+    if (duration[i]) msize += 1 + varint_size((uint64_t)duration[i]);
+    uint64_t al = (uint64_t)(uint32_t)algo[i];
+    if (al) msize += 1 + varint_size(al);
+    uint64_t be = (uint64_t)(uint32_t)behavior[i];
+    if (be) msize += 1 + varint_size(be);
+    if (burst[i]) msize += 1 + varint_size((uint64_t)burst[i]);
+    if (end - p < 2 + varint_size(msize) + msize) return -1;
+    *p++ = (1 << 3) | 2;  // requests = 1
+    p = put_varint(p, (uint64_t)msize);
+    *p++ = (1 << 3) | 2;  // name
+    p = put_varint(p, (uint64_t)nlen);
+    if (nlen) std::memcpy(p, key, nlen);
+    p += nlen;
+    *p++ = (2 << 3) | 2;  // unique_key
+    p = put_varint(p, (uint64_t)ulen);
+    if (ulen) std::memcpy(p, key + nlen + 1, ulen);
+    p += ulen;
+    if (hits[i]) {
+      *p++ = (3 << 3) | 0;
+      p = put_varint(p, (uint64_t)hits[i]);
+    }
+    if (limit[i]) {
+      *p++ = (4 << 3) | 0;
+      p = put_varint(p, (uint64_t)limit[i]);
+    }
+    if (duration[i]) {
+      *p++ = (5 << 3) | 0;
+      p = put_varint(p, (uint64_t)duration[i]);
+    }
+    if (al) {
+      *p++ = (6 << 3) | 0;
+      p = put_varint(p, al);
+    }
+    if (be) {
+      *p++ = (7 << 3) | 0;
+      p = put_varint(p, be);
+    }
+    if (burst[i]) {
+      *p++ = (8 << 3) | 0;
+      p = put_varint(p, (uint64_t)burst[i]);
+    }
+  }
+  return p - out;
+}
+
 // UpdatePeerGlobalsReq codec — the GLOBAL broadcast plane.
 //
 //   UpdatePeerGlobalsReq { repeated UpdatePeerGlobal globals = 1; }

@@ -79,6 +79,13 @@ def load():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int64,
         ]
+        lib.wire_encode_reqs.restype = ctypes.c_int64
+        # (key_buf, key_offsets, name_lens, algo, behavior, hits,
+        #  limit, duration, burst, n, out, out_cap)
+        lib.wire_encode_reqs.argtypes = (
+            [ctypes.c_void_p] * 9 + [ctypes.c_int64, ctypes.c_void_p,
+                                     ctypes.c_int64]
+        )
         lib.wire_encode_globals.restype = ctypes.c_int64
         # (key_buf, key_offsets, algo, status, limit, remaining,
         #  reset, n, out, out_cap)
@@ -95,6 +102,40 @@ def load():
         )
         _lib = lib
     return _lib
+
+
+def encode_peer_reqs(
+    key_buf: np.ndarray,
+    key_offsets: np.ndarray,
+    name_len: np.ndarray,
+    algo: np.ndarray,
+    behavior: np.ndarray,
+    hits: np.ndarray,
+    limit: np.ndarray,
+    duration: np.ndarray,
+    burst: np.ndarray,
+) -> bytes:
+    """Columns → GetPeerRateLimitsReq bytes (hits-forward plane)."""
+    lib = load()
+    assert lib is not None
+    n = len(algo)
+    key_buf = np.ascontiguousarray(key_buf, dtype=np.uint8)
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
+    name_len = np.ascontiguousarray(name_len, dtype=np.int32)
+    algo = np.ascontiguousarray(algo, dtype=np.int32)
+    behavior = np.ascontiguousarray(behavior, dtype=np.int32)
+    hits = np.ascontiguousarray(hits, dtype=np.int64)
+    limit = np.ascontiguousarray(limit, dtype=np.int64)
+    duration = np.ascontiguousarray(duration, dtype=np.int64)
+    burst = np.ascontiguousarray(burst, dtype=np.int64)
+    out = np.empty(int(key_offsets[-1]) + n * 80 + 16, dtype=np.uint8)
+    written = lib.wire_encode_reqs(
+        _ptr(key_buf), _ptr(key_offsets), _ptr(name_len), _ptr(algo),
+        _ptr(behavior), _ptr(hits), _ptr(limit), _ptr(duration),
+        _ptr(burst), n, _ptr(out), len(out),
+    )
+    assert written >= 0
+    return out[:written].tobytes()
 
 
 class DecodedGlobals(NamedTuple):

@@ -835,6 +835,17 @@ class V1Instance:
                 return [None] * len(keys)
             return self.local_picker.get_batch(list(keys))
 
+    def get_peer_batch_hashed(self, fnv1, fnv1a) -> Optional[List]:
+        """Owner clients from precomputed key hashes (the columnar hit
+        windows never materialize keys).  None when the picker is
+        empty — callers fall back to local handling."""
+        with self._peer_lock:
+            picker = self.local_picker
+            if picker.size() == 0:
+                return None
+            hashes = fnv1 if picker.hash_name == "fnv1" else fnv1a
+            return picker.get_batch_hashed(np.asarray(hashes))
+
     def get_peer_rate_limits(
         self, requests: Sequence[RateLimitReq]
     ) -> List[RateLimitResp]:
