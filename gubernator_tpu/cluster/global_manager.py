@@ -130,7 +130,7 @@ class GlobalManager:
         groups = GlobalManager._hash_pair_groups(chunks)
         if groups is None:
             return {}
-        sums, last_flat = groups
+        sums, last_flat, _, _ = groups
         # Flat source refs so the per-unique pass can reach the latest
         # occurrence's full row.
         chunk_id = np.repeat(
@@ -285,9 +285,9 @@ class GlobalManager:
         """Shared grouping core for both flush aggregations: group the
         queued occurrences by the (fnv1a, fnv1) pair and return
         (summed hits per group, flat index of each group's LATEST
-        occurrence) — or None when nothing is queued.  The latest-
-        occurrence trick depends on lexsort's stability (positions
-        ascend within equal keys)."""
+        occurrence, flat fnv1a, flat fnv1) — or None when nothing is
+        queued.  The latest-occurrence trick depends on lexsort's
+        stability (positions ascend within equal keys)."""
         import numpy as np
 
         if not chunks:
@@ -305,7 +305,7 @@ class GlobalManager:
         starts = np.nonzero(new_group)[0]
         sums = np.add.reduceat(hits[order], starts)
         ends = np.append(starts[1:], len(order))
-        return sums, order[ends - 1]
+        return sums, order[ends - 1], h_a, h_b
 
     @staticmethod
     def _aggregate_chunk_columns(chunks):
@@ -320,9 +320,7 @@ class GlobalManager:
         groups = GlobalManager._hash_pair_groups(chunks)
         if groups is None:
             return None
-        sums, sel = groups
-        h_a = np.concatenate([dec.fnv1a[idx] for dec, idx in chunks])
-        h_b = np.concatenate([dec.fnv1[idx] for dec, idx in chunks])
+        sums, sel, h_a, h_b = groups
         algo = np.concatenate([dec.algo[idx] for dec, idx in chunks])
         behavior = np.concatenate(
             [dec.behavior[idx] for dec, idx in chunks]

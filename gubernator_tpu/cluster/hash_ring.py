@@ -156,6 +156,14 @@ class ReplicatedConsistentHash(Generic[T]):
         padded, lengths = pack_keys([k.encode() for k in keys])
         return self.get_batch_hashed(_BATCH[self.hash_name](padded, lengths))
 
+    def get_batch_dual_hashed(self, fnv1, fnv1a) -> List[T]:
+        """Owner lookup given BOTH precomputed hash columns (the
+        native wire codec emits fnv1 and fnv1a per key) — the single
+        place that picks the column matching `hash_name`."""
+        return self.get_batch_hashed(
+            np.asarray(fnv1 if self.hash_name == "fnv1" else fnv1a)
+        )
+
     def get_batch_hashed(self, hashes: np.ndarray) -> List[T]:
         """Owner lookup from precomputed key hashes (the native wire
         codec emits both fnv1 and fnv1a per key; pick the column

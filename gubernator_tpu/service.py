@@ -628,10 +628,7 @@ class V1Instance:
                 if not picker.peers()[0].info.is_owner:
                     return None
             elif n_peers > 1:
-                hashes = (
-                    dec.fnv1 if picker.hash_name == "fnv1" else dec.fnv1a
-                )
-                owners = picker.get_batch_hashed(np.asarray(hashes))
+                owners = picker.get_batch_dual_hashed(dec.fnv1, dec.fnv1a)
                 if not all(o.info.is_owner for o in owners):
                     return None
             self.counters["local"] += dec.n
@@ -687,10 +684,9 @@ class V1Instance:
                 owner_objs = None
                 single_addr = me.info.grpc_address
             else:
-                hashes = (
-                    dec.fnv1 if picker.hash_name == "fnv1" else dec.fnv1a
+                owner_objs = picker.get_batch_dual_hashed(
+                    dec.fnv1, dec.fnv1a
                 )
-                owner_objs = picker.get_batch_hashed(np.asarray(hashes))
                 owned = np.fromiter(
                     (o.info.is_owner for o in owner_objs), bool, n
                 )
@@ -843,8 +839,7 @@ class V1Instance:
             picker = self.local_picker
             if picker.size() == 0:
                 return None
-            hashes = fnv1 if picker.hash_name == "fnv1" else fnv1a
-            return picker.get_batch_hashed(np.asarray(hashes))
+            return picker.get_batch_dual_hashed(fnv1, fnv1a)
 
     def get_peer_rate_limits(
         self, requests: Sequence[RateLimitReq]
