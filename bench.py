@@ -388,7 +388,7 @@ def _build_payloads(pb, wire_batch: int, behavior: int) -> list:
             requests=[
                 pb.RateLimitReq(
                     name="bench",
-                    unique_key="k%d" % ((b * wire_batch + i) % N_KEYS),
+                    unique_key="%dk" % ((b * wire_batch + i) % N_KEYS),
                     hits=1,
                     limit=1_000_000,
                     duration=3_600_000,

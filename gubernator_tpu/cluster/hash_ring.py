@@ -13,6 +13,16 @@ numpy uint64 array, a request batch is hashed in one vectorized FNV pass
 host-side analog of the device kernel's gather, so the per-request
 Python cost stays flat as batches grow.
 
+Distribution caveat (reference-faithful — fasthash fnv1 is the
+reference's default too): FNV-1's LAST operation is an xor, so keys
+that differ only in their final byte(s) produce hashes that differ
+only in low bits and fall into the SAME ring gap — sequentially
+suffixed names like "key0".."key999" collapse onto ~one owner per
+suffix-length class.  Real keys (entropy before the tail) distribute
+fine; synthetic key generators should vary a NON-terminal byte, and
+`GUBER_PEER_PICKER_HASH=fnv1a` (final op: multiply, full avalanche)
+avoids the property entirely.
+
 `RegionPicker` keeps one ring per datacenter for MULTI_REGION routing
 (reference: region_picker.go:33-111).
 """

@@ -39,8 +39,8 @@ def test_global_over_admission_bounded_and_converges(frozen_clock):
         inst1 = h.daemon_at(1).instance
         # A key owned by node 1 (so node 0 is the non-owner).
         key = next(
-            f"k{i}" for i in range(500)
-            if not inst0.get_peer(_greq(f"k{i}").hash_key()).info.is_owner
+            f"{i}k" for i in range(500)
+            if not inst0.get_peer(_greq(f"{i}k").hash_key()).info.is_owner
         )
 
         def admitted(inst, n):

@@ -184,9 +184,9 @@ def test_columnar_hits_fanout_converges(frozen_clock):
         inst0 = h.daemon_at(0).instance
         inst1 = h.daemon_at(1).instance
         key = next(
-            f"cf{i}" for i in range(500)
+            f"{i}cf" for i in range(500)
             if not inst0.get_peer(
-                RateLimitReq(name="cw", unique_key=f"cf{i}").hash_key()
+                RateLimitReq(name="cw", unique_key=f"{i}cf").hash_key()
             ).info.is_owner
         )
         reqs = [

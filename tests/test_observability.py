@@ -96,39 +96,25 @@ def test_global_series_move_under_load():
 
     from gubernator_tpu.types import Behavior
 
-    def g(i):
-        return RateLimitReq(
-            name="obsglobal", unique_key=f"k{i}", hits=1, limit=100,
-            duration=60_000, behavior=Behavior.GLOBAL,
-        )
-
-    # The reference-exact ring can be lumpy for 2 members (its own
-    # golden test is ±10% at 3 members); scan until remotely-owned
-    # keys turn up.  Rarely (~2 in 10 full-suite runs) the fresh
-    # harness shows EVERY key locally owned — not yet root-caused
-    # (set_peers copies PeerInfo and self-marks by exact address);
-    # retry once with a fresh harness and fail loudly with the peer
-    # table if it recurs.
-    for attempt in range(2):
-        h = ClusterHarness().start(2)
+    h = ClusterHarness().start(2)
+    try:
         inst = h.daemon_at(0).instance
+
+        def g(i):
+            return RateLimitReq(
+                name="obsglobal", unique_key=f"{i}k", hits=1, limit=100,
+                duration=60_000, behavior=Behavior.GLOBAL,
+            )
+
+        # Prefix-varied keys: FNV-1 does not avalanche trailing-byte
+        # differences, so "k{i}"-style names would collapse into one
+        # ring gap (see hash_ring.py docstring); the harness verifies
+        # routing health at start, so a short scan suffices.
         remote = [
             g(i)
             for i in range(2000)
             if not inst.get_peer(g(i).hash_key()).info.is_owner
         ][:5]
-        if remote:
-            break
-        peers_dbg = [
-            (p.info.grpc_address, p.info.is_owner)
-            for p in inst.get_peer_list()
-        ]
-        h.stop()
-        assert attempt == 0, (
-            f"2-member ring claims every key locally owned twice in a "
-            f"row; peers={peers_dbg}"
-        )
-    try:
         assert remote
         inst.get_rate_limits(remote)
         # Generous deadline: the async windows run on 1 shared core and

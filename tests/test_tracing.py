@@ -139,9 +139,9 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
         # arcs depend on the ephemeral ports; scan until enough
         # remotely-owned keys turn up.
         fwd = [
-            req(f"fwd{i}")
+            req(f"{i}fwd")
             for i in range(2000)
-            if not inst.get_peer(req(f"fwd{i}").hash_key()).info.is_owner
+            if not inst.get_peer(req(f"{i}fwd").hash_key()).info.is_owner
         ][:3]
         assert len(fwd) >= 3, "expected remotely-owned keys"
         inst.get_rate_limits(fwd[:3])
@@ -168,9 +168,9 @@ def test_cluster_peer_flush_and_global_spans(frozen_clock, tracer):
 
         # GLOBAL behavior → async hits window (+ broadcast on owner).
         g = [
-            req(f"g{i}", behavior=Behavior.GLOBAL)
+            req(f"{i}g", behavior=Behavior.GLOBAL)
             for i in range(2000)
-            if not inst.get_peer(req(f"g{i}").hash_key()).info.is_owner
+            if not inst.get_peer(req(f"{i}g").hash_key()).info.is_owner
         ][:3]
         assert g
         inst.get_rate_limits(g)
