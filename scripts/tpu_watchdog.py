@@ -37,18 +37,22 @@ STATUS_PATH = "/tmp/tpu_watchdog_status.json"
 
 # Capture order = value order: dispatch profile first (smallest, most
 # diagnostic), then the headline, then the rest.
+# Value order for a SHORT serving window: the post-redesign headline
+# (default), the throughput-optimal point (bulk), the overlap
+# criterion pair (wire/wire1), the 7.6GB HBM proof (zipf100m), then
+# the rest.
 BENCH_ORDER = [
     "default",
+    "bulk",
     "wire",
+    "wire1",
+    "zipf100m",
     "leaky1m",
     "zipf",
-    "zipf100m",
     "global4hot",
     "global4",
-    "herd",
     "sketch",
-    "bulk",
-    "wire1",
+    "herd",
 ]
 
 PROBE_SRC = (
