@@ -117,6 +117,20 @@ class PackedKeys:
         off = self.offsets
         return [raw[off[i] : off[i + 1]] for i in range(self.count)]
 
+    @classmethod
+    def from_list(cls, keys: List[bytes]) -> "PackedKeys":
+        """Concatenate a key list into the packed form (the empty-batch
+        placeholder keeps a valid base pointer for FFI callees)."""
+        n = len(keys)
+        buf = b"".join(keys)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        buf_arr = (
+            np.frombuffer(buf, dtype=np.uint8)
+            if buf else np.zeros(1, np.uint8)
+        )
+        return cls(buf_arr, offsets, n)
+
 
 class PendingColumnar:
     """In-flight columnar batch: device work dispatched, packed outputs

@@ -1,0 +1,96 @@
+"""ctypes wrapper for the native h2 gRPC client load loop.
+
+`bench_unary` drives a closed-loop unary load from C threads (GIL
+released for the whole call), so a loopback benchmark measures the
+SERVER's per-RPC capacity rather than grpc-python client overhead —
+the role Go clients play in the reference's own benchmarks
+(reference: benchmark_test.go:29-148, README.md:97-104).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.core.native_build import ensure_built
+
+_lib = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = ensure_built("h2_client")
+    if so is None:
+        return None
+    lib = ctypes.CDLL(str(so))
+    lib.h2_bench_unary.restype = ctypes.c_int64
+    lib.h2_bench_unary.argtypes = [
+        ctypes.c_char_p,  # host
+        ctypes.c_int32,  # port
+        ctypes.c_char_p,  # path
+        ctypes.c_char_p,  # authority
+        ctypes.c_void_p,  # payload
+        ctypes.c_int64,  # payload_len
+        ctypes.c_double,  # seconds
+        ctypes.c_int32,  # n_conns
+        ctypes.c_void_p,  # out_lats
+        ctypes.c_int64,  # max_lats
+        ctypes.c_void_p,  # out_stats
+        ctypes.c_void_p,  # out_resp
+        ctypes.c_int64,  # resp_cap
+        ctypes.c_void_p,  # out_resp_len
+    ]
+    _lib = lib
+    return _lib
+
+
+def bench_unary(
+    address: str,
+    path: str,
+    payload: bytes,
+    seconds: float,
+    n_conns: int,
+    max_lats: int = 100_000,
+) -> Optional[Tuple[int, int, np.ndarray, bytes, int]]:
+    """Run the closed loop; returns (rpcs, errors, latencies_s,
+    first_response_grpc_frame, threads_connected) or None if the
+    native client is unavailable / could not connect.  `errors` counts
+    transport failures AND trailers-only grpc error replies."""
+    lib = load()
+    if lib is None:
+        return None
+    host, port = address.rsplit(":", 1)
+    lats = np.zeros(max_lats, dtype=np.float64)
+    stats = np.zeros(4, dtype=np.int64)
+    resp = np.zeros(1 << 20, dtype=np.uint8)
+    resp_len = np.zeros(1, dtype=np.int64)
+    rc = lib.h2_bench_unary(
+        host.encode(),
+        int(port),
+        path.encode(),
+        host.encode(),
+        payload,
+        len(payload),
+        float(seconds),
+        int(n_conns),
+        lats.ctypes.data_as(ctypes.c_void_p),
+        max_lats,
+        stats.ctypes.data_as(ctypes.c_void_p),
+        resp.ctypes.data_as(ctypes.c_void_p),
+        len(resp),
+        resp_len.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        return None
+    n_rec = int(stats[2])
+    return (
+        int(stats[0]),
+        int(stats[1]),
+        lats[:n_rec],
+        resp[: int(resp_len[0])].tobytes(),
+        int(stats[3]),
+    )

@@ -61,6 +61,27 @@ def load_library():
         ctypes.c_void_p,  # out_evict_rounds
         ctypes.c_void_p,  # stats_out
     ]
+    lib.git_multi_schedule.restype = ctypes.c_int64
+    lib.git_multi_schedule.argtypes = [
+        ctypes.c_void_p,  # tables (void*[n_sh])
+        ctypes.c_int64,  # n_sh
+        ctypes.c_void_p,  # buf
+        ctypes.c_void_p,  # offsets
+        ctypes.c_void_p,  # hashes (nullable)
+        ctypes.c_int64,  # n
+        ctypes.c_int64,  # now_ms
+        ctypes.c_void_p,  # expires (nullable)
+        ctypes.c_void_p,  # out_shard
+        ctypes.c_void_p,  # out_slots
+        ctypes.c_void_p,  # out_rounds
+        ctypes.c_void_p,  # out_order
+        ctypes.c_void_p,  # out_shard_counts
+        ctypes.c_void_p,  # out_evicted
+        ctypes.c_void_p,  # out_evict_shard
+        ctypes.c_void_p,  # out_evict_rounds
+        ctypes.c_void_p,  # out_n_evicted
+        ctypes.c_void_p,  # stats_out
+    ]
     lib.git_set_expiry.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
@@ -84,7 +105,10 @@ def load_library():
 
 
 def _ptr(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.c_void_p)
+    # Bare data address (int, passed as c_void_p) — see
+    # net/wire_codec._ptr for the measured cost of the ctypes-view
+    # variant on per-RPC paths.
+    return a.ctypes.data
 
 
 class NativeInternTable:
@@ -123,12 +147,10 @@ class NativeInternTable:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Intern a batch: returns (slots, rounds, evicted_slots,
         evict_rounds) — one FFI call for the whole batch."""
-        n = len(keys)
-        buf = b"".join(keys)
-        offsets = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum([len(k) for k in keys], out=offsets[1:])
-        buf_arr = np.frombuffer(buf, dtype=np.uint8) if buf else np.zeros(1, np.uint8)
-        return self.schedule_packed(buf_arr, offsets, now_ms)
+        from gubernator_tpu.core.engine import PackedKeys
+
+        packed = PackedKeys.from_list(keys)
+        return self.schedule_packed(packed.buf, packed.offsets, now_ms)
 
     def schedule_packed(
         self,
@@ -220,6 +242,74 @@ class NativeInternTable:
             if ln <= cap:
                 return out.raw[:ln].decode()
             cap = int(ln)
+
+
+def multi_schedule(
+    tables: List["NativeInternTable"],
+    buf_arr: np.ndarray,  # uint8 concatenated key bytes
+    offsets: np.ndarray,  # int64 [n+1]
+    hashes: Optional[np.ndarray],  # uint64 fnv1a per key (None = compute)
+    now_ms: int,
+    expires: Optional[np.ndarray] = None,  # int64 [n] TTL mirror writes
+):
+    """One FFI call for the sharded engine's whole host tier: shard
+    routing, per-table interning/LRU/eviction, round assignment, TTL
+    mirror, and the shard-grouped (slot, round)-sorted dispatch order.
+
+    Returns (max_round, shard, slots, rounds, order, shard_counts,
+    evicted, evict_shard, evict_rounds) — all numpy.  The caller must
+    pass NATIVE tables only (the sharded engine gates on that)."""
+    n_sh = len(tables)
+    n = len(offsets) - 1
+    lib = tables[0]._lib
+    buf_arr = np.ascontiguousarray(buf_arr, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    if hashes is not None:
+        hashes = np.ascontiguousarray(hashes, dtype=np.uint64)
+    if expires is not None:
+        expires = np.ascontiguousarray(expires, dtype=np.int64)
+    shard = np.empty(n, dtype=np.int32)
+    slots = np.empty(n, dtype=np.int32)
+    rounds = np.empty(n, dtype=np.int32)
+    order = np.empty(n, dtype=np.int64)
+    shard_counts = np.empty(n_sh, dtype=np.int64)
+    evicted = np.empty(n if n else 1, dtype=np.int32)
+    evict_shard = np.empty(n if n else 1, dtype=np.int32)
+    evict_rounds = np.empty(n if n else 1, dtype=np.int32)
+    n_evicted = np.zeros(1, dtype=np.int64)
+    stats = np.zeros(4 * n_sh, dtype=np.int64)
+    ptrs = (ctypes.c_void_p * n_sh)(*[t._t for t in tables])
+    max_round = lib.git_multi_schedule(
+        ptrs,
+        n_sh,
+        _ptr(buf_arr),
+        _ptr(offsets),
+        _ptr(hashes) if hashes is not None else None,
+        n,
+        now_ms,
+        _ptr(expires) if expires is not None else None,
+        _ptr(shard),
+        _ptr(slots),
+        _ptr(rounds),
+        _ptr(order),
+        _ptr(shard_counts),
+        _ptr(evicted),
+        _ptr(evict_shard),
+        _ptr(evict_rounds),
+        _ptr(n_evicted),
+        _ptr(stats),
+    )
+    for sh, t in enumerate(tables):
+        off = t._stat_off
+        t.hits = int(stats[4 * sh + 0]) - off[0]
+        t.misses = int(stats[4 * sh + 1]) - off[1]
+        t.evictions = int(stats[4 * sh + 2]) - off[2]
+        t.unexpired_evictions = int(stats[4 * sh + 3]) - off[3]
+    ne = int(n_evicted[0])
+    return (
+        int(max_round), shard, slots, rounds, order, shard_counts,
+        evicted[:ne], evict_shard[:ne], evict_rounds[:ne],
+    )
 
 
 def make_intern_table(capacity: int):

@@ -41,6 +41,7 @@ def ensure_built(stem: str = "intern_table") -> Optional[Path]:
         "-std=c++17",
         "-shared",
         "-fPIC",
+        "-pthread",
         "-o",
         str(tmp),
         str(src),

@@ -216,7 +216,11 @@ def decode_globals(raw: bytes, max_items: int) -> Optional[DecodedGlobals]:
 
 
 def _ptr(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.c_void_p)
+    # Bare data address (int) — ctypes passes it as c_void_p.  The
+    # data_as(c_void_p) form costs 3.2µs per array (it builds a ctypes
+    # view object); at 16 pointer extractions per decoded RPC that was
+    # the single largest glue cost on the serve path.
+    return a.ctypes.data
 
 
 def decode_reqs(

@@ -100,6 +100,17 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "10000000",
         "BENCH_CAPACITY": str(1 << 17),
     },
+    # Latency mode (VERDICT r4 #4): closed-loop synchronous dispatch at
+    # the wire-max batch, pre-warmed engine — the p50/p99 fields are
+    # the artifact; the SLO bar is p99 < 2ms on the CPU backend where
+    # no tunnel sits between dispatch and readback (BASELINE.md).
+    "latency": {
+        "BENCH_BATCH": "1000",
+        "BENCH_KEYS": "100000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_LATENCY_BATCHES": "1000",
+        "BENCH_SECONDS": "2",
+    },
     # The 100M-slot HBM proof (BASELINE config 4 at full scale):
     # 19 arrays x 4B x 100M = 7.6GB of device state on one v5e chip.
     # TPU-only (the CPU fallback would also allocate 7.6GB, fine on

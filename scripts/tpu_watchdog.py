@@ -47,6 +47,7 @@ BENCH_ORDER = [
     "wire",
     "wire1",
     "zipf100m",
+    "latency",
     "leaky1m",
     "zipf",
     "global4hot",

@@ -1,0 +1,160 @@
+"""git_multi_schedule (one-FFI sharded host tier) must be behaviorally
+identical to the per-shard schedule_packed loop it replaces.
+
+The native path changes scheduling mechanics only — shard routing,
+interning, rounds, TTL, dispatch order — so an engine taking the
+multi-call path and one forced onto the per-shard fallback must
+produce bit-equal decisions and identical table occupancy under
+duplicate keys, evictions, Gregorian durations, and hot-key collapse.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq
+
+
+def _columns(reqs):
+    return (
+        [r.hash_key().encode() for r in reqs],
+        np.asarray([int(r.algorithm) for r in reqs], dtype=np.int32),
+        np.asarray([int(r.behavior) for r in reqs], dtype=np.int32),
+        np.asarray([r.hits for r in reqs], dtype=np.int64),
+        np.asarray([r.limit for r in reqs], dtype=np.int64),
+        np.asarray([r.duration for r in reqs], dtype=np.int64),
+        np.asarray([r.burst for r in reqs], dtype=np.int64),
+    )
+
+
+def _require_native(engine):
+    if not engine._multi_ok:
+        pytest.skip("native intern table unavailable")
+
+
+def _fuzz_reqs(rng, n_keys, n_items, greg=False):
+    reqs = []
+    for _ in range(n_items):
+        i = rng.randint(0, n_keys - 1)
+        behavior = Behavior.BATCHING
+        duration = 60_000
+        if greg and i % 7 == 0:
+            behavior |= Behavior.DURATION_IS_GREGORIAN
+            duration = 1  # GregorianMinutes
+        reqs.append(
+            RateLimitReq(
+                # Leading-byte variation: FNV-1 trailing-byte
+                # non-avalanche makes f"k{i}" keys collapse onto one
+                # ring owner (cluster/hash_ring.py).
+                name=f"{i}ms",
+                unique_key=f"{i}x",
+                hits=rng.randint(0, 3),
+                limit=10,
+                duration=duration,
+                algorithm=rng.choice(
+                    [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                ),
+                behavior=behavior,
+                burst=10,
+            )
+        )
+    return reqs
+
+
+@pytest.mark.parametrize("single_program", [False, True])
+@pytest.mark.parametrize("shard_capacity,n_keys", [
+    (128, 60),     # no evictions
+    (8, 200),      # constant eviction pressure
+])
+def test_multi_schedule_matches_fallback(
+    frozen_clock, shard_capacity, n_keys, single_program
+):
+    rng = random.Random(5)
+    eng_native = ShardedDecisionEngine(
+        shard_capacity=shard_capacity, clock=frozen_clock,
+        single_program=single_program,
+    )
+    _require_native(eng_native)
+    eng_fallback = ShardedDecisionEngine(
+        shard_capacity=shard_capacity, clock=frozen_clock
+    )
+    eng_fallback._multi_ok = False  # force the per-shard loop
+
+    for step in range(8):
+        reqs = _fuzz_reqs(rng, n_keys, rng.randint(1, 80), greg=True)
+        cols = _columns(reqs)
+        a = eng_native.apply_columnar(*cols)
+        b = eng_fallback.apply_columnar(*cols)
+        for col_a, col_b, label in zip(a, b, "slrr"):
+            np.testing.assert_array_equal(
+                np.asarray(col_a), np.asarray(col_b),
+                err_msg=f"step {step} column {label}",
+            )
+        for sh, (ta, tb) in enumerate(
+            zip(eng_native.tables, eng_fallback.tables)
+        ):
+            assert len(ta) == len(tb), f"step {step} shard {sh} occupancy"
+            assert (
+                ta.hits, ta.misses, ta.evictions, ta.unexpired_evictions
+            ) == (
+                tb.hits, tb.misses, tb.evictions, tb.unexpired_evictions
+            ), f"step {step} shard {sh} stats"
+        frozen_clock.advance(ms=rng.randint(0, 3_000))
+
+
+@pytest.mark.parametrize("single_program", [False, True])
+def test_multi_schedule_hot_key_collapse(frozen_clock, single_program):
+    """An all-duplicate batch must still collapse (uniform segments)
+    and agree with the fallback path."""
+    eng_native = ShardedDecisionEngine(
+        shard_capacity=64, clock=frozen_clock, single_program=single_program
+    )
+    _require_native(eng_native)
+    eng_fallback = ShardedDecisionEngine(shard_capacity=64, clock=frozen_clock)
+    eng_fallback._multi_ok = False
+
+    reqs = [
+        RateLimitReq(
+            name="hot", unique_key="key", hits=1, limit=1000,
+            duration=60_000, burst=1000,
+        )
+    ] * 50
+    cols = _columns(reqs)
+    rounds_before = eng_native.rounds_total
+    a = eng_native.apply_columnar(*cols)
+    assert eng_native.rounds_total == rounds_before + 1, (
+        "hot-key batch should collapse to one mesh dispatch"
+    )
+    b = eng_fallback.apply_columnar(*cols)
+    for col_a, col_b in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(col_a), np.asarray(col_b))
+    # Remaining must reflect all 50 hits on one bucket.
+    assert int(a[2][-1]) == 1000 - 50
+
+
+def test_multi_schedule_ttl_mirror(frozen_clock):
+    """The in-call TTL writes must match the deferred set_expiry they
+    replace: after the TTLs lapse, cross-batch evictions must count as
+    EXPIRED (unexpired_evictions equivalence is pinned per-batch in
+    test_multi_schedule_matches_fallback; this pins the absolute
+    semantics across a clock jump)."""
+    eng = ShardedDecisionEngine(shard_capacity=4, clock=frozen_clock)
+    _require_native(eng)
+    eng.apply_columnar(*_columns(_fuzz_reqs(random.Random(7), 64, 60)))
+    base_unexpired = [t.unexpired_evictions for t in eng.tables]
+    # Push far past every TTL, then force evictions with fresh keys —
+    # every evicted slot's mirror TTL must read as lapsed.
+    frozen_clock.advance(ms=10 * 60_000)
+    reqs2 = [
+        RateLimitReq(
+            name=f"{i}fresh", unique_key=f"{i}y", hits=1, limit=10,
+            duration=60_000,
+        )
+        for i in range(64)
+    ]
+    eng.apply_columnar(*_columns(reqs2))
+    assert [t.unexpired_evictions for t in eng.tables] == base_unexpired, [
+        (t.evictions, t.unexpired_evictions) for t in eng.tables
+    ]
