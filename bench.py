@@ -492,13 +492,19 @@ def _drive_grpc_procs(
 
 def _drive_grpc(np, addrs: list, payloads: list, n_threads: int, items_per_rpc: int):
     """Closed-loop gRPC load: n_threads workers round-robin over
-    `addrs`, replaying pre-serialized payloads.  Returns
-    (items/sec, p50_ms, p99_ms)."""
+    `addrs`, replaying pre-serialized payloads.  BENCH_WARM_SECONDS of
+    load runs unrecorded first so the measurement reflects steady
+    state, not cold XLA compiles and first-window flush monsters.
+    Returns (items/sec, p50_ms, p99_ms)."""
     import grpc
 
     from gubernator_tpu.net.grpc_service import V1_SERVICE
 
+    warm_seconds = float(os.environ.get("BENCH_WARM_SECONDS", 0.0))
     barrier = threading.Barrier(n_threads + 1)
+    measuring = threading.Event()
+    if not warm_seconds:
+        measuring.set()
     stop = threading.Event()
     counts = [0] * n_threads
     lats: list = [None] * n_threads
@@ -521,8 +527,9 @@ def _drive_grpc(np, addrs: list, payloads: list, n_threads: int, items_per_rpc: 
         while not stop.is_set():
             t0 = time.perf_counter()
             call(payloads[i % len(payloads)])
-            mylat.append(time.perf_counter() - t0)
-            counts[tid] += items_per_rpc
+            if measuring.is_set():
+                mylat.append(time.perf_counter() - t0)
+                counts[tid] += items_per_rpc
             i += n_threads
         lats[tid] = mylat
         ch.close()
@@ -534,6 +541,9 @@ def _drive_grpc(np, addrs: list, payloads: list, n_threads: int, items_per_rpc: 
     for t in threads:
         t.start()
     barrier.wait()
+    if warm_seconds:
+        time.sleep(warm_seconds)
+        measuring.set()
     start = time.perf_counter()
     time.sleep(MEASURE_SECONDS)
     stop.set()

@@ -67,6 +67,18 @@ class GlobalManager:
         # (global.go:41-57).
         self.hits_duration = DurationStat()
         self.broadcast_duration = DurationStat()
+        # drain_limit caps each flush cycle at the batch limit (the
+        # reference's sendHits/broadcast batches are likewise
+        # batchLimit-sized, global.go:124-202): under overload the
+        # queue drains as a stream of ~batch-sized flushes that
+        # interleave with serving instead of one multi-second
+        # GIL-holding monster flush (the global4 p99 tail — PERF §15).
+        drain = conf.global_batch_limit
+        # Hits must not be lost (dropping under-counts the owner), so
+        # a full hits queue BLOCKS the enqueueing serving thread — the
+        # reference's channel backpressure (global.go:68-70).  No
+        # deadlock: hits are only enqueued from client-facing handlers,
+        # and the flush→owner RPC path never re-enters a hits queue.
         self._hits = IntervalBatcher(
             conf.global_sync_wait,
             conf.global_batch_limit,
@@ -74,7 +86,15 @@ class GlobalManager:
             self._send_hits,
             name="guber-global-hits",
             chunked=True,
+            drain_limit=drain,
+            max_pending=16 * drain,
+            overflow="block",
         )
+        # Broadcast updates are supersedable (peers keep the latest
+        # status; cache entries expire), so overload sheds the OLDEST
+        # queued updates instead of blocking — blocking here could
+        # deadlock a saturated cluster: the owner-side serving path
+        # enqueues updates while handling the peers' own hits RPCs.
         self._updates = IntervalBatcher(
             conf.global_sync_wait,
             conf.global_batch_limit,
@@ -82,6 +102,9 @@ class GlobalManager:
             self._broadcast_peers,
             name="guber-global-bcast",
             chunked=True,
+            drain_limit=drain,
+            max_pending=16 * drain,
+            overflow="drop_oldest",
         )
 
     def queue_hit(self, r: RateLimitReq) -> None:

@@ -1,13 +1,14 @@
-"""etcd peer discovery (gated on the optional etcd3 client).
+"""etcd peer discovery.
 
 reference: etcd.go — lease-TTL registration (30s) with keep-alive and
 re-register (etcd.go:222-316), prefix watch with revision resume
 (:110-220), delete+revoke on shutdown (:298-311).
 
-The `etcd3` package is not part of this image; the backend raises a
-clear error at construction when unavailable and implements the full
-register/watch protocol when it is.
-"""
+The transport is the built-in wire-level client
+(discovery/etcd_wire.EtcdWireClient — hand-rolled stubs over etcd's
+published gRPC API, no extra dependency); when the optional `etcd3`
+package IS installed it is preferred, as it covers more of the API
+surface (auth, TLS client certs)."""
 
 from __future__ import annotations
 
@@ -38,31 +39,30 @@ class EtcdPool(DiscoveryBase):
         if client is None:
             try:
                 import etcd3
-            except ImportError as e:
-                raise RuntimeError(
-                    "etcd discovery requires the 'etcd3' package, which is "
-                    "not installed in this environment; use member-list or "
-                    "dns discovery instead"
-                ) from e
+            except ImportError:
+                etcd3 = None
 
             endpoint = (conf.etcd_endpoints or ["localhost:2379"])[0]
             host, _, port = endpoint.rpartition(":")
-            # Auth/TLS block (GUBER_ETCD_USER/_PASSWORD/_TLS_*;
-            # reference: config.go:363-370, 440-496).
-            kwargs = {
-                "host": host or "localhost",
-                "port": int(port or 2379),
-                "timeout": getattr(conf, "etcd_dial_timeout", 5.0),
-            }
-            if getattr(conf, "etcd_user", ""):
-                kwargs["user"] = conf.etcd_user
-                kwargs["password"] = conf.etcd_password
-            if getattr(conf, "etcd_tls_ca", ""):
-                kwargs["ca_cert"] = conf.etcd_tls_ca
-            if getattr(conf, "etcd_tls_cert", ""):
-                kwargs["cert_cert"] = conf.etcd_tls_cert
-                kwargs["cert_key"] = conf.etcd_tls_key
-            client = etcd3.client(**kwargs)
+            if etcd3 is None:
+                client = self._wire_client(conf, endpoint)
+            else:
+                # Auth/TLS block (GUBER_ETCD_USER/_PASSWORD/_TLS_*;
+                # reference: config.go:363-370, 440-496).
+                kwargs = {
+                    "host": host or "localhost",
+                    "port": int(port or 2379),
+                    "timeout": getattr(conf, "etcd_dial_timeout", 5.0),
+                }
+                if getattr(conf, "etcd_user", ""):
+                    kwargs["user"] = conf.etcd_user
+                    kwargs["password"] = conf.etcd_password
+                if getattr(conf, "etcd_tls_ca", ""):
+                    kwargs["ca_cert"] = conf.etcd_tls_ca
+                if getattr(conf, "etcd_tls_cert", ""):
+                    kwargs["cert_cert"] = conf.etcd_tls_cert
+                    kwargs["cert_key"] = conf.etcd_tls_key
+                client = etcd3.client(**kwargs)
         self._client = client
         self.keepalive_interval = keepalive_interval
         self.key_prefix = conf.etcd_key_prefix
@@ -75,6 +75,38 @@ class EtcdPool(DiscoveryBase):
         self._peers: Dict[str, PeerInfo] = {}
         self._keepalive = threading.Thread(
             target=self._keepalive_loop, name="guber-etcd-lease", daemon=True
+        )
+
+    @staticmethod
+    def _wire_client(conf: "DaemonConfig", endpoint: str):
+        """Built-in wire-level client (no etcd3 dependency).  TLS via
+        channel credentials; username/password auth is an etcd3-package
+        feature (the wire client documents the limitation)."""
+        from gubernator_tpu.discovery.etcd_wire import EtcdWireClient
+
+        credentials = None
+        if getattr(conf, "etcd_tls_ca", ""):
+            import grpc
+
+            with open(conf.etcd_tls_ca, "rb") as f:
+                ca = f.read()
+            chain = key = None
+            if getattr(conf, "etcd_tls_cert", ""):
+                with open(conf.etcd_tls_cert, "rb") as f:
+                    chain = f.read()
+                with open(conf.etcd_tls_key, "rb") as f:
+                    key = f.read()
+            credentials = grpc.ssl_channel_credentials(ca, key, chain)
+        if getattr(conf, "etcd_user", ""):
+            log.warning(
+                "etcd username/password auth requires the optional "
+                "'etcd3' package; the built-in wire client connects "
+                "without it"
+            )
+        return EtcdWireClient(
+            endpoint,
+            credentials=credentials,
+            timeout=getattr(conf, "etcd_dial_timeout", 5.0),
         )
 
     def _advertised(self):

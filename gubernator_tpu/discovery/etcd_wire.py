@@ -1,0 +1,602 @@
+"""Wire-level etcd v3 client (no `etcd3` package needed) and a
+protocol-faithful in-process mini-etcd for integration tests.
+
+`EtcdWireClient` speaks the real etcd gRPC API — the same service
+paths (/etcdserverpb.KV/Range, /etcdserverpb.Lease/LeaseKeepAlive,
+/etcdserverpb.Watch/Watch) and message numbering a real cluster
+expects (net/proto/etcd_rpc.proto) — through hand-rolled stubs, and
+exposes the etcd3-client-shaped surface EtcdPool consumes (lease/
+put/get_prefix/watch/delete).  With it, etcd discovery works in this
+image without the optional dependency: point GUBER_ETCD_ENDPOINT at a
+real cluster and the same bytes flow.
+
+`MiniEtcdServer` implements the same API subset with real semantics —
+revisions, lease TTL expiry revoking attached keys, keep-alive
+extension, half-open [key, range_end) ranges, watch streams with
+created/canceled responses and PUT/DELETE events — so the integration
+test (tests/test_etcd_wire.py) exercises EtcdPool end-to-end over
+real gRPC framing rather than API-shaped fakes.
+
+reference: etcd.go:110-316 (clientv3 usage this mirrors on the wire).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from gubernator_tpu.net.pb import etcd_kv_pb2 as kvpb
+from gubernator_tpu.net.pb import etcd_rpc_pb2 as rpc
+
+KV_SERVICE = "etcdserverpb.KV"
+LEASE_SERVICE = "etcdserverpb.Lease"
+WATCH_SERVICE = "etcdserverpb.Watch"
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """etcd's half-open prefix upper bound: last byte + 1 (with 0xff
+    carry); all-0xff prefixes watch to the end of keyspace (b"\\0")."""
+    end = bytearray(prefix)
+    while end:
+        if end[-1] < 0xFF:
+            end[-1] += 1
+            return bytes(end)
+        end.pop()
+    return b"\x00"
+
+
+class _WireLease:
+    """etcd3.Lease-shaped handle over the wire client."""
+
+    def __init__(self, client: "EtcdWireClient", lease_id: int, ttl: int):
+        self._client = client
+        self.id = lease_id
+        self.ttl = ttl
+
+    def refresh(self):
+        resp = self._client.lease_keepalive_once(self.id)
+        if resp.TTL <= 0:
+            raise RuntimeError(f"lease {self.id} expired on the server")
+        return [resp]
+
+    def revoke(self) -> None:
+        self._client.lease_revoke(self.id)
+
+
+class EtcdWireClient:
+    """The etcd3-client API surface EtcdPool needs, over raw gRPC."""
+
+    def __init__(
+        self,
+        target: str = "localhost:2379",
+        *,
+        credentials: Optional[grpc.ChannelCredentials] = None,
+        timeout: float = 5.0,
+    ):
+        self.timeout = timeout
+        if credentials is not None:
+            self._channel = grpc.secure_channel(target, credentials)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        ch = self._channel
+        self._range = ch.unary_unary(
+            f"/{KV_SERVICE}/Range",
+            request_serializer=rpc.RangeRequest.SerializeToString,
+            response_deserializer=rpc.RangeResponse.FromString,
+        )
+        self._put = ch.unary_unary(
+            f"/{KV_SERVICE}/Put",
+            request_serializer=rpc.PutRequest.SerializeToString,
+            response_deserializer=rpc.PutResponse.FromString,
+        )
+        self._delete_range = ch.unary_unary(
+            f"/{KV_SERVICE}/DeleteRange",
+            request_serializer=rpc.DeleteRangeRequest.SerializeToString,
+            response_deserializer=rpc.DeleteRangeResponse.FromString,
+        )
+        self._lease_grant = ch.unary_unary(
+            f"/{LEASE_SERVICE}/LeaseGrant",
+            request_serializer=rpc.LeaseGrantRequest.SerializeToString,
+            response_deserializer=rpc.LeaseGrantResponse.FromString,
+        )
+        self._lease_revoke = ch.unary_unary(
+            f"/{LEASE_SERVICE}/LeaseRevoke",
+            request_serializer=rpc.LeaseRevokeRequest.SerializeToString,
+            response_deserializer=rpc.LeaseRevokeResponse.FromString,
+        )
+        self._lease_keepalive = ch.stream_stream(
+            f"/{LEASE_SERVICE}/LeaseKeepAlive",
+            request_serializer=rpc.LeaseKeepAliveRequest.SerializeToString,
+            response_deserializer=rpc.LeaseKeepAliveResponse.FromString,
+        )
+        self._watch = ch.stream_stream(
+            f"/{WATCH_SERVICE}/Watch",
+            request_serializer=rpc.WatchRequest.SerializeToString,
+            response_deserializer=rpc.WatchResponse.FromString,
+        )
+        self._watches: Dict[int, "_WatchStream"] = {}
+        self._next_watch = 0
+        self._lock = threading.Lock()
+
+    # -- etcd3-shaped surface ------------------------------------------
+
+    def lease(self, ttl: int) -> _WireLease:
+        resp = self._lease_grant(
+            rpc.LeaseGrantRequest(TTL=ttl), timeout=self.timeout
+        )
+        if resp.error:
+            raise RuntimeError(f"LeaseGrant: {resp.error}")
+        return _WireLease(self, resp.ID, resp.TTL)
+
+    def put(self, key, value, lease=None) -> None:
+        lease_id = getattr(lease, "id", lease) or 0
+        self._put(
+            rpc.PutRequest(
+                key=_b(key), value=_b(value), lease=int(lease_id)
+            ),
+            timeout=self.timeout,
+        )
+
+    def get_prefix(self, prefix):
+        resp = self._range(
+            rpc.RangeRequest(
+                key=_b(prefix), range_end=prefix_range_end(_b(prefix))
+            ),
+            timeout=self.timeout,
+        )
+        for kv in resp.kvs:
+            yield kv.value, kv
+
+    def delete(self, key) -> bool:
+        resp = self._delete_range(
+            rpc.DeleteRangeRequest(key=_b(key)), timeout=self.timeout
+        )
+        return resp.deleted > 0
+
+    def add_watch_prefix_callback(
+        self, prefix, callback: Callable
+    ) -> int:
+        with self._lock:
+            watch_id = self._next_watch
+            self._next_watch += 1
+        ws = _WatchStream(self._watch, _b(prefix), callback)
+        ws.start()
+        with self._lock:
+            self._watches[watch_id] = ws
+        return watch_id
+
+    def cancel_watch(self, watch_id: int) -> None:
+        with self._lock:
+            ws = self._watches.pop(watch_id, None)
+        if ws is not None:
+            ws.stop()
+
+    # -- lower-level helpers -------------------------------------------
+
+    def lease_keepalive_once(self, lease_id: int):
+        """One keep-alive exchange on a short-lived stream (what
+        etcd3.Lease.refresh does per call)."""
+
+        def reqs():
+            yield rpc.LeaseKeepAliveRequest(ID=lease_id)
+
+        for resp in self._lease_keepalive(reqs(), timeout=self.timeout):
+            return resp
+        raise RuntimeError("LeaseKeepAlive stream yielded no response")
+
+    def lease_revoke(self, lease_id: int) -> None:
+        self._lease_revoke(
+            rpc.LeaseRevokeRequest(ID=lease_id), timeout=self.timeout
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            watches = list(self._watches.values())
+            self._watches.clear()
+        for ws in watches:
+            ws.stop()
+        self._channel.close()
+
+
+def _b(v) -> bytes:
+    return v.encode() if isinstance(v, str) else bytes(v)
+
+
+class _WatchStream:
+    """One Watch bidi stream delivering events to a callback from a
+    background thread (resumes from the last seen revision on stream
+    failure — reference: etcd.go:110-220's watch-retry loop)."""
+
+    def __init__(self, stub, prefix: bytes, callback: Callable):
+        self._stub = stub
+        self._prefix = prefix
+        self._callback = callback
+        self._stopped = threading.Event()
+        self._call = None
+        self._last_rev = 0
+        self._thread = threading.Thread(
+            target=self._run, name="guber-etcd-watch", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        call = self._call
+        if call is not None:
+            call.cancel()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._watch_once()
+            except grpc.RpcError:
+                if self._stopped.is_set():
+                    return
+                time.sleep(0.2)  # transient; resume from _last_rev
+
+    def _watch_once(self) -> None:
+        create = rpc.WatchRequest(
+            create_request=rpc.WatchCreateRequest(
+                key=self._prefix,
+                range_end=prefix_range_end(self._prefix),
+                start_revision=(
+                    self._last_rev + 1 if self._last_rev else 0
+                ),
+            )
+        )
+        hold = threading.Event()
+
+        def reqs():
+            yield create
+            hold.wait()  # keep the send side open until cancelled
+
+        self._call = self._stub(reqs())
+        try:
+            for resp in self._call:
+                if resp.header.revision:
+                    self._last_rev = max(
+                        self._last_rev, resp.header.revision
+                    )
+                if resp.canceled or self._stopped.is_set():
+                    return
+                for ev in resp.events:
+                    self._callback(ev)
+        finally:
+            hold.set()
+
+
+# ---------------------------------------------------------------------
+# In-process mini etcd (integration-test server).
+
+
+class MiniEtcdServer:
+    """etcd v3 API subset with real semantics, served over real gRPC.
+
+    Supported: revisions, Range/Put/DeleteRange over [key, range_end),
+    leases with TTL expiry that revokes attached keys, keep-alive
+    extension, watch streams (created/canceled responses, PUT/DELETE
+    events, start_revision replay is NOT kept — events are delivered
+    from subscription time, which is what the discovery client needs).
+    """
+
+    def __init__(self, *, sweep_interval: float = 0.25):
+        self._lock = threading.Lock()
+        self._kv: Dict[bytes, kvpb.KeyValue] = {}
+        self._rev = 0
+        self._leases: Dict[int, dict] = {}
+        self._next_lease = 1000
+        self._watchers: List[dict] = []
+        self._sweep_interval = sweep_interval
+        self._closed = threading.Event()
+        self._server = grpc.server(
+            __import__("concurrent.futures", fromlist=["ThreadPoolExecutor"])
+            .ThreadPoolExecutor(max_workers=16, thread_name_prefix="mini-etcd")
+        )
+        self._register_services()
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self.address = f"127.0.0.1:{self.port}"
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="mini-etcd-sweep", daemon=True
+        )
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "MiniEtcdServer":
+        self._server.start()
+        self._sweeper.start()
+        return self
+
+    def stop(self) -> None:
+        self._closed.set()
+        self._server.stop(grace=0.5).wait()
+
+    # -- core state ----------------------------------------------------
+
+    def _header(self) -> rpc.ResponseHeader:
+        return rpc.ResponseHeader(
+            cluster_id=1, member_id=1, revision=self._rev, raft_term=1
+        )
+
+    def _in_range(self, key: bytes, start: bytes, end: bytes) -> bool:
+        if not end:
+            return key == start
+        if end == b"\x00":
+            return key >= start
+        return start <= key < end
+
+    def _notify_locked(self, event: kvpb.Event) -> None:
+        for w in self._watchers:
+            if self._in_range(event.kv.key, w["key"], w["range_end"]):
+                w["queue"].put(
+                    rpc.WatchResponse(
+                        header=self._header(),
+                        watch_id=w["watch_id"],
+                        events=[event],
+                    )
+                )
+
+    def _put_locked(self, key: bytes, value: bytes, lease_id: int) -> None:
+        self._rev += 1
+        old = self._kv.get(key)
+        kv = kvpb.KeyValue(
+            key=key,
+            value=value,
+            create_revision=(
+                old.create_revision if old is not None else self._rev
+            ),
+            mod_revision=self._rev,
+            version=(old.version + 1 if old is not None else 1),
+            lease=lease_id,
+        )
+        self._kv[key] = kv
+        if lease_id:
+            self._leases[lease_id]["keys"].add(key)
+        self._notify_locked(kvpb.Event(type=kvpb.Event.PUT, kv=kv))
+
+    def _delete_locked(self, key: bytes) -> bool:
+        old = self._kv.pop(key, None)
+        if old is None:
+            return False
+        self._rev += 1
+        if old.lease and old.lease in self._leases:
+            self._leases[old.lease]["keys"].discard(key)
+        tomb = kvpb.KeyValue(key=key, mod_revision=self._rev)
+        self._notify_locked(
+            kvpb.Event(type=kvpb.Event.DELETE, kv=tomb, prev_kv=old)
+        )
+        return True
+
+    def _revoke_locked(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in sorted(lease["keys"]):
+            self._delete_locked(key)
+
+    def _sweep_loop(self) -> None:
+        while not self._closed.wait(self._sweep_interval):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    lid
+                    for lid, lease in self._leases.items()
+                    if lease["expires"] <= now
+                ]
+                for lid in expired:
+                    self._revoke_locked(lid)
+
+    # -- RPC handlers --------------------------------------------------
+
+    def _range(self, req: rpc.RangeRequest, ctx) -> rpc.RangeResponse:
+        with self._lock:
+            kvs = [
+                kv
+                for key, kv in sorted(self._kv.items())
+                if self._in_range(key, req.key, req.range_end)
+            ]
+            return rpc.RangeResponse(
+                header=self._header(), kvs=kvs, count=len(kvs)
+            )
+
+    def _put_rpc(self, req: rpc.PutRequest, ctx) -> rpc.PutResponse:
+        with self._lock:
+            if req.lease and req.lease not in self._leases:
+                ctx.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    "etcdserver: requested lease not found",
+                )
+            self._put_locked(req.key, req.value, req.lease)
+            return rpc.PutResponse(header=self._header())
+
+    def _delete_rpc(
+        self, req: rpc.DeleteRangeRequest, ctx
+    ) -> rpc.DeleteRangeResponse:
+        with self._lock:
+            keys = [
+                key
+                for key in sorted(self._kv)
+                if self._in_range(key, req.key, req.range_end)
+            ]
+            deleted = sum(1 for key in keys if self._delete_locked(key))
+            return rpc.DeleteRangeResponse(
+                header=self._header(), deleted=deleted
+            )
+
+    def _lease_grant(
+        self, req: rpc.LeaseGrantRequest, ctx
+    ) -> rpc.LeaseGrantResponse:
+        with self._lock:
+            lid = req.ID or self._next_lease
+            self._next_lease = max(self._next_lease, lid) + 1
+            ttl = max(int(req.TTL), 1)
+            self._leases[lid] = {
+                "ttl": ttl,
+                "expires": time.monotonic() + ttl,
+                "keys": set(),
+            }
+            return rpc.LeaseGrantResponse(
+                header=self._header(), ID=lid, TTL=ttl
+            )
+
+    def _lease_revoke(
+        self, req: rpc.LeaseRevokeRequest, ctx
+    ) -> rpc.LeaseRevokeResponse:
+        with self._lock:
+            self._revoke_locked(req.ID)
+            return rpc.LeaseRevokeResponse(header=self._header())
+
+    def _lease_keepalive(self, request_iterator, ctx):
+        for req in request_iterator:
+            with self._lock:
+                lease = self._leases.get(req.ID)
+                if lease is None:
+                    # Real etcd answers TTL=0 for unknown leases.
+                    yield rpc.LeaseKeepAliveResponse(
+                        header=self._header(), ID=req.ID, TTL=0
+                    )
+                    continue
+                lease["expires"] = time.monotonic() + lease["ttl"]
+                yield rpc.LeaseKeepAliveResponse(
+                    header=self._header(), ID=req.ID, TTL=lease["ttl"]
+                )
+
+    def _watch_rpc(self, request_iterator, ctx):
+        out: "queue.Queue" = queue.Queue()
+        my_watches: List[dict] = []
+        next_id = [1]
+        done = threading.Event()
+
+        def reader() -> None:
+            try:
+                for req in request_iterator:
+                    which = req.WhichOneof("request_union")
+                    if which == "create_request":
+                        cr = req.create_request
+                        w = {
+                            "key": cr.key,
+                            "range_end": cr.range_end,
+                            "watch_id": next_id[0],
+                            "queue": out,
+                        }
+                        next_id[0] += 1
+                        with self._lock:
+                            self._watchers.append(w)
+                        my_watches.append(w)
+                        out.put(
+                            rpc.WatchResponse(
+                                header=self._header(),
+                                watch_id=w["watch_id"],
+                                created=True,
+                            )
+                        )
+                    elif which == "cancel_request":
+                        wid = req.cancel_request.watch_id
+                        for w in my_watches:
+                            if w["watch_id"] == wid:
+                                with self._lock:
+                                    if w in self._watchers:
+                                        self._watchers.remove(w)
+                                out.put(
+                                    rpc.WatchResponse(
+                                        header=self._header(),
+                                        watch_id=wid,
+                                        canceled=True,
+                                    )
+                                )
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+            finally:
+                done.set()
+                out.put(None)
+
+        t = threading.Thread(
+            target=reader, name="mini-etcd-watch-reader", daemon=True
+        )
+        t.start()
+        try:
+            while True:
+                item = out.get()
+                if item is None:
+                    if done.is_set():
+                        return
+                    continue
+                yield item
+        finally:
+            with self._lock:
+                for w in my_watches:
+                    if w in self._watchers:
+                        self._watchers.remove(w)
+
+    # -- registration --------------------------------------------------
+
+    def _register_services(self) -> None:
+        def unary(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString,
+            )
+
+        self._server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    KV_SERVICE,
+                    {
+                        "Range": unary(
+                            self._range, rpc.RangeRequest, rpc.RangeResponse
+                        ),
+                        "Put": unary(
+                            self._put_rpc, rpc.PutRequest, rpc.PutResponse
+                        ),
+                        "DeleteRange": unary(
+                            self._delete_rpc,
+                            rpc.DeleteRangeRequest,
+                            rpc.DeleteRangeResponse,
+                        ),
+                    },
+                ),
+                grpc.method_handlers_generic_handler(
+                    LEASE_SERVICE,
+                    {
+                        "LeaseGrant": unary(
+                            self._lease_grant,
+                            rpc.LeaseGrantRequest,
+                            rpc.LeaseGrantResponse,
+                        ),
+                        "LeaseRevoke": unary(
+                            self._lease_revoke,
+                            rpc.LeaseRevokeRequest,
+                            rpc.LeaseRevokeResponse,
+                        ),
+                        "LeaseKeepAlive": grpc.stream_stream_rpc_method_handler(
+                            self._lease_keepalive,
+                            request_deserializer=(
+                                rpc.LeaseKeepAliveRequest.FromString
+                            ),
+                            response_serializer=(
+                                rpc.LeaseKeepAliveResponse.SerializeToString
+                            ),
+                        ),
+                    },
+                ),
+                grpc.method_handlers_generic_handler(
+                    WATCH_SERVICE,
+                    {
+                        "Watch": grpc.stream_stream_rpc_method_handler(
+                            self._watch_rpc,
+                            request_deserializer=rpc.WatchRequest.FromString,
+                            response_serializer=(
+                                rpc.WatchResponse.SerializeToString
+                            ),
+                        ),
+                    },
+                ),
+            )
+        )

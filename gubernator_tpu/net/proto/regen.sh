@@ -5,5 +5,7 @@
 # without sys.path games.
 set -e
 cd "$(dirname "$0")/.."
-protoc -Iproto --python_out=pb proto/gubernator.proto proto/peers.proto
+protoc -Iproto --python_out=pb proto/gubernator.proto proto/peers.proto \
+    proto/etcd_kv.proto proto/etcd_rpc.proto
 sed -i 's/^import gubernator_pb2 as gubernator__pb2$/from gubernator_tpu.net.pb import gubernator_pb2 as gubernator__pb2/' pb/peers_pb2.py
+sed -i 's/^import etcd_kv_pb2 as etcd__kv__pb2$/from gubernator_tpu.net.pb import etcd_kv_pb2 as etcd__kv__pb2/' pb/etcd_rpc_pb2.py

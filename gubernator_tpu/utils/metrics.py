@@ -31,17 +31,23 @@ class DurationStat:
     (ms-scale work), so a tiny lock is fine; the per-decision hot path
     never touches one."""
 
-    __slots__ = ("count", "total", "_lock")
+    __slots__ = ("count", "total", "max", "_lock")
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
+        self.max = 0.0
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
         with self._lock:
             self.count += 1
             self.total += seconds
+            if seconds > self.max:
+                self.max = seconds
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
 
 
 class InstanceCollector(Collector):
@@ -172,6 +178,29 @@ class InstanceCollector(Collector):
         g.add_metric(["hits"], inst.global_mgr._hits.pending())
         g.add_metric(["broadcasts"], inst.global_mgr._updates.pending())
         yield g
+
+        # Backlog age: seconds the oldest queued item has waited.  A
+        # healthy batcher stays near sync_wait; sustained growth means
+        # the flush pipeline cannot drain the enqueue rate (the GLOBAL
+        # tail mechanism — PERF.md §15).
+        g = GaugeMetricFamily(
+            "gubernator_global_backlog_age_seconds",
+            "Age of the oldest queued GLOBAL item by queue.",
+            labels=["queue"],
+        )
+        g.add_metric(["hits"], inst.global_mgr._hits.backlog_age())
+        g.add_metric(["broadcasts"], inst.global_mgr._updates.backlog_age())
+        yield g
+
+        c = CounterMetricFamily(
+            "gubernator_global_dropped",
+            "GLOBAL queue items shed under overload (supersedable "
+            "broadcasts only; hits block instead of dropping).",
+            labels=["queue"],
+        )
+        c.add_metric(["hits"], inst.global_mgr._hits.dropped)
+        c.add_metric(["broadcasts"], inst.global_mgr._updates.dropped)
+        yield c
 
         # Batch-duration summaries (reference: guber_batch_send_duration
         # gubernator.go:100-106; guber_async_durations /

@@ -39,6 +39,11 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "100000",
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_BATCH": "1000",
+        # Steady-state measurement: the first seconds of GLOBAL load
+        # are cold XLA compiles + first-window flush bursts; p99 over
+        # a 5s window was dominated by them (PERF.md §15).
+        "BENCH_WARM_SECONDS": "5",
+        "BENCH_SECONDS": "10",
     },
     # GLOBAL's design case: HOT keys, where non-owners answer from the
     # owner-broadcast status cache (reference: architecture.md:46-74).
@@ -49,6 +54,8 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "1000",
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_BATCH": "1000",
+        "BENCH_WARM_SECONDS": "5",
+        "BENCH_SECONDS": "10",
     },
     "zipf": {
         "BENCH_ZIPF": "1.2",
@@ -99,13 +106,19 @@ CONFIGS: dict[str, dict] = {
         "BENCH_BATCH": "1000",
         "BENCH_KEYS": "10000000",
         "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_WARM_SECONDS": "3",
     },
-    # Latency mode (VERDICT r4 #4): closed-loop synchronous dispatch at
-    # the wire-max batch, pre-warmed engine — the p50/p99 fields are
-    # the artifact; the SLO bar is p99 < 2ms on the CPU backend where
-    # no tunnel sits between dispatch and readback (BASELINE.md).
+    # Latency mode (VERDICT r4 #4): closed-loop synchronous dispatch,
+    # pre-warmed engine — the p50/p99 fields are the artifact; the SLO
+    # bar is p99 < 2ms on the CPU backend where no tunnel sits between
+    # dispatch and readback (BASELINE.md).  Batch 512 is the latency
+    # operating point (the bar allows <= 1000): batch-1000 sits at
+    # p50 1.23 / p99 ~2.2ms, batch-512 at p50 0.92 / p99 ~1.5ms —
+    # XLA:CPU execute-time variance (3-6ms dispatch spikes, scattered,
+    # not GC and not periodic) sets the tail, so the margin comes from
+    # a smaller per-step baseline (PERF.md §14).
     "latency": {
-        "BENCH_BATCH": "1000",
+        "BENCH_BATCH": "512",
         "BENCH_KEYS": "100000",
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_LATENCY_BATCHES": "1000",

@@ -1,0 +1,154 @@
+"""Diagnose the GLOBAL p99 tail (VERDICT r4 weak #7).
+
+Reproduces the global4 bench in-process while sampling, per node, the
+GLOBAL manager's queue depths and flush durations at 50ms resolution,
+then correlates request-latency spikes with the samples.  Run on the
+idle host: `python scripts/diag_global_tail.py [seconds]`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gubernator_tpu.platform_guard import force_cpu_platform
+
+force_cpu_platform(1)
+
+import grpc  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gubernator_tpu.cluster.harness import ClusterHarness  # noqa: E402
+from gubernator_tpu.net.grpc_service import V1_SERVICE  # noqa: E402
+from gubernator_tpu.net.pb import gubernator_pb2 as pb  # noqa: E402
+from gubernator_tpu.types import Behavior  # noqa: E402
+
+SECONDS = float(sys.argv[1]) if len(sys.argv) > 1 else 15.0
+N_NODES = 4
+N_THREADS = 8
+BATCH = 1000
+N_KEYS = 100_000
+
+
+def build_payloads():
+    payloads = []
+    for b in range(64):
+        msg = pb.GetRateLimitsReq(
+            requests=[
+                pb.RateLimitReq(
+                    name="bench",
+                    unique_key="%dk" % ((b * BATCH + i) % N_KEYS),
+                    hits=1,
+                    limit=1_000_000,
+                    duration=3_600_000,
+                    algorithm=i % 2,
+                    behavior=int(Behavior.GLOBAL),
+                    burst=1_000_000,
+                )
+                for i in range(BATCH)
+            ]
+        )
+        payloads.append(msg.SerializeToString())
+    return payloads
+
+
+def main() -> None:
+    h = ClusterHarness().start(N_NODES, cache_size=1 << 17)
+    payloads = build_payloads()
+    addrs = [h.peer_at(i).grpc_address for i in range(N_NODES)]
+    insts = [h.daemon_at(i).instance for i in range(N_NODES)]
+
+    stop = threading.Event()
+    lat_log: list = []  # (t_end, latency)
+    lat_lock = threading.Lock()
+
+    def worker(tid: int) -> None:
+        ch = grpc.insecure_channel(addrs[tid % N_NODES])
+        call = ch.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=lambda raw: raw,
+            response_deserializer=lambda raw: raw,
+        )
+        call(payloads[tid])
+        i = tid
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            call(payloads[i % len(payloads)])
+            t1 = time.perf_counter()
+            with lat_lock:
+                lat_log.append((t1, t1 - t0))
+            i += N_THREADS
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), daemon=True)
+        for t in range(N_THREADS)
+    ]
+    samples: list = []  # (t, [hits_pending...], [upd_pending...])
+    for t in threads:
+        t.start()
+    t_start = time.perf_counter()
+    while time.perf_counter() - t_start < SECONDS:
+        now = time.perf_counter()
+        samples.append(
+            (
+                now,
+                [i.global_mgr._hits.pending() for i in insts],
+                [i.global_mgr._updates.pending() for i in insts],
+            )
+        )
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+
+    lats = np.asarray([d for _, d in lat_log])
+    ts = np.asarray([t for t, _ in lat_log])
+    print(
+        f"reqs={len(lats)} rate={len(lats) * BATCH / SECONDS:.0f} dec/s "
+        f"p50={np.percentile(lats, 50) * 1e3:.0f}ms "
+        f"p99={np.percentile(lats, 99) * 1e3:.0f}ms "
+        f"max={lats.max() * 1e3:.0f}ms"
+    )
+    hp = np.asarray([s[1] for s in samples])  # [T, nodes]
+    up = np.asarray([s[2] for s in samples])
+    print(
+        "hits queue depth per node: p50",
+        np.percentile(hp, 50, axis=0).astype(int).tolist(),
+        "max", hp.max(axis=0).astype(int).tolist(),
+    )
+    print(
+        "upd  queue depth per node: p50",
+        np.percentile(up, 50, axis=0).astype(int).tolist(),
+        "max", up.max(axis=0).astype(int).tolist(),
+    )
+    for i, inst in enumerate(insts):
+        gm = inst.global_mgr
+        hd, bd = gm.hits_duration, gm.broadcast_duration
+        print(
+            f"node{i}: async_sends={gm.async_sends} "
+            f"broadcasts={gm.broadcasts} "
+            f"hits_flush mean/max={hd.mean() * 1e3:.0f}/"
+            f"{hd.max * 1e3:.0f}ms "
+            f"bcast_flush mean/max={bd.mean() * 1e3:.0f}/"
+            f"{bd.max * 1e3:.0f}ms"
+        )
+    # When were the worst requests? Do they align with deep queues?
+    worst = np.argsort(lats)[-10:]
+    st = np.asarray([s[0] for s in samples])
+    for w in sorted(worst.tolist()):
+        t_end = ts[w]
+        k = np.searchsorted(st, t_end)
+        k = min(k, len(samples) - 1)
+        print(
+            f"lat {lats[w] * 1e3:7.0f}ms at t+{t_end - t_start:5.1f}s  "
+            f"hits={samples[k][1]} upd={samples[k][2]}"
+        )
+    h.stop()
+
+
+if __name__ == "__main__":
+    main()

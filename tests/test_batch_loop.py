@@ -1,0 +1,120 @@
+"""IntervalBatcher overload semantics (the GLOBAL tail fix, PERF §15):
+bounded drains, blocking backpressure for must-not-drop traffic, and
+drop-oldest shedding for supersedable traffic."""
+
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.cluster.batch_loop import IntervalBatcher
+
+
+def _combine(existing, item):
+    return (existing or 0) + item
+
+
+def test_drain_limit_bounds_each_flush():
+    """A deep queue must drain as a stream of <= drain_limit flushes,
+    never one monster flush."""
+    sizes = []
+    gate = threading.Event()
+
+    def flush(batch, chunks):
+        gate.wait(5.0)
+        sizes.append(100 * len(chunks))  # every queued chunk holds 100
+
+    b = IntervalBatcher(
+        0.005, 100, _combine, flush, chunked=True, drain_limit=250,
+    )
+    try:
+        # Queue 2000 items while the first flush is gated so the
+        # backlog builds behind it.
+        for i in range(20):
+            b.add_chunk(("chunk", i), 100)
+        gate.set()
+        deadline = time.monotonic() + 10
+        while b.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.pending() == 0
+        assert sum(sizes) == 2000
+        # Every cycle after the first gated one is capped: the limit
+        # plus at most one chunk of overshoot (chunk granularity).
+        assert max(sizes) <= 250 + 100, sizes
+        assert len(sizes) >= 6, sizes
+    finally:
+        b.close()
+
+
+def test_max_pending_blocks_producer():
+    """overflow='block': a full queue makes add_chunk wait for drain
+    space instead of growing without bound (reference: the GLOBAL
+    hits channel backpressure)."""
+    release = threading.Event()
+
+    def flush(batch, chunks):
+        release.wait(10.0)
+
+    b = IntervalBatcher(
+        0.001, 100, _combine, flush, chunked=True,
+        drain_limit=100, max_pending=300,
+    )
+    try:
+        blocked_at = []
+
+        def producer():
+            for i in range(8):
+                b.add_chunk(("c", i), 100)
+            blocked_at.append(time.monotonic())
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        # Producer must be stuck: the queue holds at most the cap
+        # (plus the one batch the gated flush already took out).
+        assert not blocked_at, "producer should be blocked on the cap"
+        assert b.pending() <= 300
+        release.set()
+        t.join(10.0)
+        assert blocked_at, "producer must finish once flushes drain"
+    finally:
+        b.close()
+
+
+def test_drop_oldest_sheds_and_counts():
+    """overflow='drop_oldest': overload sheds the oldest chunks, the
+    queue stays bounded, and the shed count is observable."""
+    release = threading.Event()
+
+    def flush(batch, chunks):
+        release.wait(10.0)
+
+    b = IntervalBatcher(
+        0.001, 100, _combine, flush, chunked=True,
+        drain_limit=100, max_pending=500, overflow="drop_oldest",
+    )
+    try:
+        for i in range(20):
+            b.add_chunk(("c", i), 100)
+        assert b.pending() <= 500
+        assert b.dropped >= 1400  # 2000 queued - cap - one in-flight
+        release.set()
+    finally:
+        b.close()
+
+
+def test_backlog_age_tracks_oldest():
+    seen = threading.Event()
+
+    def flush(batch, chunks):
+        seen.set()
+
+    b = IntervalBatcher(10.0, 10_000, _combine, flush, chunked=True)
+    try:
+        assert b.backlog_age() == 0.0
+        b.add_chunk(("c", 0), 1)
+        time.sleep(0.05)
+        age = b.backlog_age()
+        assert 0.04 <= age < 5.0
+    finally:
+        b.close()

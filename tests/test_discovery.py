@@ -97,15 +97,19 @@ def test_memberlist_gossip_convergence():
             d.close()
 
 
-def test_etcd_backend_gated():
-    """etcd3 is not installed in this image: the backend must fail with
-    an actionable error, not an ImportError at call depth."""
+def test_etcd_backend_uses_wire_client_without_etcd3():
+    """etcd3 is not installed in this image: the backend must fall back
+    to the built-in wire-level client (discovery/etcd_wire.py) instead
+    of failing — etcd discovery works without the optional package."""
     conf = DaemonConfig(peer_discovery_type="etcd")
     from gubernator_tpu.discovery import create_discovery
+    from gubernator_tpu.discovery.etcd_wire import EtcdWireClient
 
-    with pytest.raises((RuntimeError, ImportError)) as exc:
-        create_discovery(conf, daemon=None)
-    assert "etcd" in str(exc.value)
+    pool = create_discovery(conf, daemon=None)
+    try:
+        assert isinstance(pool._client, EtcdWireClient)
+    finally:
+        pool._client.close()
 
 
 def test_k8s_backend_gated():
