@@ -596,7 +596,7 @@ class DecisionEngine:
         ).astype(_I32)
         c[: len(cleared)] = cleared
         self._state = self._state._replace(
-            occupied=clear_occupied(self._state.occupied, jnp.asarray(c))
+            meta=clear_occupied(self._state.meta, jnp.asarray(c))
         )
 
     def _apply_restores(self, restores: List[tuple]) -> None:
@@ -1210,25 +1210,21 @@ class DecisionEngine:
 
         with self._lock:
             self._flush_pump()
-            s = self._state
-            occ = np.asarray(s.occupied)
-            algo = np.asarray(s.algo)
-            status = np.asarray(s.status)
+            from gubernator_tpu.ops.bucket_kernel import unpack_state_host
 
-            def c64(hi, lo):
-                return (
-                    np.asarray(hi).astype(np.int64) << 32
-                ) | np.asarray(lo).astype(np.int64)
-
-            limit = c64(s.limit_hi, s.limit_lo)
-            remaining = c64(s.remaining_hi, s.remaining_lo)
-            remf_hi = np.asarray(s.remf_hi)
-            remf_lo = np.asarray(s.remf_lo)
-            duration = c64(s.duration_hi, s.duration_lo)
-            t0 = c64(s.t0_hi, s.t0_lo)
-            expire = c64(s.expire_hi, s.expire_lo)
-            burst = c64(s.burst_hi, s.burst_lo)
-            invalid = c64(s.invalid_hi, s.invalid_lo)
+            u = unpack_state_host(self._state)
+            occ = u["occupied"]
+            algo = u["algo"]
+            status = u["status"]
+            limit = u["limit"]
+            remaining = u["remaining"]
+            remf_hi = u["remf_hi"]
+            remf_lo = u["remf_lo"]
+            duration = u["duration"]
+            t0 = u["t0"]
+            expire = u["expire"]
+            burst = u["burst"]
+            invalid = u["invalid"]
             slots = np.nonzero(occ)[0]
             keys = [self.table.key_for_slot(int(sl)) for sl in slots]
         from gubernator_tpu.store import item_from_record
@@ -1325,7 +1321,7 @@ class DecisionEngine:
                     np.arange(self.capacity, self.capacity + csize, dtype=np.int64).astype(_I32)
                 )
                 self._state = self._state._replace(
-                    occupied=clear_occupied(self._state.occupied, dummy)
+                    meta=clear_occupied(self._state.meta, dummy)
                 )
                 csize *= 2
             # Readback-combiner stack ladder: concurrent/pipelined

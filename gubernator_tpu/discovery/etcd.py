@@ -98,10 +98,14 @@ class EtcdPool(DiscoveryBase):
                     key = f.read()
             credentials = grpc.ssl_channel_credentials(ca, key, chain)
         if getattr(conf, "etcd_user", ""):
-            log.warning(
-                "etcd username/password auth requires the optional "
-                "'etcd3' package; the built-in wire client connects "
-                "without it"
+            # Fail fast (the pre-wire-client behavior): connecting
+            # unauthenticated to an auth-enabled cluster would start a
+            # "healthy" daemon whose discovery fails on every RPC.
+            raise RuntimeError(
+                "GUBER_ETCD_USER is set but etcd username/password auth "
+                "requires the optional 'etcd3' package (the built-in "
+                "wire client supports TLS but not etcd auth tokens); "
+                "install etcd3 or unset the credentials"
             )
         return EtcdWireClient(
             endpoint,

@@ -260,13 +260,18 @@ class _WatchStream:
         self._call = self._stub(reqs())
         try:
             for resp in self._call:
-                if resp.header.revision:
-                    self._last_rev = max(
-                        self._last_rev, resp.header.revision
-                    )
                 if resp.canceled or self._stopped.is_set():
                     return
                 for ev in resp.events:
+                    # Advance the resume point ONLY past revisions whose
+                    # events were actually delivered — taking it from an
+                    # event-less response header (the `created` ack) can
+                    # skip events the broken stream never sent, silently
+                    # losing a dead peer's DELETE on reconnect.
+                    if ev.kv.mod_revision:
+                        self._last_rev = max(
+                            self._last_rev, ev.kv.mod_revision
+                        )
                     self._callback(ev)
         finally:
             hold.set()
@@ -454,18 +459,22 @@ class MiniEtcdServer:
 
     def _lease_keepalive(self, request_iterator, ctx):
         for req in request_iterator:
+            # Build the response under the lock, yield OUTSIDE it — a
+            # client stalled on flow control would otherwise suspend
+            # the generator with the server-wide lock held.
             with self._lock:
                 lease = self._leases.get(req.ID)
                 if lease is None:
                     # Real etcd answers TTL=0 for unknown leases.
-                    yield rpc.LeaseKeepAliveResponse(
+                    resp = rpc.LeaseKeepAliveResponse(
                         header=self._header(), ID=req.ID, TTL=0
                     )
-                    continue
-                lease["expires"] = time.monotonic() + lease["ttl"]
-                yield rpc.LeaseKeepAliveResponse(
-                    header=self._header(), ID=req.ID, TTL=lease["ttl"]
-                )
+                else:
+                    lease["expires"] = time.monotonic() + lease["ttl"]
+                    resp = rpc.LeaseKeepAliveResponse(
+                        header=self._header(), ID=req.ID, TTL=lease["ttl"]
+                    )
+            yield resp
 
     def _watch_rpc(self, request_iterator, ctx):
         out: "queue.Queue" = queue.Queue()

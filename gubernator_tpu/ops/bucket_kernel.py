@@ -42,41 +42,54 @@ _UNDER = jnp.int32(int(Status.UNDER_LIMIT))
 
 
 class BucketState(NamedTuple):
-    """Struct-of-arrays bucket state, shape [capacity] per field.
+    """Struct-of-arrays bucket state, 48 bytes/slot (VERDICT r4 #6;
+    the round-4 layout was 19 plain arrays at 73 B/slot — 7.6 GB at
+    100 M keys).
 
     The fields of TokenBucketItem/LeakyBucketItem (reference:
     store.go:29-43) plus cache-item metadata (reference: cache.go:30-42):
-    `t0` = CreatedAt (token) / UpdatedAt (leaky); `expire_at` /
-    `invalid_at` mirror CacheItem.ExpireAt / InvalidAt.
+    `t0` = CreatedAt (token) / UpdatedAt (leaky); expire/invalid mirror
+    CacheItem.ExpireAt / InvalidAt.
 
-    64-bit logical fields are stored as (hi: int32, lo: uint32) pairs
-    (and float64 as its two bitcast words) because the TPU runtime has
-    no native 64-bit arrays: JAX's x64 shim would otherwise split and
-    recombine every capacity-sized array at the jit boundary on every
-    call — O(state) work per step (measured: ~8ms/step at 1M slots).
-    The kernel combines only the gathered B-sized views to int64/f64,
-    computes, and splits results back for the scatter.
+    64-bit logical fields travel as (hi: int32, lo: uint32) word pairs
+    because the TPU runtime has no native 64-bit arrays (JAX's x64 shim
+    would otherwise split/recombine every capacity-sized array at the
+    jit boundary — O(state) per step).  Three packings shrink the slot:
+
+    - `meta` folds occupied (bit 0), the algorithm (bit 1, normalized
+      to {0,1} — every non-zero wire value means LEAKY_BUCKET, the
+      documented divergence for out-of-enum algorithm ints), the
+      sticky token status (bits 2-3), and the HI WORDS of t0 and
+      invalid_at (11 bits each at bits 4-14 / 15-25): millisecond
+      timestamps fit 43 bits until the year 2248, so their hi words
+      fit 11.  Values clamp to [0, 2^43) at encode.
+    - `hi2` likewise folds the expire and duration hi words (duration
+      clamps at 2^43 ms ≈ 278 years; negative durations clamp to 0 —
+      both documented divergences at absurd inputs only).
+    - `rem` merges the token remaining (int64 words) and the leaky
+      32.32 fixed-point remaining (whole:int32, frac:uint32): a slot
+      runs one algorithm at a time, so the pair is interpreted through
+      the meta algo bit (`models/spec.py quantize_remf` defines the
+      leaky quantization; bit-equality stays fuzz-pinned either way).
     """
 
-    occupied: jax.Array  # bool
-    algo: jax.Array  # int32
-    status: jax.Array  # int32   (token sticky status)
+    meta: jax.Array  # int32 — see docstring bit layout
+    hi2: jax.Array  # int32 — expire hi (bits 0-10) | duration hi (11-21)
+    t0_lo: jax.Array  # uint32
+    expire_lo: jax.Array  # uint32
+    invalid_lo: jax.Array  # uint32
+    duration_lo: jax.Array  # uint32
     limit_hi: jax.Array  # int32
     limit_lo: jax.Array  # uint32
-    remaining_hi: jax.Array  # int32   (token)
-    remaining_lo: jax.Array  # uint32
-    remf_hi: jax.Array  # int32   (leaky remaining, whole part)
-    remf_lo: jax.Array  # uint32  (leaky remaining, 2^-32 fraction)
-    duration_hi: jax.Array  # int32
-    duration_lo: jax.Array  # uint32
-    t0_hi: jax.Array  # int32
-    t0_lo: jax.Array  # uint32
-    expire_hi: jax.Array  # int32
-    expire_lo: jax.Array  # uint32
+    rem_hi: jax.Array  # int32   (token int64 hi / leaky whole)
+    rem_lo: jax.Array  # uint32  (token int64 lo / leaky fraction)
     burst_hi: jax.Array  # int32
     burst_lo: jax.Array  # uint32
-    invalid_hi: jax.Array  # int32
-    invalid_lo: jax.Array  # uint32
+
+
+# Millisecond-timestamp clamp bound for the packed 11-bit hi words.
+TS_CLAMP_MAX = (1 << 43) - 1
+_HI11 = 0x7FF
 
 
 class BatchInput(NamedTuple):
@@ -124,26 +137,159 @@ def make_state(capacity: int) -> BucketState:
         return jnp.zeros((capacity,), dtype=dt)
 
     return BucketState(
-        occupied=z(jnp.bool_),
-        algo=z(_I32),
-        status=z(_I32),
+        meta=z(_I32),
+        hi2=z(_I32),
+        t0_lo=z(_U32),
+        expire_lo=z(_U32),
+        invalid_lo=z(_U32),
+        duration_lo=z(_U32),
         limit_hi=z(_I32),
         limit_lo=z(_U32),
-        remaining_hi=z(_I32),
-        remaining_lo=z(_U32),
-        remf_hi=z(_I32),
-        remf_lo=z(_U32),
-        duration_hi=z(_I32),
-        duration_lo=z(_U32),
-        t0_hi=z(_I32),
-        t0_lo=z(_U32),
-        expire_hi=z(_I32),
-        expire_lo=z(_U32),
+        rem_hi=z(_I32),
+        rem_lo=z(_U32),
         burst_hi=z(_I32),
         burst_lo=z(_U32),
-        invalid_hi=z(_I32),
-        invalid_lo=z(_U32),
     )
+
+
+def clamp_ts(v):
+    """Clamp a millisecond value into the packed-hi-word range (works
+    on jnp and np arrays alike)."""
+    return jnp.clip(v, 0, TS_CLAMP_MAX)
+
+
+def pack_meta(occ, algo_norm, status, t0c, invc):
+    """occupied/algo/status/t0/invalid → the meta word (values already
+    normalized/clamped; t0c/invc int64 in [0, 2^43))."""
+    return (
+        occ.astype(_I32)
+        | (algo_norm.astype(_I32) << 1)
+        | ((status & 3).astype(_I32) << 2)
+        | ((t0c >> 32).astype(_I32) << 4)
+        | ((invc >> 32).astype(_I32) << 15)
+    )
+
+
+def meta_occupied(meta):
+    return (meta & 1) != 0
+
+
+def meta_algo(meta):
+    return ((meta >> 1) & 1).astype(_I32)
+
+
+def meta_status(meta):
+    return ((meta >> 2) & 3).astype(_I32)
+
+
+def meta_t0(meta, t0_lo):
+    return (((meta >> 4) & _HI11).astype(_I64) << 32) | t0_lo.astype(_I64)
+
+
+def meta_invalid(meta, inv_lo):
+    return (((meta >> 15) & _HI11).astype(_I64) << 32) | inv_lo.astype(_I64)
+
+
+def pack_hi2(expc, durc):
+    """expire/duration (clamped int64) → the hi2 word."""
+    return ((expc >> 32).astype(_I32)) | (((durc >> 32).astype(_I32)) << 11)
+
+
+def hi2_expire(hi2, exp_lo):
+    return ((hi2 & _HI11).astype(_I64) << 32) | exp_lo.astype(_I64)
+
+
+def hi2_duration(hi2, dur_lo):
+    return (((hi2 >> 11) & _HI11).astype(_I64) << 32) | dur_lo.astype(_I64)
+
+
+def pack_state_host(logical: dict) -> dict:
+    """Encode logical numpy columns (keys as in `unpack_state_host`,
+    with the leaky remaining given as remf_hi/remf_lo words) into the
+    packed BucketState field arrays — bulk load/restore paths only."""
+    occ = np.asarray(logical["occupied"]).astype(bool)
+    algo = (np.asarray(logical["algo"]) != 0).astype(np.int32)
+    status = np.asarray(logical["status"]).astype(np.int64)
+    t0c = np.clip(np.asarray(logical["t0"]), 0, TS_CLAMP_MAX)
+    invc = np.clip(np.asarray(logical["invalid"]), 0, TS_CLAMP_MAX)
+    expc = np.clip(np.asarray(logical["expire"]), 0, TS_CLAMP_MAX)
+    durc = np.clip(np.asarray(logical["duration"]), 0, TS_CLAMP_MAX)
+    meta = (
+        occ.astype(np.int32)
+        | (algo << 1)
+        | ((status & 3).astype(np.int32) << 2)
+        | ((t0c >> 32).astype(np.int32) << 4)
+        | ((invc >> 32).astype(np.int32) << 15)
+    )
+    hi2 = ((expc >> 32).astype(np.int32)) | (
+        (durc >> 32).astype(np.int32) << 11
+    )
+    rem64 = np.asarray(logical["remaining"]).astype(np.int64)
+    leaky = algo == 1
+    rem_hi = np.where(
+        leaky, np.asarray(logical["remf_hi"]).astype(np.int32),
+        (rem64 >> 32).astype(np.int32),
+    )
+    rem_lo = np.where(
+        leaky, np.asarray(logical["remf_lo"]).astype(np.uint32),
+        (rem64 & 0xFFFFFFFF).astype(np.uint32),
+    )
+    limit64 = np.asarray(logical["limit"]).astype(np.int64)
+    burst64 = np.asarray(logical["burst"]).astype(np.int64)
+    return {
+        "meta": meta,
+        "hi2": hi2,
+        "t0_lo": (t0c & 0xFFFFFFFF).astype(np.uint32),
+        "expire_lo": (expc & 0xFFFFFFFF).astype(np.uint32),
+        "invalid_lo": (invc & 0xFFFFFFFF).astype(np.uint32),
+        "duration_lo": (durc & 0xFFFFFFFF).astype(np.uint32),
+        "limit_hi": (limit64 >> 32).astype(np.int32),
+        "limit_lo": (limit64 & 0xFFFFFFFF).astype(np.uint32),
+        "rem_hi": rem_hi,
+        "rem_lo": rem_lo,
+        "burst_hi": (burst64 >> 32).astype(np.int32),
+        "burst_lo": (burst64 & 0xFFFFFFFF).astype(np.uint32),
+    }
+
+
+def unpack_state_host(state) -> dict:
+    """Decode a full state into logical numpy columns (export /
+    checkpoint / inspection — full-state host ops, never the hot
+    path).  Keys: occupied, algo, status, t0, invalid, expire,
+    duration, limit, remaining (token view), remf_hi/remf_lo (leaky
+    words), burst."""
+    meta = np.asarray(state.meta)
+    hi2 = np.asarray(state.hi2)
+    t0_lo = np.asarray(state.t0_lo)
+    inv_lo = np.asarray(state.invalid_lo)
+    exp_lo = np.asarray(state.expire_lo)
+    dur_lo = np.asarray(state.duration_lo)
+
+    def c64(hi, lo):
+        return (np.asarray(hi).astype(np.int64) << 32) | np.asarray(
+            lo
+        ).astype(np.int64)
+
+    rem_hi = np.asarray(state.rem_hi)
+    rem_lo = np.asarray(state.rem_lo)
+    return {
+        "occupied": (meta & 1) != 0,
+        "algo": (meta >> 1) & 1,
+        "status": (meta >> 2) & 3,
+        "t0": (((meta >> 4) & _HI11).astype(np.int64) << 32)
+        | t0_lo.astype(np.int64),
+        "invalid": (((meta >> 15) & _HI11).astype(np.int64) << 32)
+        | inv_lo.astype(np.int64),
+        "expire": ((hi2 & _HI11).astype(np.int64) << 32)
+        | exp_lo.astype(np.int64),
+        "duration": (((hi2 >> 11) & _HI11).astype(np.int64) << 32)
+        | dur_lo.astype(np.int64),
+        "limit": c64(state.limit_hi, state.limit_lo),
+        "remaining": c64(rem_hi, rem_lo),
+        "remf_hi": rem_hi,
+        "remf_lo": rem_lo,
+        "burst": c64(state.burst_hi, state.burst_lo),
+    }
 
 
 def combine_i64(hi: jax.Array, lo: jax.Array) -> jax.Array:
@@ -176,16 +322,22 @@ def split_remf(v: jax.Array) -> tuple[jax.Array, jax.Array]:
     return wc.astype(_I32), ((v - w) * (2.0**32)).astype(_U32)
 
 
-def _clear_occupied_impl(occupied: jax.Array, slots: jax.Array) -> jax.Array:
+def _clear_occupied_impl(meta: jax.Array, slots: jax.Array) -> jax.Array:
     """Mark evicted slots unoccupied (host eviction executed on device).
 
     Split out of the apply kernel so the compile cache is one shape per
     clear width instead of a (batch width × clear width) matrix —
     eviction bursts then never trigger apply-kernel recompiles.
-    Padding lanes use distinct ascending out-of-range slots.
-    """
-    return occupied.at[jnp.sort(slots)].set(
-        False, mode="drop", indices_are_sorted=True, unique_indices=True
+    Padding lanes use distinct ascending out-of-range slots.  With the
+    packed layout this is a sparse read-modify-write of the meta word
+    (clear bit 0); the gather+scatter touch O(clears) cells only."""
+    s = jnp.sort(slots)
+    cur = meta.at[s].get(
+        mode="fill", fill_value=0, indices_are_sorted=True,
+        unique_indices=True,
+    )
+    return meta.at[s].set(
+        cur & ~1, mode="drop", indices_are_sorted=True, unique_indices=True
     )
 
 
@@ -202,7 +354,7 @@ def _apply_batch_impl(
     clear_slots: jax.Array,  # int32 [C]; padding = out-of-range ascending
     now_ms: jax.Array,  # int64 scalar
 ) -> tuple[BucketState, BatchOutput]:
-    cap = state.occupied.shape[0]
+    cap = state.meta.shape[0]
     now = now_ms.astype(_I64)
 
     # TPU gather/scatter with arbitrary indices lowers to a serial
@@ -244,9 +396,7 @@ def _apply_batch_impl(
     # Host-side eviction: mark reclaimed slots unoccupied before applying
     # the batch (the reference evicts inline in the LRU; here eviction is
     # a host decision executed on device, SURVEY.md §7.3 item 6).
-    occupied = state.occupied.at[jnp.sort(clear_slots)].set(
-        False, mode="drop", indices_are_sorted=True, unique_indices=True
-    )
+    occupied = _clear_occupied_impl(state.meta, clear_slots)
 
     new_state, resp_status, resp_rem, resp_reset = _apply_core(
         state, occupied, slot, r_algo, r_beh, r_hits, r_limit, r_dur,
@@ -280,7 +430,7 @@ def _apply_core(
     vals, resp_status, resp_rem, resp_reset = _compute_update(
         state, occupied, slot, *args
     )
-    new_state = _scatter_values(state._replace(occupied=occupied), slot, vals)
+    new_state = _scatter_values(state._replace(meta=occupied), slot, vals)
     return new_state, resp_status, resp_rem, resp_reset
 
 
@@ -301,7 +451,7 @@ def _compute_update(
     """The READ-ONLY half of the branch-free bucket update over
     slot-sorted lanes: gather → update.  Returns (SlotValues, status,
     remaining, reset_time) with everything in the SORTED lane order."""
-    cap = state.occupied.shape[0]
+    cap = state.meta.shape[0]
     mask = slot < cap
 
     def g(arr):
@@ -315,17 +465,26 @@ def _compute_update(
     def g64(hi, lo):
         return combine_i64(g(hi), g(lo))
 
-    s_occ = g(occupied) & mask
-    s_algo = g(state.algo)
-    s_status = g(state.status)
+    s_meta = g(occupied)  # the (possibly clear-updated) meta array
+    s_occ = meta_occupied(s_meta) & mask
+    s_algo = meta_algo(s_meta)
+    s_status = meta_status(s_meta)
+    s_t0 = meta_t0(s_meta, g(state.t0_lo))
+    s_inv = meta_invalid(s_meta, g(state.invalid_lo))
+    s_hi2 = g(state.hi2)
+    s_exp = hi2_expire(s_hi2, g(state.expire_lo))
+    s_dur = hi2_duration(s_hi2, g(state.duration_lo))
     s_limit = g64(state.limit_hi, state.limit_lo)
-    s_rem = g64(state.remaining_hi, state.remaining_lo)
-    s_rem_f = combine_remf(g(state.remf_hi), g(state.remf_lo))
-    s_dur = g64(state.duration_hi, state.duration_lo)
-    s_t0 = g64(state.t0_hi, state.t0_lo)
-    s_exp = g64(state.expire_hi, state.expire_lo)
+    # The merged remaining words: int64 for token slots, 32.32 fixed
+    # point for leaky — both views computed, the algo paths pick.
+    _rem_hi, _rem_lo = g(state.rem_hi), g(state.rem_lo)
+    s_rem = combine_i64(_rem_hi, _rem_lo)
+    s_rem_f = combine_remf(_rem_hi, _rem_lo)
     s_burst = g64(state.burst_hi, state.burst_lo)
-    s_inv = g64(state.invalid_hi, state.invalid_lo)
+
+    # Normalize the request algorithm to the stored 1-bit domain (see
+    # BucketState docstring).
+    r_algo = (r_algo != 0).astype(_I32)
 
     greg = (r_beh & int(Behavior.DURATION_IS_GREGORIAN)) != 0
     rst = (r_beh & int(Behavior.RESET_REMAINING)) != 0
@@ -564,34 +723,34 @@ def _scatter_values(
         hi, lo = split_i64(v)
         return sc(hi_arr, hi), sc(lo_arr, lo)
 
-    n_limit_hi, n_limit_lo = sc64(state.limit_hi, state.limit_lo, vals.limit)
-    n_rem_hi, n_rem_lo = sc64(state.remaining_hi, state.remaining_lo, vals.remaining)
+    algo_norm = (vals.algo != 0).astype(_I32)
+    t0c = clamp_ts(vals.t0)
+    invc = jnp.zeros_like(t0c)  # updates always clear invalid_at
+    expc = clamp_ts(vals.expire)
+    durc = clamp_ts(vals.duration)
+    meta_v = pack_meta(vals.occ, algo_norm, vals.status, t0c, invc)
+    hi2_v = pack_hi2(expc, durc)
+    # Merged remaining: token int64 words vs leaky 32.32 words.
+    tok_hi, tok_lo = split_i64(vals.remaining)
     remf_hi_v, remf_lo_v = split_remf(vals.rem_f)
-    n_dur_hi, n_dur_lo = sc64(state.duration_hi, state.duration_lo, vals.duration)
-    n_t0_hi, n_t0_lo = sc64(state.t0_hi, state.t0_lo, vals.t0)
-    n_exp_hi, n_exp_lo = sc64(state.expire_hi, state.expire_lo, vals.expire)
+    leaky = algo_norm == 1
+    rem_hi_v = jnp.where(leaky, remf_hi_v, tok_hi)
+    rem_lo_v = jnp.where(leaky, remf_lo_v, tok_lo)
+    n_limit_hi, n_limit_lo = sc64(state.limit_hi, state.limit_lo, vals.limit)
     n_burst_hi, n_burst_lo = sc64(state.burst_hi, state.burst_lo, vals.burst)
-    zero32 = jnp.zeros_like(slot)
     return BucketState(
-        occupied=sc(state.occupied, vals.occ),
-        algo=sc(state.algo, vals.algo),
-        status=sc(state.status, vals.status),
+        meta=sc(state.meta, meta_v),
+        hi2=sc(state.hi2, hi2_v),
+        t0_lo=sc(state.t0_lo, (t0c & 0xFFFFFFFF)),
+        expire_lo=sc(state.expire_lo, (expc & 0xFFFFFFFF)),
+        invalid_lo=sc(state.invalid_lo, jnp.zeros_like(slot)),
+        duration_lo=sc(state.duration_lo, (durc & 0xFFFFFFFF)),
         limit_hi=n_limit_hi,
         limit_lo=n_limit_lo,
-        remaining_hi=n_rem_hi,
-        remaining_lo=n_rem_lo,
-        remf_hi=sc(state.remf_hi, remf_hi_v),
-        remf_lo=sc(state.remf_lo, remf_lo_v),
-        duration_hi=n_dur_hi,
-        duration_lo=n_dur_lo,
-        t0_hi=n_t0_hi,
-        t0_lo=n_t0_lo,
-        expire_hi=n_exp_hi,
-        expire_lo=n_exp_lo,
+        rem_hi=sc(state.rem_hi, rem_hi_v),
+        rem_lo=sc(state.rem_lo, rem_lo_v),
         burst_hi=n_burst_hi,
         burst_lo=n_burst_lo,
-        invalid_hi=sc(state.invalid_hi, zero32),
-        invalid_lo=sc(state.invalid_lo, zero32),
     )
 
 
@@ -617,7 +776,7 @@ def _apply_batch_sorted_impl(
     """
     new_state, resp_status, resp_rem, resp_reset = _apply_core(
         state,
-        state.occupied,
+        state.meta,
         batch.slot,
         batch.algo,
         batch.behavior,
@@ -649,7 +808,7 @@ def _compute_update_sorted_impl(
     (see `_scatter_values`)."""
     vals, resp_status, resp_rem, resp_reset = _compute_update(
         state,
-        state.occupied,
+        state.meta,
         batch.slot,
         batch.algo,
         batch.behavior,
@@ -796,7 +955,7 @@ def _fused_step_core(state: BucketState, pin: jax.Array):
     batch, now = _unpack_in(pin)
     new_state, resp_status, resp_rem, resp_reset = _apply_core(
         state,
-        state.occupied,
+        state.meta,
         batch.slot,
         batch.algo,
         batch.behavior,
@@ -926,7 +1085,7 @@ def _uniform_step_core(state: BucketState, pin: jax.Array):
     burst = bc(hdr[8].astype(_I64))
     zeros = jnp.zeros((w,), dtype=_I64)
     new_state, status, rem, reset = _apply_core(
-        state, state.occupied, slot, algo, behavior, hits, limit,
+        state, state.meta, slot, algo, behavior, hits, limit,
         duration, burst, zeros, zeros, now,
     )
     pout = jnp.stack(
@@ -994,7 +1153,7 @@ def _packed_compute_core(state: BucketState, pin: jax.Array):
     batch, now = _unpack_in(pin)
     vals, resp_status, resp_rem, resp_reset = _compute_update(
         state,
-        state.occupied,
+        state.meta,
         batch.slot,
         batch.algo,
         batch.behavior,
@@ -1078,7 +1237,7 @@ def _collapsed_values(state: BucketState, pin: jax.Array):
 
     # First application per segment: the full bucket update.
     vals, st1, rem1, rst1 = _compute_update(
-        state, state.occupied, slot, s_algo, s_beh, s_hits, s_limit,
+        state, state.meta, slot, s_algo, s_beh, s_hits, s_limit,
         s_dur, s_burst, s_gdur, s_gexp, now,
     )
 
@@ -1262,34 +1421,37 @@ def _load_slots_impl(state: BucketState, rec: SlotRecord) -> BucketState:
         vh, vl = split_i64(v)
         return put(hi, vh), put(lo, vl)
 
-    cap = state.occupied.shape[0]
+    cap = state.meta.shape[0]
+    algo_norm = (rec.algo != 0).astype(_I32)
+    t0c = clamp_ts(rec.t0)
+    invc = clamp_ts(rec.invalid_at)
+    expc = clamp_ts(rec.expire_at)
+    durc = clamp_ts(rec.duration)
+    meta_v = pack_meta(
+        (rec.slot < cap), algo_norm, rec.status, t0c, invc
+    )
+    hi2_v = pack_hi2(expc, durc)
+    tok_hi, tok_lo = split_i64(rec.remaining)
+    leaky = algo_norm == 1
+    rem_hi_v = jnp.where(leaky, rec.remf_hi, tok_hi)
+    rem_lo_v = jnp.where(leaky, rec.remf_lo, tok_lo)
     limit_hi, limit_lo = put64(state.limit_hi, state.limit_lo, rec.limit)
-    rem_hi, rem_lo = put64(state.remaining_hi, state.remaining_lo, rec.remaining)
-    dur_hi, dur_lo = put64(state.duration_hi, state.duration_lo, rec.duration)
-    t0_hi, t0_lo = put64(state.t0_hi, state.t0_lo, rec.t0)
-    exp_hi, exp_lo = put64(state.expire_hi, state.expire_lo, rec.expire_at)
     burst_hi, burst_lo = put64(state.burst_hi, state.burst_lo, rec.burst)
-    inv_hi, inv_lo = put64(state.invalid_hi, state.invalid_lo, rec.invalid_at)
     return state._replace(
-        occupied=put(state.occupied, rec.slot < cap),
-        algo=put(state.algo, rec.algo),
-        status=put(state.status, rec.status),
+        meta=put(state.meta, meta_v),
+        hi2=put(state.hi2, hi2_v),
+        t0_lo=put(state.t0_lo, (t0c & 0xFFFFFFFF).astype(_U32)),
+        expire_lo=put(state.expire_lo, (expc & 0xFFFFFFFF).astype(_U32)),
+        invalid_lo=put(state.invalid_lo, (invc & 0xFFFFFFFF).astype(_U32)),
+        duration_lo=put(
+            state.duration_lo, (durc & 0xFFFFFFFF).astype(_U32)
+        ),
         limit_hi=limit_hi,
         limit_lo=limit_lo,
-        remaining_hi=rem_hi,
-        remaining_lo=rem_lo,
-        remf_hi=put(state.remf_hi, rec.remf_hi),
-        remf_lo=put(state.remf_lo, rec.remf_lo),
-        duration_hi=dur_hi,
-        duration_lo=dur_lo,
-        t0_hi=t0_hi,
-        t0_lo=t0_lo,
-        expire_hi=exp_hi,
-        expire_lo=exp_lo,
+        rem_hi=put(state.rem_hi, rem_hi_v),
+        rem_lo=put(state.rem_lo, rem_lo_v.astype(_U32)),
         burst_hi=burst_hi,
         burst_lo=burst_lo,
-        invalid_hi=inv_hi,
-        invalid_lo=inv_lo,
     )
 
 

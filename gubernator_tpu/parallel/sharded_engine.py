@@ -405,7 +405,7 @@ class ShardedDecisionEngine:
         for sh in range(self.n_shards):
             c[sh, : len(clears[sh])] = clears[sh]
         self._state = self._state._replace(
-            occupied=self._clear_step(self._state.occupied, jnp.asarray(c))
+            meta=self._clear_step(self._state.meta, jnp.asarray(c))
         )
 
     def _apply_shard_restores(self, restores: List[List[tuple]]) -> None:
@@ -822,7 +822,7 @@ class ShardedDecisionEngine:
                     )
                 )
                 self._state = self._state._replace(
-                    occupied=self._clear_step(self._state.occupied, dummy)
+                    meta=self._clear_step(self._state.meta, dummy)
                 )
                 csize *= 2
             # Readback-combiner stack ladder (see DecisionEngine.warmup).
@@ -1504,14 +1504,18 @@ class ShardedDecisionEngine:
         from gubernator_tpu.store import LeakyBucketItem, TokenBucketItem
         from gubernator_tpu.parallel.mesh import keys_sharding
 
+        from gubernator_tpu.ops.bucket_kernel import (
+            pack_state_host,
+            unpack_state_host,
+        )
+
         now_ms = self.clock.now_ms()
         with self._lock:
-            host = {
-                # np.array (copy): np.asarray of a jax array is a
-                # read-only view.
-                f: np.array(getattr(self._state, f))
-                for f in self._state._fields
-            }
+            # Decode the current state into logical columns, apply the
+            # stream, re-encode once — bulk startup path, O(state) by
+            # design.
+            host = unpack_state_host(self._state)
+            host = {k: np.array(v) for k, v in host.items()}  # writable
             count = 0
             for item in loader.load():
                 v = item.value
@@ -1526,27 +1530,21 @@ class ShardedDecisionEngine:
                     np.asarray([slot], dtype=_I32),
                     np.asarray([item.expire_at], dtype=_I64),
                 )
-
-                def put64(name, val):
-                    host[name + "_hi"][sh, slot] = np.int64(val) >> 32
-                    host[name + "_lo"][sh, slot] = np.uint32(val & 0xFFFFFFFF)
-
                 host["occupied"][sh, slot] = True
                 host["algo"][sh, slot] = int(item.algorithm)
-                put64("limit", v.limit)
-                put64("duration", v.duration)
-                put64("expire", item.expire_at)
-                put64("invalid", item.invalid_at)
+                host["limit"][sh, slot] = v.limit
+                host["duration"][sh, slot] = v.duration
+                host["expire"][sh, slot] = item.expire_at
+                host["invalid"][sh, slot] = item.invalid_at
                 if isinstance(v, TokenBucketItem):
                     host["status"][sh, slot] = v.status
-                    put64("remaining", v.remaining)
+                    host["remaining"][sh, slot] = v.remaining
                     host["remf_hi"][sh, slot] = 0
                     host["remf_lo"][sh, slot] = 0
-                    put64("t0", v.created_at)
-                    put64("burst", 0)
+                    host["t0"][sh, slot] = v.created_at
+                    host["burst"][sh, slot] = 0
                 elif isinstance(v, LeakyBucketItem):
                     host["status"][sh, slot] = 0
-                    put64("remaining", 0)
                     from gubernator_tpu.store import words_from_float
 
                     w = (
@@ -1556,9 +1554,10 @@ class ShardedDecisionEngine:
                     )
                     host["remf_hi"][sh, slot] = w[0]
                     host["remf_lo"][sh, slot] = np.uint32(w[1])
-                    put64("t0", v.updated_at)
-                    put64("burst", v.burst)
+                    host["t0"][sh, slot] = v.updated_at
+                    host["burst"][sh, slot] = v.burst
                 count += 1
+            packed = pack_state_host(host)
             placement = (
                 next(iter(self.mesh.devices.flat))
                 if self._single_program
@@ -1567,7 +1566,7 @@ class ShardedDecisionEngine:
             self._state = BucketState(
                 **{
                     f: jax.device_put(a, placement)
-                    for f, a in host.items()
+                    for f, a in packed.items()
                 }
             )
         return count
@@ -1578,25 +1577,21 @@ class ShardedDecisionEngine:
         from gubernator_tpu.types import Algorithm
 
         with self._lock:
-            s = self._state
-            occ = np.asarray(s.occupied)
-            algo = np.asarray(s.algo)
-            status = np.asarray(s.status)
+            from gubernator_tpu.ops.bucket_kernel import unpack_state_host
 
-            def c64(hi, lo):
-                return (
-                    np.asarray(hi).astype(np.int64) << 32
-                ) | np.asarray(lo).astype(np.int64)
-
-            limit = c64(s.limit_hi, s.limit_lo)
-            remaining = c64(s.remaining_hi, s.remaining_lo)
-            remf_hi = np.asarray(s.remf_hi)
-            remf_lo = np.asarray(s.remf_lo)
-            duration = c64(s.duration_hi, s.duration_lo)
-            t0 = c64(s.t0_hi, s.t0_lo)
-            expire = c64(s.expire_hi, s.expire_lo)
-            burst = c64(s.burst_hi, s.burst_lo)
-            invalid = c64(s.invalid_hi, s.invalid_lo)
+            u = unpack_state_host(self._state)
+            occ = u["occupied"]
+            algo = u["algo"]
+            status = u["status"]
+            limit = u["limit"]
+            remaining = u["remaining"]
+            remf_hi = u["remf_hi"]
+            remf_lo = u["remf_lo"]
+            duration = u["duration"]
+            t0 = u["t0"]
+            expire = u["expire"]
+            burst = u["burst"]
+            invalid = u["invalid"]
             located = [
                 (sh, int(sl), self.tables[sh].key_for_slot(int(sl)))
                 for sh, sl in zip(*np.nonzero(occ))

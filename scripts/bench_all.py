@@ -23,7 +23,7 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ROUND = os.environ.get("BENCH_ROUND", "r04")
+ROUND = os.environ.get("BENCH_ROUND", "r05")
 
 CONFIGS: dict[str, dict] = {
     "default": {},

@@ -30,7 +30,7 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ROUND = os.environ.get("BENCH_ROUND", "r04")
+ROUND = os.environ.get("BENCH_ROUND", "r05")
 PROBE_TIMEOUT = float(os.environ.get("WATCHDOG_PROBE_TIMEOUT", 120))
 POLL_SECONDS = float(os.environ.get("WATCHDOG_POLL_SECONDS", 180))
 STATUS_PATH = "/tmp/tpu_watchdog_status.json"

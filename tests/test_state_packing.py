@@ -1,0 +1,157 @@
+"""Packed BucketState invariants (VERDICT r4 #6 — 48 B/slot layout).
+
+The packings must be invisible at the API: decisions identical to the
+scalar spec (covered by test_kernel_vs_spec), full-fidelity
+export/load round-trips, correct behavior across the documented clamp
+boundary (timestamps/durations beyond 2^43 ms), and the occupied-bit
+clear leaving the rest of the meta word intact."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import Algorithm, RateLimitReq
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core.engine import DecisionEngine
+from gubernator_tpu.ops import bucket_kernel as bk
+
+
+def test_state_is_48_bytes_per_slot():
+    state = bk.make_state(64)
+    per_slot = sum(a.dtype.itemsize for a in state)
+    assert per_slot == 48, [f"{f}:{a.dtype}" for f, a in zip(state._fields, state)]
+
+
+def test_pack_unpack_round_trip_host():
+    rng = np.random.default_rng(3)
+    n = 256
+    logical = {
+        "occupied": rng.integers(0, 2, n).astype(bool),
+        "algo": rng.integers(0, 2, n),
+        "status": rng.integers(0, 2, n),
+        "t0": rng.integers(0, bk.TS_CLAMP_MAX, n),
+        "invalid": rng.integers(0, bk.TS_CLAMP_MAX, n),
+        "expire": rng.integers(0, bk.TS_CLAMP_MAX, n),
+        "duration": rng.integers(0, bk.TS_CLAMP_MAX, n),
+        "limit": rng.integers(-(2**62), 2**62, n),
+        "remaining": rng.integers(-(2**62), 2**62, n),
+        "remf_hi": rng.integers(-(2**31), 2**31, n).astype(np.int32),
+        "remf_lo": rng.integers(0, 2**32, n).astype(np.uint32),
+        "burst": rng.integers(-(2**62), 2**62, n),
+    }
+    packed = bk.pack_state_host(logical)
+
+    class _S:
+        pass
+
+    s = _S()
+    for f, a in packed.items():
+        setattr(s, f, a)
+    u = bk.unpack_state_host(s)
+    np.testing.assert_array_equal(u["occupied"], logical["occupied"])
+    np.testing.assert_array_equal(u["algo"], logical["algo"])
+    np.testing.assert_array_equal(u["status"], logical["status"])
+    for f in ("t0", "invalid", "expire", "duration", "limit", "burst"):
+        np.testing.assert_array_equal(u[f], logical[f], err_msg=f)
+    # Merged remaining: token lanes round-trip the int64; leaky lanes
+    # round-trip the fixed-point words.
+    tok = np.asarray(logical["algo"]) == 0
+    np.testing.assert_array_equal(
+        u["remaining"][tok], np.asarray(logical["remaining"])[tok]
+    )
+    np.testing.assert_array_equal(
+        u["remf_hi"][~tok], logical["remf_hi"][~tok]
+    )
+    np.testing.assert_array_equal(
+        u["remf_lo"][~tok], logical["remf_lo"][~tok]
+    )
+
+
+def test_timestamp_clamp_boundary():
+    """Values beyond 2^43 ms clamp at encode (documented divergence);
+    values inside the bound are exact."""
+    logical = {
+        "occupied": np.array([True, True]),
+        "algo": np.array([0, 0]),
+        "status": np.array([0, 0]),
+        "t0": np.array([bk.TS_CLAMP_MAX, bk.TS_CLAMP_MAX + 12345]),
+        "invalid": np.array([0, -5]),  # negatives clamp to 0
+        "expire": np.array([17, 2**50]),
+        "duration": np.array([3_600_000, 2**55]),
+        "limit": np.array([10, 10]),
+        "remaining": np.array([1, 1]),
+        "remf_hi": np.zeros(2, np.int32),
+        "remf_lo": np.zeros(2, np.uint32),
+        "burst": np.array([0, 0]),
+    }
+    packed = bk.pack_state_host(logical)
+
+    class _S:
+        pass
+
+    s = _S()
+    for f, a in packed.items():
+        setattr(s, f, a)
+    u = bk.unpack_state_host(s)
+    assert u["t0"].tolist() == [bk.TS_CLAMP_MAX, bk.TS_CLAMP_MAX]
+    assert u["invalid"].tolist() == [0, 0]
+    assert u["expire"].tolist() == [17, bk.TS_CLAMP_MAX]
+    assert u["duration"].tolist() == [3_600_000, bk.TS_CLAMP_MAX]
+
+
+def test_clear_preserves_other_meta_bits(frozen_clock):
+    """Evicting a slot clears ONLY the occupied bit: the engine relies
+    on liveness, but the packed t0/invalid hi words and algo/status
+    bits must not be corrupted by the clear scatter."""
+    import jax.numpy as jnp
+
+    state = bk.make_state(8)
+    meta_word = bk.pack_meta(
+        jnp.asarray([True]), jnp.asarray([1]), jnp.asarray([1]),
+        jnp.asarray([123 << 32], dtype=jnp.int64),
+        jnp.asarray([77 << 32], dtype=jnp.int64),
+    )
+    meta = state.meta.at[3].set(meta_word[0])
+    cleared = bk._clear_occupied_impl(meta, jnp.asarray([3], dtype=jnp.int32))
+    w = int(cleared[3])
+    assert (w & 1) == 0  # unoccupied
+    assert bk.meta_algo(np.asarray([w]))[0] == 1
+    assert bk.meta_status(np.asarray([w]))[0] == 1
+    assert int(bk.meta_t0(np.asarray([w]), np.zeros(1, np.uint32))[0]) == (
+        123 << 32
+    )
+
+
+def test_export_round_trip_through_engine(frozen_clock):
+    """End to end: decisions → export_items → fresh engine load →
+    identical follow-up decisions (the packing must be invisible)."""
+    eng = DecisionEngine(capacity=64, clock=frozen_clock)
+    reqs = [
+        RateLimitReq(
+            name="rt", unique_key=f"{i}k", hits=2, limit=11,
+            duration=60_000,
+            algorithm=(
+                Algorithm.TOKEN_BUCKET if i % 2 == 0
+                else Algorithm.LEAKY_BUCKET
+            ),
+        )
+        for i in range(20)
+    ]
+    eng.get_rate_limits(reqs)
+    items = list(eng.export_items())
+    assert len(items) == 20
+
+    class _Loader:
+        def load(self):
+            return iter(items)
+
+        def save(self, it):
+            pass
+
+    eng2 = DecisionEngine(capacity=64, clock=frozen_clock)
+    assert eng2.load(_Loader()) == 20
+    r1 = eng.get_rate_limits(reqs)
+    r2 = eng2.get_rate_limits(reqs)
+    for a, b in zip(r1, r2):
+        assert (a.status, a.remaining, a.reset_time) == (
+            b.status, b.remaining, b.reset_time,
+        )
