@@ -657,6 +657,10 @@ def _run_herd(np, platform: str) -> dict:
     import grpc
 
     n_threads = int(os.environ.get("BENCH_HERD_THREADS", 32))
+    # BENCH_HERD_FAST=1: serve through the native h2 fast front
+    # (net/h2_fast.py) — zero per-RPC Python; the C side owns framing
+    # and the group-commit window.
+    fast = os.environ.get("BENCH_HERD_FAST", "0") != "0"
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
@@ -670,6 +674,10 @@ def _run_herd(np, platform: str) -> dict:
         # requests per engine dispatch; the measured knee is at
         # ~2-4ms on this host (PERF.md §13).
         local_batch_wait=float(
+            os.environ.get("BENCH_LOCAL_BATCH_WAIT", "0.002")
+        ),
+        h2_fast_address="127.0.0.1:0" if fast else "",
+        h2_fast_window=float(
             os.environ.get("BENCH_LOCAL_BATCH_WAIT", "0.002")
         ),
     )
@@ -689,7 +697,7 @@ def _run_herd(np, platform: str) -> dict:
             from gubernator_tpu.core import h2_client
 
             res = h2_client.bench_unary(
-                daemon.grpc_address,
+                daemon.h2_fast_address if fast else daemon.grpc_address,
                 f"/{V1_SERVICE}/GetRateLimits",
                 payload,
                 MEASURE_SECONDS,
@@ -698,10 +706,13 @@ def _run_herd(np, platform: str) -> dict:
             if res is not None and _herd_result_valid(pb, res):
                 rpcs, errors, lats, _frame, connected = res
                 rate = rpcs / MEASURE_SECONDS
+                front = (
+                    "native h2 fast front" if fast else "grpc listener"
+                )
                 return {
                     "metric": "rate-limit decisions/sec, thundering herd "
-                    f"({connected} concurrent native h2 clients, 1 hot "
-                    "key, single-item RPCs)",
+                    f"({connected} concurrent native h2 clients via "
+                    f"{front}, 1 hot key, single-item RPCs)",
                     "value": round(rate, 1),
                     "unit": "decisions/sec",
                     "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),

@@ -236,6 +236,10 @@ class DaemonConfig:
     sweep_interval: float = 30.0
     # Client-facing wire group-commit window (0 = off); see Config.
     local_batch_wait: float = 0.0
+    # Native h2 fast front (net/h2_fast.py): "" = disabled;
+    # "127.0.0.1:0" binds an ephemeral port.
+    h2_fast_address: str = ""
+    h2_fast_window: float = 0.002
 
     metric_flags: List[str] = field(default_factory=list)
 
@@ -355,6 +359,8 @@ def setup_daemon_config(
         device_count=device_count,
         sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),
         local_batch_wait=_env_float_seconds(d, "GUBER_LOCAL_BATCH_WAIT", 0.0),
+        h2_fast_address=d.get("GUBER_H2_FAST_ADDRESS", ""),
+        h2_fast_window=_env_float_seconds(d, "GUBER_H2_FAST_WINDOW", 0.002),
         metric_flags=[
             f.strip()
             for f in _env(d, "GUBER_METRIC_FLAGS", "").split(",")

@@ -1,0 +1,636 @@
+// Native HTTP/2 gRPC serving front for ONE method: GetRateLimits.
+//
+// Why: grpc-python costs ~160µs of framework Python per RPC on this
+// host (PERF.md §13) — the measured wall for the thundering-herd
+// config once the engine work is window-amortized.  This front moves
+// everything EXCEPT the engine step out of Python: h2 framing, grpc
+// message framing, group-commit windowing, and response encoding run
+// in C threads; Python is entered exactly once per WINDOW through a
+// ctypes callback that receives the window's concatenated request
+// bodies and returns decision columns.
+//
+// Scope (deliberate, documented in net/h2_fast.py): a dedicated
+// cleartext listener that serves exactly one unary method, so request
+// HEADERS need no HPACK decoding at all — header blocks are skipped
+// wholesale (the port IS the route), which is what makes the front
+// ~500 lines instead of an HPACK/huffman implementation.  Responses
+// use static-table + literal HPACK (no dynamic table, no huffman),
+// which every conformant peer accepts.  Requests whose decisions
+// cannot be expressed as plain (status, limit, remaining, reset)
+// columns are answered UNIMPLEMENTED by the Python callback contract
+// and belong on the full gRPC listener.
+//
+// Concatenation trick: protobuf repeated-field semantics mean the
+// byte-concatenation of N serialized GetRateLimitsReq messages IS one
+// valid GetRateLimitsReq whose `requests` repeat across the inputs —
+// so the window's bodies concatenate into ONE decode + ONE engine
+// batch with zero per-RPC Python (reference wire contract:
+// proto/gubernator.proto).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kData = 0x0, kHeaders = 0x1, kRst = 0x3, kSettings = 0x4,
+                  kPing = 0x6, kGoaway = 0x7, kWindowUpdate = 0x8,
+                  kContinuation = 0x9;
+constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4;
+
+void put_u24(uint8_t* p, uint32_t v) {
+  p[0] = (v >> 16) & 0xff;
+  p[1] = (v >> 8) & 0xff;
+  p[2] = v & 0xff;
+}
+void put_u32(uint8_t* p, uint32_t v) {
+  p[0] = (v >> 24) & 0xff;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+void frame_header(std::string& out, uint32_t len, uint8_t type, uint8_t flags,
+                  uint32_t stream) {
+  uint8_t h[9];
+  put_u24(h, len);
+  h[3] = type;
+  h[4] = flags;
+  put_u32(h + 5, stream);
+  out.append(reinterpret_cast<char*>(h), 9);
+}
+
+// Protobuf unsigned varint (int64 negatives = 10-byte two's complement).
+void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out.push_back(static_cast<char>(v));
+}
+
+// Bounded varint read: false on truncation or >64-bit overflow.  The
+// length checks below compare against the REMAINING byte count, never
+// via pointer arithmetic on attacker-controlled lengths (p + len can
+// wrap — a remote-segfault class).
+bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end) {
+    const uint8_t b = *p++;
+    if (shift >= 64) return false;
+    v |= uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Count top-level `requests` (field 1, wire type 2) entries in a
+// GetRateLimitsReq body; -1 on malformed input.
+int64_t count_items(const uint8_t* p, const uint8_t* end) {
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag = 0;
+    if (!read_varint(p, end, &tag)) return -1;
+    const uint32_t field = tag >> 3, wt = tag & 7;
+    if (wt == 2) {
+      uint64_t len = 0;
+      if (!read_varint(p, end, &len)) return -1;
+      if (len > static_cast<uint64_t>(end - p)) return -1;
+      if (field == 1) ++n;
+      p += len;
+    } else if (wt == 0) {
+      uint64_t skip = 0;
+      if (!read_varint(p, end, &skip)) return -1;
+    } else if (wt == 5) {
+      if (end - p < 4) return -1;
+      p += 4;
+    } else if (wt == 1) {
+      if (end - p < 8) return -1;
+      p += 8;
+    } else {
+      return -1;
+    }
+  }
+  return n;
+}
+
+// window callback: Python fills out_cols[4 * total_items] (blocked:
+// status | limit | remaining | reset) and out_rpc_status[n_rpcs]
+// (0 = serve from the columns; nonzero = answer that RPC with the
+// given grpc status, its column lanes ignored — one out-of-scope RPC
+// must not fail its window-mates).  body_lens[n_rpcs] gives each
+// RPC's byte length within `concat` so Python can re-serve RPCs
+// individually when the combined decode declines.  Returns 0, or a
+// grpc status code to fail the WHOLE window with (callback crash).
+typedef int64_t (*WindowCallback)(const uint8_t* concat, int64_t concat_len,
+                                  const int64_t* item_counts,
+                                  const int64_t* body_lens, int64_t n_rpcs,
+                                  int64_t total_items, int64_t* out_cols,
+                                  int64_t* out_rpc_status);
+
+struct Conn;
+
+struct PendingRpc {
+  std::shared_ptr<Conn> conn;
+  uint32_t stream;
+  std::string body;       // grpc-deframed protobuf payload
+  int64_t items;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  WindowCallback callback = nullptr;
+  int64_t window_us = 2000;
+  int64_t max_batch = 16384;
+  std::atomic<bool> closing{false};
+  std::thread accept_thread, dispatch_thread;
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<PendingRpc> queue;
+  // Stats.
+  std::atomic<int64_t> rpcs{0}, windows{0}, errors{0};
+  // Connection threads are DETACHED (a long-lived daemon must not
+  // accumulate unjoined thread handles across connection churn);
+  // shutdown coordinates through the live-conn registry + an active
+  // counter instead of joins.
+  std::atomic<int64_t> active_conns{0};
+  std::mutex conns_mu;
+  std::condition_variable conns_cv;
+  std::vector<std::weak_ptr<Conn>> conns;
+};
+
+struct Conn : std::enable_shared_from_this<Conn> {
+  int fd;
+  std::mutex write_mu;
+  std::atomic<bool> dead{false};
+  int64_t recv_since_update = 0;
+
+  explicit Conn(int f) : fd(f) {}
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  bool send_all(const std::string& buf) {
+    std::lock_guard<std::mutex> lock(write_mu);
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
+    size_t n = buf.size();
+    while (n) {
+      ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (w <= 0) {
+        dead.store(true);
+        return false;
+      }
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+    return true;
+  }
+};
+
+// Response header block: :status 200 (static 8) + content-type
+// application/grpc (literal w/o indexing, static name 31).
+std::string resp_headers_block() {
+  std::string b;
+  b.push_back(static_cast<char>(0x88));
+  b.push_back(static_cast<char>(0x0f));
+  b.push_back(static_cast<char>(0x10));
+  b.push_back(static_cast<char>(16));
+  b.append("application/grpc");
+  return b;
+}
+
+// Trailer block: grpc-status (literal name) = given code.
+std::string trailers_block(int code) {
+  std::string b;
+  b.push_back(static_cast<char>(0x00));
+  b.push_back(static_cast<char>(11));
+  b.append("grpc-status");
+  const std::string v = std::to_string(code);
+  b.push_back(static_cast<char>(v.size()));
+  b.append(v);
+  return b;
+}
+
+// One RPC's full response: HEADERS + DATA(grpc frame) + trailers.
+std::string build_response(uint32_t stream, const int64_t* cols,
+                           int64_t offset, int64_t k, int64_t total,
+                           int grpc_status) {
+  static const std::string kHdr = resp_headers_block();
+  std::string out;
+  frame_header(out, static_cast<uint32_t>(kHdr.size()), kHeaders,
+               kFlagEndHeaders, stream);
+  out += kHdr;
+  if (grpc_status == 0) {
+    // GetRateLimitsResp{ repeated RateLimitResp responses = 1 }
+    std::string pb;
+    for (int64_t i = 0; i < k; ++i) {
+      std::string item;
+      const int64_t st = cols[0 * total + offset + i];
+      const int64_t li = cols[1 * total + offset + i];
+      const int64_t re = cols[2 * total + offset + i];
+      const int64_t rt = cols[3 * total + offset + i];
+      if (st) {
+        item.push_back(0x08);
+        put_varint(item, static_cast<uint64_t>(st));
+      }
+      if (li) {
+        item.push_back(0x10);
+        put_varint(item, static_cast<uint64_t>(li));
+      }
+      if (re) {
+        item.push_back(0x18);
+        put_varint(item, static_cast<uint64_t>(re));
+      }
+      if (rt) {
+        item.push_back(0x20);
+        put_varint(item, static_cast<uint64_t>(rt));
+      }
+      pb.push_back(0x0a);
+      put_varint(pb, item.size());
+      pb += item;
+    }
+    std::string data;
+    data.push_back(0);  // uncompressed
+    uint8_t len4[4];
+    put_u32(len4, static_cast<uint32_t>(pb.size()));
+    data.append(reinterpret_cast<char*>(len4), 4);
+    data += pb;
+    frame_header(out, static_cast<uint32_t>(data.size()), kData, 0, stream);
+    out += data;
+  }
+  const std::string tr = trailers_block(grpc_status);
+  frame_header(out, static_cast<uint32_t>(tr.size()), kHeaders,
+               kFlagEndHeaders | kFlagEndStream, stream);
+  out += tr;
+  return out;
+}
+
+struct StreamState {
+  std::string body;        // accumulated grpc DATA payload
+  bool headers_done = false;
+};
+
+void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
+  std::vector<uint8_t> buf(1 << 16);
+  size_t len = 0;
+  // Expect the client preface.
+  static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  size_t preface_seen = 0;
+  {
+    // SETTINGS: INITIAL_WINDOW_SIZE 4MB so request bodies up to the
+    // body cap never stall on per-stream flow control (we do not send
+    // per-stream WINDOW_UPDATEs), MAX_FRAME_SIZE stays default 16KB.
+    std::string s;
+    frame_header(s, 6, kSettings, 0, 0);
+    uint8_t entry[6] = {0x00, 0x04, 0x00, 0x40, 0x00, 0x00};  // id=4, 4MiB
+    s.append(reinterpret_cast<char*>(entry), 6);
+    if (!conn->send_all(s)) return;
+  }
+  // Stream table as a flat vector — ids are few and short-lived.
+  std::vector<std::pair<uint32_t, StreamState>> streams;
+
+  auto stream_of = [&](uint32_t id) -> StreamState& {
+    for (auto& kv : streams)
+      if (kv.first == id) return kv.second;
+    streams.emplace_back(id, StreamState{});
+    return streams.back().second;
+  };
+  auto drop_stream = [&](uint32_t id) {
+    for (size_t i = 0; i < streams.size(); ++i)
+      if (streams[i].first == id) {
+        streams.erase(streams.begin() + i);
+        return;
+      }
+  };
+
+  while (!srv->closing.load() && !conn->dead.load()) {
+    if (len == buf.size()) buf.resize(buf.size() * 2);
+    ssize_t r = ::recv(conn->fd, buf.data() + len, buf.size() - len, 0);
+    if (r <= 0) break;
+    len += static_cast<size_t>(r);
+    size_t pos = 0;
+    // Preface bytes first.
+    while (preface_seen < 24 && pos < len) {
+      if (static_cast<char>(buf[pos]) != kPreface[preface_seen]) {
+        conn->dead.store(true);
+        break;
+      }
+      ++pos;
+      ++preface_seen;
+    }
+    if (conn->dead.load()) break;
+    // Frames.
+    for (;;) {
+      if (len - pos < 9) break;
+      const uint8_t* f = buf.data() + pos;
+      const uint32_t flen =
+          (uint32_t(f[0]) << 16) | (uint32_t(f[1]) << 8) | f[2];
+      if (flen > (1u << 20)) {  // far beyond our advertised 16KB max
+        conn->dead.store(true);
+        break;
+      }
+      if (len - pos < 9 + flen) break;
+      const uint8_t type = f[3], flags = f[4];
+      const uint32_t stream = get_u32(f + 5) & 0x7fffffff;
+      const uint8_t* payload = f + 9;
+      switch (type) {
+        case kSettings:
+          if (!(flags & kFlagAck)) {
+            std::string s;
+            frame_header(s, 0, kSettings, kFlagAck, 0);
+            conn->send_all(s);
+          }
+          break;
+        case kPing:
+          if (!(flags & kFlagAck) && flen == 8) {
+            std::string s;
+            frame_header(s, 8, kPing, kFlagAck, 0);
+            s.append(reinterpret_cast<const char*>(payload), 8);
+            conn->send_all(s);
+          }
+          break;
+        case kHeaders:
+        case kContinuation: {
+          // Single-method port: header CONTENT is irrelevant (the
+          // port is the route); only END_STREAM matters (a request
+          // with no body ends here — answer UNIMPLEMENTED).
+          StreamState& st = stream_of(stream);
+          if (flags & kFlagEndHeaders) st.headers_done = true;
+          if (flags & kFlagEndStream) {
+            conn->send_all(build_response(stream, nullptr, 0, 0, 0, 12));
+            drop_stream(stream);
+          }
+          break;
+        }
+        case kData: {
+          StreamState& st = stream_of(stream);
+          if (st.body.size() + flen > (4u << 20)) {
+            // No legitimate rate-limit request is megabytes long —
+            // cap per-stream buffering (DoS guard) and drop the conn.
+            conn->dead.store(true);
+            break;
+          }
+          st.body.append(reinterpret_cast<const char*>(payload), flen);
+          conn->recv_since_update += flen;
+          if (flags & kFlagEndStream) {
+            // grpc frame: 1-byte compressed flag + u32 length + body.
+            if (st.body.size() < 5 || st.body[0] != 0) {
+              conn->send_all(build_response(stream, nullptr, 0, 0, 0, 13));
+            } else {
+              const uint32_t mlen =
+                  get_u32(reinterpret_cast<const uint8_t*>(st.body.data()) + 1);
+              if (5 + mlen > st.body.size()) {
+                conn->send_all(
+                    build_response(stream, nullptr, 0, 0, 0, 13));
+              } else {
+                std::string body = st.body.substr(5, mlen);
+                const int64_t items = count_items(
+                    reinterpret_cast<const uint8_t*>(body.data()),
+                    reinterpret_cast<const uint8_t*>(body.data()) +
+                        body.size());
+                if (items < 0 || items > 1000) {
+                  conn->send_all(
+                      build_response(stream, nullptr, 0, 0, 0, 13));
+                } else {
+                  std::lock_guard<std::mutex> lock(srv->q_mu);
+                  srv->queue.push_back(PendingRpc{
+                      conn, stream, std::move(body), items});
+                  srv->q_cv.notify_one();
+                }
+              }
+            }
+            drop_stream(stream);
+          }
+          // Replenish the connection-level receive window.
+          if (conn->recv_since_update >= 1 << 14) {
+            std::string s;
+            frame_header(s, 4, kWindowUpdate, 0, 0);
+            uint8_t inc[4];
+            put_u32(inc, static_cast<uint32_t>(conn->recv_since_update));
+            s.append(reinterpret_cast<char*>(inc), 4);
+            conn->send_all(s);
+            conn->recv_since_update = 0;
+          }
+          break;
+        }
+        case kRst:
+          drop_stream(stream);
+          break;
+        case kGoaway:
+          conn->dead.store(true);
+          break;
+        case kWindowUpdate:
+        default:
+          break;  // responses are tiny; send-window tracking unneeded
+      }
+      pos += 9 + flen;
+      if (conn->dead.load()) break;
+    }
+    if (pos) {
+      std::memmove(buf.data(), buf.data() + pos, len - pos);
+      len -= pos;
+    }
+  }
+  conn->dead.store(true);
+}
+
+void dispatch_loop(Server* srv) {
+  while (!srv->closing.load()) {
+    std::vector<PendingRpc> batch;
+    {
+      std::unique_lock<std::mutex> lock(srv->q_mu);
+      srv->q_cv.wait(lock, [&] {
+        return srv->closing.load() || !srv->queue.empty();
+      });
+      if (srv->closing.load()) return;
+    }
+    // Group-commit window: let concurrent arrivals pile in.
+    std::this_thread::sleep_for(std::chrono::microseconds(srv->window_us));
+    int64_t total = 0;
+    {
+      std::lock_guard<std::mutex> lock(srv->q_mu);
+      while (!srv->queue.empty() &&
+             total + srv->queue.front().items <= srv->max_batch) {
+        total += srv->queue.front().items;
+        batch.push_back(std::move(srv->queue.front()));
+        srv->queue.pop_front();
+      }
+    }
+    if (batch.empty()) continue;
+    std::string concat;
+    std::vector<int64_t> counts;
+    counts.reserve(batch.size());
+    for (auto& rpc : batch) {
+      concat += rpc.body;
+      counts.push_back(rpc.items);
+    }
+    std::vector<int64_t> cols(static_cast<size_t>(4 * total), 0);
+    std::vector<int64_t> rpc_status(batch.size(), 0);
+    std::vector<int64_t> body_lens;
+    body_lens.reserve(batch.size());
+    for (auto& rpc : batch)
+      body_lens.push_back(static_cast<int64_t>(rpc.body.size()));
+    const int64_t rc = srv->callback(
+        reinterpret_cast<const uint8_t*>(concat.data()),
+        static_cast<int64_t>(concat.size()), counts.data(),
+        body_lens.data(), static_cast<int64_t>(batch.size()), total,
+        cols.data(), rpc_status.data());
+    srv->windows.fetch_add(1);
+    int64_t offset = 0;
+    size_t ridx = 0;
+    for (auto& rpc : batch) {
+      const int64_t st = (rc != 0) ? rc : rpc_status[ridx++];
+      if (rpc.conn->dead.load()) {
+        offset += rpc.items;
+        continue;
+      }
+      if (st == 0) {
+        rpc.conn->send_all(build_response(rpc.stream, cols.data(), offset,
+                                          rpc.items, total, 0));
+        srv->rpcs.fetch_add(1);
+      } else {
+        rpc.conn->send_all(build_response(
+            rpc.stream, nullptr, 0, 0, 0, static_cast<int>(st)));
+        srv->errors.fetch_add(1);
+      }
+      offset += rpc.items;
+    }
+  }
+}
+
+void accept_loop(Server* srv) {
+  while (!srv->closing.load()) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(srv->listen_fd, reinterpret_cast<sockaddr*>(&peer),
+                      &plen);
+    if (fd < 0) {
+      if (srv->closing.load()) return;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_shared<Conn>(fd);
+    {
+      std::lock_guard<std::mutex> lock(srv->conns_mu);
+      // Prune registry entries for connections long gone.
+      srv->conns.erase(
+          std::remove_if(srv->conns.begin(), srv->conns.end(),
+                         [](const std::weak_ptr<Conn>& w) {
+                           return w.expired();
+                         }),
+          srv->conns.end());
+      srv->conns.push_back(conn);
+    }
+    srv->active_conns.fetch_add(1);
+    std::thread([srv, conn]() {
+      conn_loop(srv, conn);
+      srv->active_conns.fetch_sub(1);
+      std::lock_guard<std::mutex> lock(srv->conns_mu);
+      srv->conns_cv.notify_all();
+    }).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start the front on 127.0.0.1:port (0 = ephemeral).  Returns an
+// opaque handle, or nullptr on bind failure.
+void* h2s_start(int32_t port, int64_t window_us, int64_t max_batch,
+                WindowCallback callback) {
+  auto* srv = new Server();
+  srv->callback = callback;
+  srv->window_us = window_us;
+  srv->max_batch = max_batch;
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, 128) != 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  srv->port = ntohs(addr.sin_port);
+  srv->accept_thread = std::thread(accept_loop, srv);
+  srv->dispatch_thread = std::thread(dispatch_loop, srv);
+  return srv;
+}
+
+int32_t h2s_port(void* handle) {
+  return static_cast<Server*>(handle)->port;
+}
+
+void h2s_stats(void* handle, int64_t* out3) {
+  auto* srv = static_cast<Server*>(handle);
+  out3[0] = srv->rpcs.load();
+  out3[1] = srv->windows.load();
+  out3[2] = srv->errors.load();
+}
+
+void h2s_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  srv->closing.store(true);
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  {
+    std::lock_guard<std::mutex> lock(srv->q_mu);
+    srv->q_cv.notify_all();
+  }
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  if (srv->dispatch_thread.joinable()) srv->dispatch_thread.join();
+  {
+    // Conn threads block in recv(); shut their sockets down, then
+    // wait (bounded) for the detached threads to drain.
+    std::unique_lock<std::mutex> lock(srv->conns_mu);
+    for (auto& w : srv->conns)
+      if (auto c = w.lock()) {
+        c->dead.store(true);
+        ::shutdown(c->fd, SHUT_RDWR);
+      }
+    srv->conns_cv.wait_for(lock, std::chrono::seconds(5), [&] {
+      return srv->active_conns.load() == 0;
+    });
+  }
+  if (srv->active_conns.load() != 0) return;  // leak over use-after-free
+  delete srv;
+}
+
+}  // extern "C"

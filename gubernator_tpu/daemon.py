@@ -185,6 +185,18 @@ class Daemon:
         host = conf.http_listen_address.rpartition(":")[0]
         self.http_address = f"{host}:{self.gateway.port}"
 
+        # Optional native h2 fast front: one-method serving with zero
+        # per-RPC Python (net/h2_fast.py documents the scope).
+        self.h2_fast = None
+        if conf.h2_fast_address:
+            from gubernator_tpu.net.h2_fast import H2FastFront
+
+            port = int(conf.h2_fast_address.rpartition(":")[2] or 0)
+            self.h2_fast = H2FastFront(
+                self.instance, port=port, window_s=conf.h2_fast_window
+            )
+            self.h2_fast_address = self.h2_fast.address
+
         # Optional plain-HTTP status listener for probes when mTLS
         # would block them (reference: daemon.go:279-307).
         if conf.http_status_listen_address:
@@ -318,6 +330,8 @@ class Daemon:
             self._sweep_stop.set()
         if self._discovery is not None:
             self._discovery.close()
+        if getattr(self, "h2_fast", None) is not None:
+            self.h2_fast.close()
         if self.gateway is not None:
             self.gateway.close()
         if self.status_gateway is not None:

@@ -1,0 +1,220 @@
+"""Native h2 serving front: one method, zero per-RPC Python.
+
+`H2FastFront` runs the C server (core/native/h2_server.cpp) on a
+dedicated cleartext port serving exactly
+/pb.gubernator.V1/GetRateLimits.  The C side owns accept/framing/
+group-commit/response-encode; Python is entered ONCE per window with
+the concatenated request bodies (protobuf repeated-field semantics
+make the concatenation of N GetRateLimitsReq messages one valid
+GetRateLimitsReq), runs the columnar engine path, and hands decision
+columns back.
+
+Scope, documented for operators: the front answers plain rate-limit
+checks — requests that decode on the columnar path and whose
+responses carry no error/metadata fields.  Batches containing
+behaviors the columnar route declines (GLOBAL and friends) or any
+per-item validation error are answered with grpc-status
+UNIMPLEMENTED(12); point such traffic at the full gRPC listener
+(`GUBER_GRPC_ADDRESS`).  The grpc-python wall this removes is
+~160 µs/RPC of framework Python (PERF.md §13).
+
+Enable with GUBER_H2_FAST_ADDRESS=127.0.0.1:<port> (0 = ephemeral);
+GUBER_H2_FAST_WINDOW tunes the C-side group-commit window (default
+2 ms, the §13 knee).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from typing import Optional
+
+import numpy as np
+
+from gubernator_tpu.core.native_build import ensure_built
+
+log = logging.getLogger("gubernator_tpu.h2_fast")
+
+_CALLBACK = ctypes.CFUNCTYPE(
+    ctypes.c_int64,
+    ctypes.c_void_p,  # concat bodies
+    ctypes.c_int64,  # len
+    ctypes.c_void_p,  # item_counts [n_rpcs]
+    ctypes.c_void_p,  # body_lens [n_rpcs]
+    ctypes.c_int64,  # n_rpcs
+    ctypes.c_int64,  # total_items
+    ctypes.c_void_p,  # out_cols [4 * total]
+    ctypes.c_void_p,  # out_rpc_status [n_rpcs]
+)
+
+_lib = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = ensure_built("h2_server")
+    if so is None:
+        return None
+    lib = ctypes.CDLL(str(so))
+    lib.h2s_start.restype = ctypes.c_void_p
+    lib.h2s_start.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, _CALLBACK,
+    ]
+    lib.h2s_port.restype = ctypes.c_int32
+    lib.h2s_port.argtypes = [ctypes.c_void_p]
+    lib.h2s_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.h2s_stop.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class H2FastFront:
+    """The native front bound to a V1Instance's columnar serve path."""
+
+    def __init__(
+        self,
+        instance,
+        *,
+        port: int = 0,
+        window_s: float = 0.002,
+        max_batch: int = 16384,
+    ):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native h2 server unavailable")
+        self._lib = lib
+        self.instance = instance
+        # The ctypes callback object must outlive the server.
+        self._cb = _CALLBACK(self._window)
+        self._handle = lib.h2s_start(
+            port, int(window_s * 1e6), max_batch, self._cb
+        )
+        if not self._handle:
+            raise RuntimeError("h2 fast front failed to bind")
+        self.port = int(lib.h2s_port(self._handle))
+        self.address = f"127.0.0.1:{self.port}"
+
+    # -- the per-window entry ------------------------------------------
+
+    def _window(
+        self, buf, length, counts_ptr, lens_ptr, n_rpcs, total, out_ptr,
+        status_ptr,
+    ) -> int:
+        try:
+            payload = ctypes.string_at(buf, length)
+            n = int(total)
+            nr = int(n_rpcs)
+            cols = np.ctypeslib.as_array(
+                ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int64)),
+                shape=(4 * n,),
+            )
+            rpc_status = np.ctypeslib.as_array(
+                ctypes.cast(status_ptr, ctypes.POINTER(ctypes.c_int64)),
+                shape=(nr,),
+            )
+            out = self._serve(payload, n)
+            if out is not None:
+                st, lim, rem, rst = out
+                cols[0 * n : 0 * n + n] = np.asarray(st, dtype=np.int64)
+                cols[1 * n : 1 * n + n] = np.asarray(lim, dtype=np.int64)
+                cols[2 * n : 2 * n + n] = np.asarray(rem, dtype=np.int64)
+                cols[3 * n : 3 * n + n] = np.asarray(rst, dtype=np.int64)
+                rpc_status[:] = 0
+                return 0
+            # The combined window declined (one RPC out of scope must
+            # not fail its window-mates): re-serve each RPC alone and
+            # mark only the decliners UNIMPLEMENTED.
+            counts = np.ctypeslib.as_array(
+                ctypes.cast(counts_ptr, ctypes.POINTER(ctypes.c_int64)),
+                shape=(nr,),
+            )
+            lens = np.ctypeslib.as_array(
+                ctypes.cast(lens_ptr, ctypes.POINTER(ctypes.c_int64)),
+                shape=(nr,),
+            )
+            b_off = 0
+            i_off = 0
+            for r in range(nr):
+                body = payload[b_off : b_off + int(lens[r])]
+                k = int(counts[r])
+                one = self._serve(body, k)
+                if one is None:
+                    rpc_status[r] = 12  # UNIMPLEMENTED
+                else:
+                    st, lim, rem, rst = one
+                    cols[0 * n + i_off : 0 * n + i_off + k] = np.asarray(
+                        st, dtype=np.int64
+                    )
+                    cols[1 * n + i_off : 1 * n + i_off + k] = np.asarray(
+                        lim, dtype=np.int64
+                    )
+                    cols[2 * n + i_off : 2 * n + i_off + k] = np.asarray(
+                        rem, dtype=np.int64
+                    )
+                    cols[3 * n + i_off : 3 * n + i_off + k] = np.asarray(
+                        rst, dtype=np.int64
+                    )
+                    rpc_status[r] = 0
+                b_off += int(lens[r])
+                i_off += k
+            return 0
+        except Exception:  # noqa: BLE001 — never unwind into C
+            log.exception("h2 fast window failed")
+            return 13  # INTERNAL
+
+    def _serve(self, payload: bytes, total: int):
+        """Columnar decode + engine apply for one window; None if the
+        batch needs the pb path (caller answers UNIMPLEMENTED)."""
+        import gubernator_tpu.service as svc
+        from gubernator_tpu.core.engine import PackedKeys
+        from gubernator_tpu.net import wire_codec
+
+        inst = self.instance
+        engine = inst.engine
+        # Same engine guards as service.serve_wire_bytes: a
+        # write-through store must not be bypassed, and an engine
+        # without the columnar entry declines cleanly.
+        if getattr(engine, "apply_columnar", None) is None or getattr(
+            engine, "store", None
+        ) is not None:
+            return None
+        mask = svc.COLUMNAR_DISQUALIFIERS
+        dec = wire_codec.decode_reqs(payload, max(total, 1), mask)
+        if dec is None or dec.n != total:
+            return None
+        # Ownership gate shared with service.serve_wire_bytes: the
+        # fast front must never answer peer-owned keys locally —
+        # clustered deployments route those through the full
+        # listener's forward path.
+        if not inst.all_locally_owned(dec):
+            return None
+        packed = PackedKeys(dec.key_buf, dec.key_offsets, dec.n)
+        if hasattr(engine, "tables"):
+            return engine.apply_columnar(
+                packed, dec.algo, dec.behavior, dec.hits, dec.limit,
+                dec.duration, dec.burst, route_hashes=dec.fnv1a,
+            )
+        return engine.apply_columnar(
+            packed, dec.algo, dec.behavior, dec.hits, dec.limit,
+            dec.duration, dec.burst,
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = np.zeros(3, dtype=np.int64)
+        self._lib.h2s_stats(
+            self._handle, out.ctypes.data_as(ctypes.c_void_p)
+        )
+        return {
+            "rpcs": int(out[0]),
+            "windows": int(out[1]),
+            "errors": int(out[2]),
+        }
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.h2s_stop(self._handle)
+            self._handle = None

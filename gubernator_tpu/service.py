@@ -572,6 +572,20 @@ class V1Instance:
     # DecisionEngine.apply_columnar — VERDICT r1 item 2: the served path
     # must be the same program as the benched one).
 
+    def all_locally_owned(self, dec) -> bool:
+        """True when every key in a decoded wire batch is owned by this
+        node (the columnar fast paths' gate; shared with the native h2
+        front so the ownership semantics cannot drift between them)."""
+        with self._peer_lock:
+            picker = self.local_picker
+        n_peers = picker.size()
+        if n_peers == 1:
+            return bool(picker.peers()[0].info.is_owner)
+        if n_peers > 1:
+            owners = picker.get_batch_dual_hashed(dec.fnv1, dec.fnv1a)
+            return all(o.info.is_owner for o in owners)
+        return True
+
     def serve_wire_bytes(
         self, raw: bytes, *, check_ownership: bool = True
     ) -> Optional[bytes]:
@@ -621,16 +635,8 @@ class V1Instance:
                 return None
             return self._serve_wire_global(dec, check_ownership)
         if check_ownership:
-            with self._peer_lock:
-                picker = self.local_picker
-            n_peers = picker.size()
-            if n_peers == 1:
-                if not picker.peers()[0].info.is_owner:
-                    return None
-            elif n_peers > 1:
-                owners = picker.get_batch_dual_hashed(dec.fnv1, dec.fnv1a)
-                if not all(o.info.is_owner for o in owners):
-                    return None
+            if not self.all_locally_owned(dec):
+                return None
             self.counters["local"] += dec.n
         self.counters["columnar"] += dec.n
 

@@ -90,6 +90,15 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "1",
         "BENCH_CAPACITY": str(1 << 17),
     },
+    # Same herd served through the native h2 fast front
+    # (net/h2_fast.py): C-side framing + group commit, one Python
+    # entry per window — the grpc-python per-RPC wall removed.
+    "herdfast": {
+        "BENCH_MODE": "herd",
+        "BENCH_KEYS": "1",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_HERD_FAST": "1",
+    },
     # Throughput-optimal operating point: batch 32768 amortizes the
     # tunneled backend's per-RPC fixed costs 4x deeper than the
     # default-config batch 8192 (PERF.md §9 transport arithmetic).

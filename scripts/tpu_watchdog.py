@@ -54,6 +54,7 @@ BENCH_ORDER = [
     "global4",
     "sketch",
     "herd",
+    "herdfast",
 ]
 
 PROBE_SRC = (

@@ -1,0 +1,193 @@
+"""Native h2 fast front: protocol correctness, real grpc-python client
+compatibility, scope enforcement (UNIMPLEMENTED for non-columnar
+traffic), and the cluster ownership gate."""
+
+import struct
+
+import pytest
+
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.core import h2_client
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.net import h2_fast
+from gubernator_tpu.net.grpc_service import V1Stub, dial
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import Behavior
+
+
+@pytest.fixture
+def daemon():
+    if h2_fast.load() is None:
+        pytest.skip("native h2 server unavailable")
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=1 << 12,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        h2_fast_address="127.0.0.1:0",
+        h2_fast_window=0.001,
+    )
+    d = spawn_daemon(conf)
+    yield d
+    d.close()
+
+
+def test_fast_front_serves_real_grpc_client(daemon):
+    """A stock grpc-python client must work against the front — the
+    single-method port design depends on ignoring request header
+    blocks, not on a cooperative client."""
+    stub = V1Stub(dial(daemon.h2_fast_address))
+    for expect in (4, 3, 2):
+        got = stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="f", unique_key="k", hits=1, limit=5,
+                        duration=60_000,
+                    )
+                ]
+            )
+        )
+        assert got.responses[0].remaining == expect
+    # State is shared with the full listener: the same bucket.
+    full = V1Stub(dial(daemon.grpc_address))
+    got = full.GetRateLimits(
+        pb.GetRateLimitsReq(
+            requests=[
+                pb.RateLimitReq(
+                    name="f", unique_key="k", hits=1, limit=5,
+                    duration=60_000,
+                )
+            ]
+        )
+    )
+    assert got.responses[0].remaining == 1
+
+
+def test_fast_front_multi_item_and_native_client(daemon):
+    payload = pb.GetRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name="m", unique_key=f"{i}k", hits=1, limit=100,
+                duration=60_000,
+            )
+            for i in range(7)
+        ]
+    ).SerializeToString()
+    res = h2_client.bench_unary(
+        daemon.h2_fast_address, "/pb.gubernator.V1/GetRateLimits",
+        payload, 0.4, 2,
+    )
+    assert res is not None
+    rpcs, errors, lats, frame, connected = res
+    assert errors == 0 and rpcs > 0
+    (ln,) = struct.unpack(">I", frame[1:5])
+    resp = pb.GetRateLimitsResp.FromString(frame[5 : 5 + ln])
+    assert len(resp.responses) == 7
+    assert all(0 <= r.remaining < 100 for r in resp.responses)
+
+
+def test_fast_front_declines_non_columnar(daemon):
+    """Behaviors outside the front's scope must answer UNIMPLEMENTED,
+    never a wrong decision (GLOBAL et al belong on the full listener)."""
+    import grpc
+
+    stub = V1Stub(dial(daemon.h2_fast_address))
+    with pytest.raises(grpc.RpcError) as err:
+        stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="g", unique_key="k", hits=1, limit=5,
+                        duration=60_000,
+                        behavior=int(Behavior.GLOBAL),
+                    )
+                ]
+            )
+        )
+    assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_fast_front_window_isolation(daemon):
+    """One out-of-scope RPC in a window must not fail its window-mates
+    (the per-RPC fallback in H2FastFront._window)."""
+    import ctypes
+
+    import numpy as np
+
+    front = daemon.h2_fast
+    plain = pb.GetRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name="iso", unique_key="a", hits=1, limit=9,
+                duration=60_000,
+            )
+        ]
+    ).SerializeToString()
+    glob = pb.GetRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name="iso", unique_key="b", hits=1, limit=9,
+                duration=60_000, behavior=int(Behavior.GLOBAL),
+            )
+        ]
+    ).SerializeToString()
+    concat = plain + glob
+    buf = ctypes.create_string_buffer(concat, len(concat))
+    counts = np.array([1, 1], dtype=np.int64)
+    lens = np.array([len(plain), len(glob)], dtype=np.int64)
+    cols = np.zeros(8, dtype=np.int64)
+    status = np.zeros(2, dtype=np.int64)
+    rc = front._window(
+        ctypes.addressof(buf), len(concat),
+        counts.ctypes.data, lens.ctypes.data, 2, 2,
+        cols.ctypes.data, status.ctypes.data,
+    )
+    assert rc == 0
+    assert status.tolist() == [0, 12]  # plain served, GLOBAL declined
+    assert cols[2 * 2 + 0] == 8  # remaining column, first lane
+
+
+def test_fast_front_ownership_gate():
+    """In a cluster, the front must decline peer-owned keys rather
+    than answer them locally."""
+    if h2_fast.load() is None:
+        pytest.skip("native h2 server unavailable")
+    import grpc
+
+    from gubernator_tpu.cluster.harness import ClusterHarness
+    from gubernator_tpu.net.h2_fast import H2FastFront
+
+    h = ClusterHarness().start(2, cache_size=1 << 12)
+    try:
+        d0 = h.daemons[0]
+        front = H2FastFront(d0.instance, window_s=0.001)
+        try:
+            stub = V1Stub(dial(front.address))
+            # Find a key owned by the OTHER node.
+            remote_key = None
+            for i in range(200):
+                key = f"{i}r"
+                owner = d0.instance.local_picker.get(f"own_{key}")
+                if owner.info.grpc_address != d0.grpc_address:
+                    remote_key = key
+                    break
+            assert remote_key is not None
+            with pytest.raises(grpc.RpcError) as err:
+                stub.GetRateLimits(
+                    pb.GetRateLimitsReq(
+                        requests=[
+                            pb.RateLimitReq(
+                                name="own", unique_key=remote_key,
+                                hits=1, limit=5, duration=60_000,
+                            )
+                        ]
+                    )
+                )
+            assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        finally:
+            front.close()
+    finally:
+        h.stop()
