@@ -151,23 +151,41 @@ def run(name: str, overrides: dict) -> dict:
     env = dict(os.environ)
     env.update(overrides)
     env.setdefault("BENCH_SECONDS", "5")
-    proc = subprocess.run(
+    # Own process group + group kill on timeout: the bench child's
+    # axon relay grandchild can hold the pipes open past a plain
+    # subprocess.run timeout (see scripts/tpu_watchdog.run_group).
+    import signal
+
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "bench.py")],
-        env=env,
-        cwd=ROOT,
-        capture_output=True,
-        text=True,
-        timeout=1200,
+        env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, stdin=subprocess.DEVNULL, text=True,
+        start_new_session=True,
     )
+    try:
+        out, err = proc.communicate(timeout=1200)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
+        return {"error": "bench timed out (group-killed)"}
     line = ""
-    for ln in (proc.stdout or "").strip().splitlines():
+    for ln in (out or "").strip().splitlines():
         ln = ln.strip()
         if ln.startswith("{"):
             line = ln
     if not line:
         return {
             "error": f"no JSON line (rc={proc.returncode})",
-            "stderr_tail": (proc.stderr or "")[-400:],
+            "stderr_tail": (err or "")[-400:],
         }
     result = json.loads(line)
     result["config"] = name

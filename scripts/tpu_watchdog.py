@@ -63,6 +63,38 @@ PROBE_SRC = (
 )
 
 
+
+def run_group(cmd, timeout, **kw):
+    """subprocess.run with a REAL timeout: the probe/bench children
+    could leave an axon relay grandchild holding the output pipes, and
+    subprocess.run's timeout path then blocks forever in its second
+    communicate().  Runs the command in its own process group, kills
+    the group on timeout, abandons unreapable pipes.  Returns
+    (returncode_or_None, stdout, stderr); returncode None = timeout."""
+    import signal
+
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        stdin=subprocess.DEVNULL, start_new_session=True, **kw,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out or "", err or ""
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
+        return None, "", ""
+
+
 def log(msg: str) -> None:
     ts = time.strftime("%H:%M:%S")
     print(f"[{ts}] {msg}", flush=True)
@@ -76,21 +108,44 @@ def write_status(state: dict) -> None:
 
 
 def probe() -> str | None:
-    """Return the live platform name, or None if wedged/dead."""
+    """Return the live platform name, or None if wedged/dead.
+
+    NOT subprocess.run(timeout=...): on timeout that kills the child
+    and then calls communicate() with NO timeout — if the axon plugin
+    spawns a relay grandchild that inherits the pipes, the second
+    communicate blocks forever on pipe EOF and the watchdog would sit
+    wedged while serving windows pass.  Run the probe in its own
+    process GROUP, kill the whole group on timeout, and abandon the
+    pipes if they still will not drain."""
+    import signal
+
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let the site hook force axon
+    proc = subprocess.Popen(
+        [sys.executable, "-c", PROBE_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        stdin=subprocess.DEVNULL, cwd=ROOT, env=env,
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
-            cwd=ROOT, env=env,
-        )
+        out, _ = proc.communicate(timeout=PROBE_TIMEOUT)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
         return None
     if proc.returncode != 0:
         return None
-    out = (proc.stdout or "").strip().split()
-    return out[0] if out else None
+    out_words = (out or "").strip().split()
+    return out_words[0] if out_words else None
 
 
 def commit_paths(paths: list[str], message: str) -> bool:
@@ -145,21 +200,19 @@ def artifact_platform(name: str) -> str | None:
 
 def run_profile() -> bool:
     out_path = os.path.join(ROOT, f"PROFILE_{ROUND}_tpu.json")
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "scripts", "profile_dispatch.py")],
-            capture_output=True, text=True, timeout=900, cwd=ROOT,
-        )
-    except subprocess.TimeoutExpired:
+    rc, out, err = run_group(
+        [sys.executable, os.path.join(ROOT, "scripts", "profile_dispatch.py")],
+        timeout=900, cwd=ROOT,
+    )
+    if rc is None:
         log("profile_dispatch timed out")
         return False
     line = ""
-    for ln in (proc.stdout or "").strip().splitlines():
+    for ln in out.strip().splitlines():
         if ln.strip().startswith("{"):
             line = ln.strip()
     if not line:
-        log(f"profile_dispatch produced no JSON (rc={proc.returncode}): "
-            f"{(proc.stderr or '')[-300:]}")
+        log(f"profile_dispatch produced no JSON (rc={rc}): {err[-300:]}")
         return False
     data = json.loads(line)
     with open(out_path, "w") as f:
@@ -177,16 +230,15 @@ def run_profile() -> bool:
 def run_bench(name: str) -> str | None:
     env = dict(os.environ)
     env["BENCH_ROUND"] = ROUND
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "scripts", "bench_all.py"), name],
-            capture_output=True, text=True, timeout=1800, cwd=ROOT, env=env,
-        )
-    except subprocess.TimeoutExpired:
+    rc, _out, _err = run_group(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_all.py"), name],
+        timeout=1800, cwd=ROOT, env=env,
+    )
+    if rc is None:
         log(f"bench {name}: timed out")
         return None
     plat = artifact_platform(name)
-    log(f"bench {name}: rc={proc.returncode} platform={plat}")
+    log(f"bench {name}: rc={rc} platform={plat}")
     if plat in ("tpu", "axon"):
         commit_paths(
             [f"BENCH_{ROUND}_{name}.json"],
