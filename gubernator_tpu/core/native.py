@@ -11,6 +11,7 @@ table is fuzz-tested (tests/test_native_table.py).
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -81,6 +82,7 @@ def load_library():
         ctypes.c_void_p,  # out_evict_rounds
         ctypes.c_void_p,  # out_n_evicted
         ctypes.c_void_p,  # stats_out
+        ctypes.c_int64,  # n_threads
     ]
     lib.git_set_expiry.argtypes = [
         ctypes.c_void_p,
@@ -244,6 +246,19 @@ class NativeInternTable:
             cap = int(ln)
 
 
+def _default_threads() -> int:
+    """GUBER_MULTI_THREADS resolved ONCE (malformed values fail at
+    first use, not per request); 0 = auto (ncpu-capped per call)."""
+    global _DEFAULT_THREADS
+    if _DEFAULT_THREADS is None:
+        env = os.environ.get("GUBER_MULTI_THREADS", "")
+        _DEFAULT_THREADS = int(env) if env else 0
+    return _DEFAULT_THREADS
+
+
+_DEFAULT_THREADS: Optional[int] = None
+
+
 def multi_schedule(
     tables: List["NativeInternTable"],
     buf_arr: np.ndarray,  # uint8 concatenated key bytes
@@ -251,6 +266,7 @@ def multi_schedule(
     hashes: Optional[np.ndarray],  # uint64 fnv1a per key (None = compute)
     now_ms: int,
     expires: Optional[np.ndarray] = None,  # int64 [n] TTL mirror writes
+    threads: Optional[int] = None,  # None = GUBER_MULTI_THREADS or ncpu
 ):
     """One FFI call for the sharded engine's whole host tier: shard
     routing, per-table interning/LRU/eviction, round assignment, TTL
@@ -262,6 +278,8 @@ def multi_schedule(
     n_sh = len(tables)
     n = len(offsets) - 1
     lib = tables[0]._lib
+    if threads is None:
+        threads = _default_threads() or min(n_sh, os.cpu_count() or 1)
     buf_arr = np.ascontiguousarray(buf_arr, dtype=np.uint8)
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     if hashes is not None:
@@ -298,6 +316,7 @@ def multi_schedule(
         _ptr(evict_rounds),
         _ptr(n_evicted),
         _ptr(stats),
+        int(threads),
     )
     for sh, t in enumerate(tables):
         off = t._stat_off

@@ -51,7 +51,8 @@ namespace {
 constexpr uint8_t kData = 0x0, kHeaders = 0x1, kRst = 0x3, kSettings = 0x4,
                   kPing = 0x6, kGoaway = 0x7, kWindowUpdate = 0x8,
                   kContinuation = 0x9;
-constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
+                  kFlagPadded = 0x8;
 
 void put_u24(uint8_t* p, uint32_t v) {
   p[0] = (v >> 16) & 0xff;
@@ -387,15 +388,34 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
           break;
         }
         case kData: {
+          // PADDED flag: first payload byte is the pad length, pad
+          // bytes trail — both must be stripped or they corrupt the
+          // grpc message body.
+          const uint8_t* dp = payload;
+          uint32_t dlen = flen;
+          if (flags & kFlagPadded) {
+            if (dlen < 1) {
+              conn->dead.store(true);
+              break;
+            }
+            const uint8_t pad = dp[0];
+            ++dp;
+            --dlen;
+            if (pad > dlen) {
+              conn->dead.store(true);
+              break;
+            }
+            dlen -= pad;
+          }
           StreamState& st = stream_of(stream);
-          if (st.body.size() + flen > (4u << 20)) {
+          if (st.body.size() + dlen > (4u << 20)) {
             // No legitimate rate-limit request is megabytes long —
             // cap per-stream buffering (DoS guard) and drop the conn.
             conn->dead.store(true);
             break;
           }
-          st.body.append(reinterpret_cast<const char*>(payload), flen);
-          conn->recv_since_update += flen;
+          st.body.append(reinterpret_cast<const char*>(dp), dlen);
+          conn->recv_since_update += flen;  // flow control counts raw
           if (flags & kFlagEndStream) {
             // grpc frame: 1-byte compressed flag + u32 length + body.
             if (st.body.size() < 5 || st.body[0] != 0) {

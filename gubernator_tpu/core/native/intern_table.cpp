@@ -25,9 +25,11 @@
 // C ABI only (consumed via ctypes; no pybind11 in this image).
 
 #include <algorithm>
+#include <array>
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -353,93 +355,121 @@ int64_t git_multi_schedule(
     int32_t* out_shard, int32_t* out_slots, int32_t* out_rounds,
     int64_t* out_order, int64_t* out_shard_counts, int32_t* out_evicted,
     int32_t* out_evict_shard, int32_t* out_evict_rounds,
-    int64_t* out_n_evicted, int64_t* stats_out) {
+    int64_t* out_n_evicted, int64_t* stats_out, int64_t n_threads) {
   for (int64_t sh = 0; sh < n_sh; ++sh)
     ++static_cast<Table*>(tables[sh])->epoch;
   const uint64_t ns = static_cast<uint64_t>(n_sh);
-  int64_t n_evicted = 0;
-  int64_t max_round = 0;
-  // Hash-ahead window (same rationale as git_schedule_idx): probes are
-  // cache-miss bound at large capacities, so prefetch the first bucket
-  // line of each key's table a window ahead.
-  constexpr int64_t kAhead = 16;
-  uint64_t hwin[kAhead];
-  auto hash_of = [&](int64_t j) {
-    return hashes ? hashes[j]
-                  : fnv1a(buf + offsets[j], offsets[j + 1] - offsets[j]);
-  };
-  auto prefetch = [&](uint64_t h) {
-    Table& t = *static_cast<Table*>(tables[h % ns]);
-    __builtin_prefetch(&t.buckets[h & t.mask]);
-    __builtin_prefetch(&t.bucket_hash[h & t.mask]);
-  };
-  const int64_t warm = n < kAhead ? n : kAhead;
-  for (int64_t j = 0; j < warm; ++j) {
-    hwin[j] = hash_of(j);
-    prefetch(hwin[j]);
-  }
-  for (int64_t j = 0; j < n; ++j) {
-    const uint64_t h = hwin[j % kAhead];
-    if (j + kAhead < n) {
-      const uint64_t hn = hash_of(j + kAhead);
-      hwin[(j + kAhead) % kAhead] = hn;
-      prefetch(hn);
-    }
-    const int64_t sh = static_cast<int64_t>(h % ns);
-    Table& t = *static_cast<Table*>(tables[sh]);
-    int32_t ev_slot, ev_round;
-    const int32_t slot =
-        schedule_one(t, buf + offsets[j], offsets[j + 1] - offsets[j], h,
-                     now_ms, &ev_slot, &ev_round);
-    if (ev_slot >= 0) {
-      out_evicted[n_evicted] = ev_slot;
-      out_evict_shard[n_evicted] = static_cast<int32_t>(sh);
-      out_evict_rounds[n_evicted] = ev_round;
-      ++n_evicted;
-    }
-    const int32_t round = t.next_round(slot);
-    if (round > max_round) max_round = round;
-    out_shard[j] = static_cast<int32_t>(sh);
-    out_slots[j] = slot;
-    out_rounds[j] = round;
-  }
-  // TTL mirror writes AFTER the scheduling loop — a same-batch
-  // eviction must read the expire the slot had before this batch
-  // (fresh inserts read 0), exactly like the deferred git_set_expiry
-  // call this replaces; writing inline would skew the
-  // unexpired_evictions metric.
-  if (expires) {
+
+  // Pass 1 (serial): hash + shard per item, then a counting sort that
+  // leaves out_order grouped by shard in ARRIVAL order — the layout
+  // the per-shard workers consume.
+  std::vector<uint64_t> h_local;
+  const uint64_t* h_all = hashes;
+  if (!h_all) {
+    h_local.resize(static_cast<size_t>(n));
     for (int64_t j = 0; j < n; ++j)
-      static_cast<Table*>(tables[out_shard[j]])->expire[out_slots[j]] =
-          expires[j];
+      h_local[j] = fnv1a(buf + offsets[j], offsets[j + 1] - offsets[j]);
+    h_all = h_local.data();
   }
-  // Dispatch ordering: counting-sort by shard, then sort each shard's
-  // segment by (slot, round).  (slot, round) pairs are unique within a
-  // shard — round k IS the k-th occurrence of the slot — so the sort
-  // is total and, for duplicate slots, round order equals arrival
-  // order (what the hot-key collapse requires).
   std::vector<int64_t> start(static_cast<size_t>(n_sh) + 1, 0);
-  for (int64_t j = 0; j < n; ++j) ++start[out_shard[j] + 1];
+  for (int64_t j = 0; j < n; ++j) {
+    const int64_t sh = static_cast<int64_t>(h_all[j] % ns);
+    out_shard[j] = static_cast<int32_t>(sh);
+    ++start[sh + 1];
+  }
   for (int64_t sh = 0; sh < n_sh; ++sh) {
     out_shard_counts[sh] = start[sh + 1];
     start[sh + 1] += start[sh];
   }
-  std::vector<int64_t> cursor(start.begin(), start.end() - 1);
-  for (int64_t j = 0; j < n; ++j) out_order[cursor[out_shard[j]]++] = j;
-  for (int64_t sh = 0; sh < n_sh; ++sh) {
-    std::sort(out_order + start[sh], out_order + start[sh + 1],
+  {
+    std::vector<int64_t> cursor(start.begin(), start.end() - 1);
+    for (int64_t j = 0; j < n; ++j) out_order[cursor[out_shard[j]]++] = j;
+  }
+
+  // Pass 2: per-shard scheduling — tables are independent, so shards
+  // run CONCURRENTLY on multi-core hosts (the ctypes caller released
+  // the GIL; n_threads <= 1 runs inline).  Each worker schedules its
+  // shard's items in arrival order, defers its TTL writes to after
+  // its loop (same-batch evictions must read pre-batch expire — the
+  // deferred git_set_expiry semantics), sorts its out_order segment
+  // by (slot, round), and publishes per-table stats.
+  std::vector<std::vector<std::array<int32_t, 2>>> evs(
+      static_cast<size_t>(n_sh));
+  std::vector<int32_t> shard_max(static_cast<size_t>(n_sh), 0);
+
+  auto work_shard = [&](int64_t sh) {
+    Table& t = *static_cast<Table*>(tables[sh]);
+    const int64_t lo = start[sh], hi = start[sh + 1];
+    auto& ev = evs[static_cast<size_t>(sh)];
+    int32_t local_max = 0;
+    constexpr int64_t kAhead = 8;
+    for (int64_t k = lo; k < hi; ++k) {
+      if (k + kAhead < hi) {
+        const uint64_t hn = h_all[out_order[k + kAhead]];
+        __builtin_prefetch(&t.buckets[hn & t.mask]);
+        __builtin_prefetch(&t.bucket_hash[hn & t.mask]);
+      }
+      const int64_t j = out_order[k];
+      int32_t ev_slot, ev_round;
+      const int32_t slot = schedule_one(
+          t, buf + offsets[j], offsets[j + 1] - offsets[j], h_all[j],
+          now_ms, &ev_slot, &ev_round);
+      if (ev_slot >= 0) ev.push_back({ev_slot, ev_round});
+      const int32_t round = t.next_round(slot);
+      if (round > local_max) local_max = round;
+      out_slots[j] = slot;
+      out_rounds[j] = round;
+    }
+    if (expires) {
+      for (int64_t k = lo; k < hi; ++k) {
+        const int64_t j = out_order[k];
+        t.expire[out_slots[j]] = expires[j];
+      }
+    }
+    // (slot, round) sort: pairs are unique within a shard — round k
+    // IS the k-th occurrence of the slot — so the sort is total and,
+    // for duplicate slots, round order equals arrival order (what
+    // the hot-key collapse requires).
+    std::sort(out_order + lo, out_order + hi,
               [&](int64_t a, int64_t b) {
                 if (out_slots[a] != out_slots[b])
                   return out_slots[a] < out_slots[b];
                 return out_rounds[a] < out_rounds[b];
               });
-  }
-  for (int64_t sh = 0; sh < n_sh; ++sh) {
-    const Table& t = *static_cast<Table*>(tables[sh]);
+    shard_max[static_cast<size_t>(sh)] = local_max;
     stats_out[4 * sh + 0] = t.hits;
     stats_out[4 * sh + 1] = t.misses;
     stats_out[4 * sh + 2] = t.evictions;
     stats_out[4 * sh + 3] = t.unexpired_evictions;
+  };
+
+  int64_t k_threads = n_threads;
+  if (k_threads > n_sh) k_threads = n_sh;
+  if (k_threads <= 1) {
+    for (int64_t sh = 0; sh < n_sh; ++sh) work_shard(sh);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(static_cast<size_t>(k_threads));
+    for (int64_t w = 0; w < k_threads; ++w)
+      pool.emplace_back([&, w]() {
+        for (int64_t sh = w; sh < n_sh; sh += k_threads) work_shard(sh);
+      });
+    for (auto& th : pool) th.join();
+  }
+
+  // Merge evictions (shard-grouped; consumers bucket by (round,
+  // shard), so inter-shard order is irrelevant).
+  int64_t n_evicted = 0;
+  int64_t max_round = 0;
+  for (int64_t sh = 0; sh < n_sh; ++sh) {
+    if (shard_max[static_cast<size_t>(sh)] > max_round)
+      max_round = shard_max[static_cast<size_t>(sh)];
+    for (const auto& e : evs[static_cast<size_t>(sh)]) {
+      out_evicted[n_evicted] = e[0];
+      out_evict_shard[n_evicted] = static_cast<int32_t>(sh);
+      out_evict_rounds[n_evicted] = e[1];
+      ++n_evicted;
+    }
   }
   *out_n_evicted = n_evicted;
   return max_round;

@@ -110,6 +110,41 @@ def test_fast_front_declines_non_columnar(daemon):
     assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
 
 
+def test_fast_front_sharded_engine():
+    """The front must route through the sharded engine's columnar path
+    (codec hashes as shard routes) when the daemon runs multi-device."""
+    if h2_fast.load() is None:
+        pytest.skip("native h2 server unavailable")
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=1 << 12,
+        peer_discovery_type="none",
+        device_count=8,
+        sweep_interval=0.0,
+        h2_fast_address="127.0.0.1:0",
+        h2_fast_window=0.001,
+    )
+    d = spawn_daemon(conf)
+    try:
+        assert hasattr(d.instance.engine, "tables"), "expected sharded"
+        stub = V1Stub(dial(d.h2_fast_address))
+        got = stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="sh", unique_key=f"{i}k", hits=1, limit=5,
+                        duration=60_000,
+                    )
+                    for i in range(20)
+                ]
+            )
+        )
+        assert [r.remaining for r in got.responses] == [4] * 20
+    finally:
+        d.close()
+
+
 def test_fast_front_window_isolation(daemon):
     """One out-of-scope RPC in a window must not fail its window-mates
     (the per-RPC fallback in H2FastFront._window)."""

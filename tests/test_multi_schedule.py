@@ -134,6 +134,47 @@ def test_multi_schedule_hot_key_collapse(frozen_clock, single_program):
     assert int(a[2][-1]) == 1000 - 50
 
 
+def test_multi_schedule_threaded_matches_serial(frozen_clock):
+    """The per-shard parallel workers (multi-core hosts; GIL released
+    in the FFI call) must be bit-identical to the serial path —
+    correctness is core-count-independent, so this pins it even on a
+    one-core runner."""
+    from gubernator_tpu.core.engine import PackedKeys
+    from gubernator_tpu.core.native import multi_schedule
+
+    rng = random.Random(9)
+    eng_a = ShardedDecisionEngine(shard_capacity=16, clock=frozen_clock)
+    _require_native(eng_a)
+    eng_b = ShardedDecisionEngine(shard_capacity=16, clock=frozen_clock)
+    for step in range(6):
+        reqs = _fuzz_reqs(rng, 120, rng.randint(1, 96))
+        keys = [r.hash_key().encode() for r in reqs]
+        packed = PackedKeys.from_list(keys)
+        now = frozen_clock.now_ms()
+        exp = np.full(len(keys), now + 60_000, dtype=np.int64)
+        a = multi_schedule(
+            eng_a.tables, packed.buf, packed.offsets, None, now, exp,
+            threads=1,
+        )
+        b = multi_schedule(
+            eng_b.tables, packed.buf, packed.offsets, None, now, exp,
+            threads=4,
+        )
+        assert a[0] == b[0], f"step {step} max_round"
+        for ai, bi, label in zip(a[1:6], b[1:6],
+                                 ("shard", "slots", "rounds", "order",
+                                  "counts")):
+            np.testing.assert_array_equal(
+                ai, bi, err_msg=f"step {step} {label}"
+            )
+        # Evictions: same multiset per shard (inter-shard order is the
+        # documented free variable).
+        ev_a = sorted(zip(a[7].tolist(), a[6].tolist(), a[8].tolist()))
+        ev_b = sorted(zip(b[7].tolist(), b[6].tolist(), b[8].tolist()))
+        assert ev_a == ev_b, f"step {step} evictions"
+        frozen_clock.advance(ms=500)
+
+
 def test_multi_schedule_ttl_mirror(frozen_clock):
     """The in-call TTL writes must match the deferred set_expiry they
     replace: after the TTLs lapse, cross-batch evictions must count as
