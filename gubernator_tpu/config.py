@@ -359,7 +359,7 @@ def setup_daemon_config(
         device_count=device_count,
         sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),
         local_batch_wait=_env_float_seconds(d, "GUBER_LOCAL_BATCH_WAIT", 0.0),
-        h2_fast_address=d.get("GUBER_H2_FAST_ADDRESS", ""),
+        h2_fast_address=_env(d, "GUBER_H2_FAST_ADDRESS", ""),
         h2_fast_window=_env_float_seconds(d, "GUBER_H2_FAST_WINDOW", 0.002),
         metric_flags=[
             f.strip()
