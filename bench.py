@@ -85,46 +85,12 @@ def _emit_once(result: dict) -> None:
 
 
 def _probe_backend(timeout: float) -> tuple[bool, str]:
-    """Initialize the configured jax backend in a throwaway subprocess.
+    """Initialize the configured jax backend in a throwaway subprocess
+    (shared group-kill implementation:
+    gubernator_tpu.platform_guard.probe_backend_subprocess)."""
+    from gubernator_tpu.platform_guard import probe_backend_subprocess
 
-    A wedged PJRT plugin can hang or crash the whole interpreter during
-    init; probing out-of-process means this process never touches the
-    backend until it is known healthy.  Returns (ok, detail)."""
-    # Not subprocess.run(timeout=...): its timeout path re-waits on
-    # the pipes with NO timeout, so an axon relay grandchild holding
-    # them open would wedge the probe forever — kill the whole process
-    # group instead (scripts/tpu_watchdog.run_group documents this).
-    import signal
-
-    proc = subprocess.Popen(
-        [sys.executable, "-c", _PROBE_SRC],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        stdin=subprocess.DEVNULL,
-        text=True,
-        start_new_session=True,
-    )
-    try:
-        out_s, err_s = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        try:
-            proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            if proc.stdout:
-                proc.stdout.close()
-            if proc.stderr:
-                proc.stderr.close()
-        return False, f"backend init timed out after {timeout:.0f}s"
-    if proc.returncode != 0:
-        tail = (err_s or out_s or "").strip().splitlines()
-        return False, (tail[-1][:300] if tail else f"rc={proc.returncode}")
-    # Last stdout line only: the plugin may log above the platform name.
-    lines = (out_s or "").strip().splitlines()
-    return True, (lines[-1].strip() if lines else "unknown")
+    return probe_backend_subprocess(timeout)
 
 
 def _pick_platform() -> tuple[str, str | None]:

@@ -26,26 +26,14 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    # Operator platform override, applied BEFORE any jax import: a
-    # wedged accelerator plugin can hang backend init, and merely
-    # setting JAX_PLATFORMS does not override an already-registered
-    # plugin (platform_guard docstring).  GUBER_PLATFORM=cpu runs the
-    # daemon on the host backend.  The -config file participates like
-    # every other GUBER_* key (load_env_file has no jax dependency),
-    # and GUBER_DEVICE_COUNT flows through so a sharded config keeps
-    # its full capacity on the virtual CPU mesh.
-    import os
-
-    early = dict(os.environ)
+    # Load the -config file EARLY (it exports into os.environ like
+    # every other GUBER_* source and has no jax dependency) so the
+    # GUBER_PLATFORM escape hatch in Daemon.start sees file-provided
+    # keys before any backend touch.
     if args.config:
         from gubernator_tpu.config import load_env_file
 
-        early.update(load_env_file(args.config))
-    if early.get("GUBER_PLATFORM", "").lower() == "cpu":
-        from gubernator_tpu.platform_guard import force_cpu_platform
-
-        n_dev = early.get("GUBER_DEVICE_COUNT", "")
-        force_cpu_platform(int(n_dev) if n_dev.isdigit() else None)
+        load_env_file(args.config)
 
     from gubernator_tpu.utils.logging_setup import configure_logging
 

@@ -10,6 +10,7 @@ membership pushes (daemon.go:370-380 marks self by address match).
 from __future__ import annotations
 
 import logging
+import os
 import ssl
 import tempfile
 import threading
@@ -66,6 +67,56 @@ class Daemon:
 
     # ------------------------------------------------------------------
 
+    def _probe_backend(self) -> None:
+        """Apply the operator platform escape hatch and fail FAST when
+        the accelerator plugin is wedged, instead of hanging backend
+        init forever.
+
+        GUBER_PLATFORM=cpu (honored HERE so every entry point —
+        binary, spawn_daemon, harness — gets it, not just
+        cmd/daemon.py) forces the host backend before any backend
+        touch.  Otherwise, when no backend is initialized yet, probe
+        it in a throwaway subprocess with a hard timeout
+        (platform_guard.probe_backend_subprocess — process-group kill)
+        and raise a clear error naming the escape hatch on failure.
+        GUBER_BACKEND_PROBE=0 disables the probe;
+        GUBER_BACKEND_PROBE_TIMEOUT takes Go-style durations."""
+        import sys
+
+        if os.environ.get("GUBER_PLATFORM", "").lower() == "cpu":
+            from gubernator_tpu.platform_guard import force_cpu_platform
+
+            force_cpu_platform(self.conf.device_count or None)
+            return
+        if os.environ.get("GUBER_BACKEND_PROBE", "1") == "0":
+            return
+        if "jax" in sys.modules:
+            # Importing jax does NOT initialize a backend (the package
+            # __init__ pulls jax in), so module presence alone must not
+            # skip the probe — but a forced-CPU platform or an
+            # already-initialized backend means there is nothing left
+            # to hang on.
+            import jax
+            from jax._src import xla_bridge
+
+            if (jax.config.jax_platforms or "") == "cpu":
+                return
+            if getattr(xla_bridge, "_backends", None):
+                return
+        from gubernator_tpu.config import _env_float_seconds
+        from gubernator_tpu.platform_guard import probe_backend_subprocess
+
+        timeout = _env_float_seconds(
+            {}, "GUBER_BACKEND_PROBE_TIMEOUT", 120.0
+        )
+        ok, detail = probe_backend_subprocess(timeout)
+        if not ok:
+            raise RuntimeError(
+                f"accelerator backend failed to initialize: {detail}; "
+                "set GUBER_PLATFORM=cpu to serve on the host backend, "
+                "or GUBER_BACKEND_PROBE=0 to wait indefinitely"
+            )
+
     def _build_engine(self):
         if self._engine is not None:
             return self._engine
@@ -96,6 +147,7 @@ class Daemon:
     def start(self) -> None:
         """reference: daemon.go:82-339 (Daemon.Start)."""
         conf = self.conf
+        self._probe_backend()
         engine = self._build_engine()
         self._warmup(engine)
         if self._loader is not None:

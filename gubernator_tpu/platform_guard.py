@@ -73,3 +73,47 @@ def disable_cpu_persistent_cache() -> None:
         _cc.reset_cache()
     except Exception:  # noqa: BLE001 — private API; best effort
         pass
+
+
+def probe_backend_subprocess(timeout: float) -> "tuple[bool, str]":
+    """Initialize the configured jax backend in a THROWAWAY subprocess
+    with a real timeout.  Shared by bench.py and Daemon.start — the
+    subtle part is identical in both: subprocess.run's timeout path
+    re-waits on the pipes with NO timeout, so a plugin relay grandchild
+    holding them open would wedge the caller forever; the probe runs in
+    its own process group, group-SIGKILLs on timeout, and abandons
+    unreapable pipes.  Returns (ok, detail): detail is the platform
+    name on success, the failure reason otherwise."""
+    import signal
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); print(d[0].platform)"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        stdin=subprocess.DEVNULL,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
+        return False, f"backend init timed out after {timeout:.0f}s"
+    if proc.returncode != 0:
+        tail = (err_s or out_s or "").strip().splitlines()
+        return False, (tail[-1][:300] if tail else f"rc={proc.returncode}")
+    lines = (out_s or "").strip().splitlines()
+    return True, (lines[-1].strip() if lines else "unknown")
