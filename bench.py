@@ -67,8 +67,6 @@ HARD_TIMEOUT = max(
     3.0 * MEASURE_SECONDS + 0.1 * LATENCY_BATCHES + 120.0,
 )
 
-_PROBE_SRC = "import jax; d = jax.devices(); print(d[0].platform)"
-
 _emit_lock = threading.Lock()
 _emitted = False
 
@@ -382,6 +380,10 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
     wire_batch = min(BATCH, 1000)  # MAX_BATCH_SIZE on the wire
     n_threads = int(os.environ.get("BENCH_WIRE_THREADS", 8))
     behavior = int(Behavior.SKETCH) if sketch else 0
+    # BENCH_WIRE_FAST=1: serve through the native h2 fast front with
+    # native clients — measures the front at the wire-max batch (the
+    # herd configs measure it at batch 1).
+    fast = os.environ.get("BENCH_WIRE_FAST", "0") != "0"
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
@@ -389,9 +391,58 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
         peer_discovery_type="none",
         device_count=1,
         sweep_interval=0.0,
+        h2_fast_address="127.0.0.1:0" if fast else "",
+        h2_fast_window=float(
+            os.environ.get("BENCH_LOCAL_BATCH_WAIT", "0.002")
+        ),
     )
     daemon = spawn_daemon(conf)
     try:
+        if fast and not sketch:
+            from gubernator_tpu.core import h2_client
+            from gubernator_tpu.net.grpc_service import V1_SERVICE as _V1
+
+            payloads = _build_payloads(pb, wire_batch, behavior=behavior)
+            res = h2_client.bench_unary(
+                daemon.h2_fast_address, f"/{_V1}/GetRateLimits",
+                payloads[0], MEASURE_SECONDS, n_threads,
+            )
+            if res is None or res[0] == 0 or res[1] != 0:
+                # NEVER fall through to the grpc path: the artifact
+                # would be measured over a different stack while
+                # labeled "fast front".
+                return {
+                    "metric": "rate-limit decisions/sec, single node, "
+                    "native h2 fast front",
+                    "value": 0,
+                    "unit": "decisions/sec",
+                    "vs_baseline": 0,
+                    "platform": platform,
+                    "error": (
+                        "native h2 client unavailable or errored: "
+                        f"res={None if res is None else (res[0], res[1])}"
+                    ),
+                }
+            if True:
+                rpcs, errors, lats, _frame, connected = res
+                rate = rpcs * wire_batch / MEASURE_SECONDS
+                return {
+                    "metric": "rate-limit decisions/sec, single node, "
+                    f"native h2 fast front (batch={wire_batch}, "
+                    f"{connected} native clients, {wire_batch} hot keys)",
+                    "value": round(rate, 1),
+                    "unit": "decisions/sec",
+                    "vs_baseline": round(
+                        rate / BASELINE_DECISIONS_PER_SEC, 2
+                    ),
+                    "p50_ms": round(
+                        float(np.percentile(lats, 50)) * 1e3, 3
+                    ) if len(lats) else None,
+                    "p99_ms": round(
+                        float(np.percentile(lats, 99)) * 1e3, 3
+                    ) if len(lats) else None,
+                    "platform": platform,
+                }
         n_procs = int(os.environ.get("BENCH_WIRE_PROCS", "0"))
         if n_procs:
             rate, p50_ms, p99_ms = _drive_grpc_procs(

@@ -83,6 +83,20 @@ CONFIGS: dict[str, dict] = {
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_WIRE_PROCS": "1",
     },
+    # Wire-max batch through the native h2 fast front: the front's
+    # throughput shape at batch 1000 (the herd configs measure batch 1).
+    "wirefast": {
+        "BENCH_MODE": "wire",
+        "BENCH_BATCH": "1000",
+        # The native client replays ONE payload, so exactly batch-many
+        # keys are exercised (the metric label says so too).
+        "BENCH_KEYS": "1000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_WIRE_FAST": "1",
+        # The group-commit window exists for tiny RPCs; at the
+        # wire-max batch it only adds latency — run it near zero.
+        "BENCH_LOCAL_BATCH_WAIT": "0.0002",
+    },
     # Thundering herd: 32 concurrent clients, one hot key, single-item
     # RPCs (reference: benchmark_test.go thundering-herd subtest).
     "herd": {
