@@ -382,8 +382,20 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
     behavior = int(Behavior.SKETCH) if sketch else 0
     # BENCH_WIRE_FAST=1: serve through the native h2 fast front with
     # native clients — measures the front at the wire-max batch (the
-    # herd configs measure it at batch 1).
+    # herd configs measure it at batch 1).  The front does not serve
+    # the sketch route, so the combination is an explicit error rather
+    # than a silently-grpc-measured artifact.
     fast = os.environ.get("BENCH_WIRE_FAST", "0") != "0"
+    if fast and sketch:
+        return {
+            "metric": "rate-limit decisions/sec, native h2 fast front",
+            "value": 0,
+            "unit": "decisions/sec",
+            "vs_baseline": 0,
+            "platform": platform,
+            "error": "BENCH_WIRE_FAST does not support the sketch mode "
+            "(the fast front serves plain columnar decisions only)",
+        }
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
@@ -423,26 +435,25 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
                         f"res={None if res is None else (res[0], res[1])}"
                     ),
                 }
-            if True:
-                rpcs, errors, lats, _frame, connected = res
-                rate = rpcs * wire_batch / MEASURE_SECONDS
-                return {
-                    "metric": "rate-limit decisions/sec, single node, "
-                    f"native h2 fast front (batch={wire_batch}, "
-                    f"{connected} native clients, {wire_batch} hot keys)",
-                    "value": round(rate, 1),
-                    "unit": "decisions/sec",
-                    "vs_baseline": round(
-                        rate / BASELINE_DECISIONS_PER_SEC, 2
-                    ),
-                    "p50_ms": round(
-                        float(np.percentile(lats, 50)) * 1e3, 3
-                    ) if len(lats) else None,
-                    "p99_ms": round(
-                        float(np.percentile(lats, 99)) * 1e3, 3
-                    ) if len(lats) else None,
-                    "platform": platform,
-                }
+            rpcs, errors, lats, _frame, connected = res
+            rate = rpcs * wire_batch / MEASURE_SECONDS
+            return {
+                "metric": "rate-limit decisions/sec, single node, "
+                f"native h2 fast front (batch={wire_batch}, "
+                f"{connected} native clients, {wire_batch} hot keys)",
+                "value": round(rate, 1),
+                "unit": "decisions/sec",
+                "vs_baseline": round(
+                    rate / BASELINE_DECISIONS_PER_SEC, 2
+                ),
+                "p50_ms": round(
+                    float(np.percentile(lats, 50)) * 1e3, 3
+                ) if len(lats) else None,
+                "p99_ms": round(
+                    float(np.percentile(lats, 99)) * 1e3, 3
+                ) if len(lats) else None,
+                "platform": platform,
+            }
         n_procs = int(os.environ.get("BENCH_WIRE_PROCS", "0"))
         if n_procs:
             rate, p50_ms, p99_ms = _drive_grpc_procs(

@@ -167,6 +167,11 @@ struct Server {
   WindowCallback callback = nullptr;
   int64_t window_us = 2000;
   int64_t max_batch = 16384;
+  // Early-flush threshold: dispatch before the window elapses once
+  // this many items are queued (an engine-batch-worth; the window
+  // exists to amortize tiny RPCs, not to delay full batches).
+  int64_t flush_items = 4096;
+  int64_t queued_items = 0;  // guarded by q_mu
   std::atomic<bool> closing{false};
   std::thread accept_thread, dispatch_thread;
   std::mutex q_mu;
@@ -439,6 +444,7 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                   std::lock_guard<std::mutex> lock(srv->q_mu);
                   srv->queue.push_back(PendingRpc{
                       conn, stream, std::move(body), items});
+                  srv->queued_items += items;
                   srv->q_cv.notify_one();
                 }
               }
@@ -487,15 +493,28 @@ void dispatch_loop(Server* srv) {
         return srv->closing.load() || !srv->queue.empty();
       });
       if (srv->closing.load()) return;
+      // Group-commit window with EARLY FLUSH: wait up to window_us for
+      // concurrent arrivals, but dispatch as soon as an engine-batch-
+      // worth of items is queued — large-batch RPCs should not pay
+      // the window that exists to amortize tiny ones.  The running
+      // counter keeps the predicate O(1) per producer notify.
+      if (srv->queued_items < srv->flush_items) {
+        const auto deadline = std::chrono::steady_clock::now() +
+                              std::chrono::microseconds(srv->window_us);
+        srv->q_cv.wait_until(lock, deadline, [&] {
+          return srv->closing.load() ||
+                 srv->queued_items >= srv->flush_items;
+        });
+        if (srv->closing.load()) return;
+      }
     }
-    // Group-commit window: let concurrent arrivals pile in.
-    std::this_thread::sleep_for(std::chrono::microseconds(srv->window_us));
     int64_t total = 0;
     {
       std::lock_guard<std::mutex> lock(srv->q_mu);
       while (!srv->queue.empty() &&
              total + srv->queue.front().items <= srv->max_batch) {
         total += srv->queue.front().items;
+        srv->queued_items -= srv->queue.front().items;
         batch.push_back(std::move(srv->queue.front()));
         srv->queue.pop_front();
       }
@@ -583,11 +602,12 @@ extern "C" {
 // Start the front on 127.0.0.1:port (0 = ephemeral).  Returns an
 // opaque handle, or nullptr on bind failure.
 void* h2s_start(int32_t port, int64_t window_us, int64_t max_batch,
-                WindowCallback callback) {
+                int64_t flush_items, WindowCallback callback) {
   auto* srv = new Server();
   srv->callback = callback;
   srv->window_us = window_us;
   srv->max_batch = max_batch;
+  if (flush_items > 0) srv->flush_items = flush_items;
   srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (srv->listen_fd < 0) {
     delete srv;

@@ -60,7 +60,8 @@ def load() -> Optional[ctypes.CDLL]:
     lib = ctypes.CDLL(str(so))
     lib.h2s_start.restype = ctypes.c_void_p
     lib.h2s_start.argtypes = [
-        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, _CALLBACK,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        _CALLBACK,
     ]
     lib.h2s_port.restype = ctypes.c_int32
     lib.h2s_port.argtypes = [ctypes.c_void_p]
@@ -80,6 +81,7 @@ class H2FastFront:
         port: int = 0,
         window_s: float = 0.002,
         max_batch: int = 16384,
+        flush_items: int = 4096,  # early-flush: an engine-batch-worth
     ):
         lib = load()
         if lib is None:
@@ -89,7 +91,7 @@ class H2FastFront:
         # The ctypes callback object must outlive the server.
         self._cb = _CALLBACK(self._window)
         self._handle = lib.h2s_start(
-            port, int(window_s * 1e6), max_batch, self._cb
+            port, int(window_s * 1e6), max_batch, flush_items, self._cb
         )
         if not self._handle:
             raise RuntimeError("h2 fast front failed to bind")
