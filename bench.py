@@ -561,17 +561,19 @@ def _client_proc_main() -> int:
 
 
 def _drive_grpc_procs(
-    np, addrs: list, n_procs: int, items_per_rpc: int, behavior: int = 0
+    np, addrs: list, n_procs: int, items_per_rpc: int, behavior: int = 0,
+    seconds: float | None = None,
 ):
     """Closed-loop load from SUBPROCESS clients: the server's GIL is
     not shared with the load generator, so the measurement reflects
     server capacity, not client/server GIL thrash.  Returns
     (items/sec, p50_ms, p99_ms)."""
+    seconds = MEASURE_SECONDS if seconds is None else seconds
     procs = [
         subprocess.Popen(
             [
                 sys.executable, os.path.abspath(__file__), "--wire-client",
-                addrs[t % len(addrs)], str(MEASURE_SECONDS),
+                addrs[t % len(addrs)], str(seconds),
                 str(items_per_rpc), str(N_KEYS), str(behavior),
             ],
             stdout=subprocess.PIPE,
@@ -582,7 +584,7 @@ def _drive_grpc_procs(
     rate = 0.0
     lats: list = []
     for p in procs:
-        out, _ = p.communicate(timeout=3 * MEASURE_SECONDS + 180)
+        out, _ = p.communicate(timeout=3 * seconds + 180)
         line = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
         d = json.loads(line)
         # Each child measures its own closed-loop window; the summed
@@ -831,13 +833,201 @@ def _run_herd(np, platform: str) -> dict:
         daemon.close()
 
 
+def _scrape_stage_raw(http_addrs: list) -> tuple:
+    """Cumulative gubernator_stage_duration (count, sum) aggregated
+    across the nodes' /metrics."""
+    import re
+    import urllib.request
+
+    counts: dict = {}
+    sums: dict = {}
+    pat = re.compile(
+        r'gubernator_stage_duration_(count|sum)\{stage="([a-z_]+)"\}\s+'
+        r"([0-9.e+-]+)"
+    )
+    for addr in http_addrs:
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+        except OSError:
+            continue
+        for kind, stage, val in pat.findall(text):
+            d = counts if kind == "count" else sums
+            d[stage] = d.get(stage, 0.0) + float(val)
+    return counts, sums
+
+
+def _stage_budget_diff(before: tuple, after: tuple) -> dict:
+    """Per-stage means over the MEASURED window only (the counters are
+    cumulative from daemon start, and the warmup round's cold-compile
+    windows must not bias the published budget)."""
+    c0, s0 = before
+    c1, s1 = after
+    out = {}
+    for stage, n1 in c1.items():
+        dn = n1 - c0.get(stage, 0.0)
+        ds = s1.get(stage, 0.0) - s0.get(stage, 0.0)
+        out[stage] = {
+            "count": int(dn),
+            "mean_ms": round(ds / dn * 1e3, 3) if dn else 0.0,
+        }
+    return out
+
+
+def _run_global_procs(np, platform: str, n_nodes: int, wire_batch: int) -> dict:
+    """GLOBAL over a process-per-node cluster (GUBER_STATIC_PEERS).
+
+    The in-process harness serializes every node's Python behind ONE
+    GIL — a contention mode the Go reference does not have anywhere
+    (its in-process benchmark cluster still parallelizes across
+    cores).  One daemon process per node is the faithful analog of a
+    real deployment, and the artifact records the topology.  Client
+    load also runs as subprocesses (the wire config's precedent) so
+    the measurement reflects server capacity."""
+    import signal
+    import socket
+
+    from gubernator_tpu.types import Behavior
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    grpc_addrs = [f"127.0.0.1:{free_port()}" for _ in range(n_nodes)]
+    http_addrs = [f"127.0.0.1:{free_port()}" for _ in range(n_nodes)]
+    peers = ",".join(grpc_addrs)
+    procs = []
+    root = os.path.dirname(os.path.abspath(__file__))
+    for i in range(n_nodes):
+        env = dict(os.environ)
+        env.update(
+            {
+                "GUBER_PLATFORM": "cpu",
+                "JAX_PLATFORMS": "cpu",
+                "GUBER_GRPC_ADDRESS": grpc_addrs[i],
+                "GUBER_HTTP_ADDRESS": http_addrs[i],
+                "GUBER_PEER_DISCOVERY_TYPE": "none",
+                "GUBER_STATIC_PEERS": peers,
+                "GUBER_CACHE_SIZE": str(CAPACITY),
+                "GUBER_SWEEP_INTERVAL": "0",
+                # The harness's cluster-test knobs, matched.
+                "GUBER_GLOBAL_SYNC_WAIT": os.environ.get(
+                    "BENCH_GLOBAL_SYNC_WAIT", "50ms"
+                ),
+                "GUBER_BATCH_WAIT": "5ms",
+                "GUBER_GLOBAL_TIMEOUT": "1s",
+                "GUBER_BATCH_TIMEOUT": "1s",
+                # Serving-daemon posture for a shared-core CPU host:
+                # inline XLA dispatch (async dispatch only adds
+                # cross-thread handoffs when there is no accelerator
+                # RPC to overlap — each handoff costs scheduler
+                # latency under 4-nodes-on-2-cores oversubscription),
+                # and a worker pool sized near the core count so
+                # excess RPCs queue FIFO in the executor instead of
+                # convoying on the engine lock.
+                "JAX_CPU_ENABLE_ASYNC_DISPATCH": os.environ.get(
+                    "BENCH_CPU_ASYNC_DISPATCH", "false"
+                ),
+                "GUBER_GRPC_WORKERS": os.environ.get(
+                    "BENCH_GRPC_WORKERS", "6"
+                ),
+            }
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "gubernator_tpu.cmd.daemon"],
+                env=env,
+                cwd=root,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                stdin=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+        )
+    try:
+        import grpc
+
+        from gubernator_tpu.net.grpc_service import V1Stub, dial
+        from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+        deadline = time.monotonic() + 240.0
+        for addr in grpc_addrs:
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"node {addr} never became ready")
+                ch = dial(addr)
+                try:
+                    V1Stub(ch).HealthCheck(pb.HealthCheckReq(), timeout=1.0)
+                    break
+                except grpc.RpcError:
+                    time.sleep(0.25)
+                finally:
+                    ch.close()
+        warm_seconds = float(os.environ.get("BENCH_WARM_SECONDS", 0.0))
+        n_procs = int(os.environ.get("BENCH_WIRE_PROCS", "8"))
+        behavior = int(Behavior.GLOBAL)
+        if warm_seconds:
+            # A throwaway client round pays the cold XLA compiles and
+            # first-window flush storms before the measured window.
+            _drive_grpc_procs(
+                np, grpc_addrs, n_procs, wire_batch, behavior=behavior,
+                seconds=warm_seconds,
+            )
+        stage_before = _scrape_stage_raw(http_addrs)
+        rate, p50_ms, p99_ms = _drive_grpc_procs(
+            np, grpc_addrs, n_procs, wire_batch, behavior=behavior
+        )
+        budget = _stage_budget_diff(
+            stage_before, _scrape_stage_raw(http_addrs)
+        )
+        return {
+            "metric": f"rate-limit decisions/sec, GLOBAL, {n_nodes}-node "
+            f"cluster, one daemon process per node (batch={wire_batch}, "
+            f"{n_procs} client procs, {N_KEYS} hot keys)",
+            "value": round(rate, 1),
+            "unit": "decisions/sec",
+            "vs_baseline": round(rate / BASELINE_DECISIONS_PER_SEC, 2),
+            "p50_ms": p50_ms,
+            "p99_ms": p99_ms,
+            "platform": platform,
+            "topology": "process-per-node",
+            "stage_budget_ms": budget,
+        }
+    finally:
+        for p in procs:
+            try:
+                os.killpg(p.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+
 def _run_global(np, platform: str) -> dict:
-    """BASELINE config 3: GLOBAL behavior over an in-process cluster.
+    """BASELINE config 3: GLOBAL behavior over a local cluster.
 
     Every request carries Behavior.GLOBAL; clients spray all nodes, so
     non-owners answer from the owner-broadcast status cache while hits
     aggregate asynchronously to owners (reference: global.go;
-    benchmark_test.go:29-148's GLOBAL subtest)."""
+    benchmark_test.go:29-148's GLOBAL subtest).
+
+    On the CPU host the cluster runs one daemon PROCESS per node
+    (BENCH_GLOBAL_PROCS=0 restores the in-process harness): in-process
+    nodes share one GIL, a serialization the Go reference never pays,
+    and the artifact should measure the serving stack, not CPython's
+    scheduler.  On an accelerator host the in-process harness stands
+    (N processes cannot share one device)."""
     from gubernator_tpu.cluster.harness import ClusterHarness
     from gubernator_tpu.net.pb import gubernator_pb2 as pb
     from gubernator_tpu.types import Behavior
@@ -845,6 +1035,9 @@ def _run_global(np, platform: str) -> dict:
     n_nodes = int(os.environ.get("BENCH_NODES", 4))
     n_threads = int(os.environ.get("BENCH_WIRE_THREADS", 8))
     wire_batch = min(BATCH, 1000)
+    procs_default = "1" if platform == "cpu" else "0"
+    if os.environ.get("BENCH_GLOBAL_PROCS", procs_default) != "0":
+        return _run_global_procs(np, platform, n_nodes, wire_batch)
     h = ClusterHarness().start(n_nodes, cache_size=CAPACITY)
     try:
         addrs = [h.peer_at(i).grpc_address for i in range(n_nodes)]

@@ -6,24 +6,81 @@ multi-region multiregion.go:43-92): accumulate items into an aggregate,
 flush when the aggregate reaches `batch_limit` or `sync_wait` has
 elapsed since the first item.  This is the one host-side primitive that
 feeds the TPU step cadence, so it lives in one place.
+
+Round 6 (VERDICT r5 weak #2): `sync_wait` is now a CAP, not a fixed
+delay.  Every tier grew one of these windows, and on the GLOBAL path
+they stack in series (client window + hit window + broadcast window),
+so a fixed wait taxes the cluster-tier MEDIAN even when nothing would
+have batched.  AdaptiveWait keeps the reference's interval semantics
+(peer_client.go:380-453: the ticker only matters when traffic is
+actually queueing) but sizes the wait by measured occupancy: an idle
+batcher fires immediately; the wait grows toward the cap only while
+batches actually fill.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Generic, TypeVar
+from typing import Callable, Dict, Generic, Optional, TypeVar
 
 K = TypeVar("K")
 V = TypeVar("V")
 
 
+class AdaptiveWait:
+    """Load-adaptive batching window: 0 under low occupancy, `cap`
+    when batches fill.
+
+    Occupancy is an EWMA of flush fill fraction (drained items ÷
+    batch_limit).  The wait is `cap * min(1, ewma / fill_target)`:
+    once windows fill past `fill_target` of the limit the full cap is
+    worth paying (amortization), below it the wait shrinks linearly to
+    zero — a single-caller batcher flushes as soon as the item lands
+    instead of idling out its window (the cluster-tier p50 mechanism,
+    VERDICT r5 weak #2).  The feedback is self-correcting: firing
+    immediately under trickle load keeps batches small, which keeps
+    the wait at ~0; under a herd, even zero-wait windows fill while
+    the previous flush runs, which grows the wait toward the cap.
+    """
+
+    __slots__ = ("cap", "limit", "fill_target", "alpha", "_ewma")
+
+    def __init__(
+        self,
+        cap: float,
+        limit: int,
+        *,
+        fill_target: float = 0.5,
+        alpha: float = 0.4,
+    ):
+        self.cap = cap
+        self.limit = max(1, limit)
+        self.fill_target = fill_target
+        self.alpha = alpha
+        self._ewma = 0.0  # start idle: the first window fires fast
+
+    def next_wait(self) -> float:
+        if self.cap <= 0:
+            return 0.0
+        frac = min(1.0, self._ewma / self.fill_target)
+        w = self.cap * frac
+        # Sub-50µs sleeps cost more in scheduler churn than they buy.
+        return w if w >= 50e-6 else 0.0
+
+    def observe(self, drained: int) -> None:
+        fill = min(1.0, drained / self.limit)
+        self._ewma += self.alpha * (fill - self._ewma)
+
+
 class IntervalBatcher(Generic[K, V]):
-    """Aggregate (key, item) pairs; flush at batch_limit or sync_wait.
+    """Aggregate (key, item) pairs; flush at batch_limit or an
+    occupancy-adaptive wait capped at sync_wait.
 
     `combine(existing, item) -> merged` merges a new item into the
     aggregate for its key (None existing for the first).  `flush(dict)`
-    runs on the batcher thread; long work should hop to an executor.
+    runs on the batcher thread (ordered mode) or a small flush pool
+    (ordered=False) — see `flush_workers`.
     """
 
     def __init__(
@@ -36,18 +93,33 @@ class IntervalBatcher(Generic[K, V]):
         name: str = "batcher",
         chunked: bool = False,
         drain_limit: int | None = None,
+        item_drain_limit: int | None = None,
         max_pending: int | None = None,
         overflow: str = "block",
+        adaptive: bool = True,
+        flush_workers: int = 0,
+        wait_stat=None,  # DurationStat: queue age at drain (window wait)
+        age_stat=None,  # DurationStat: oldest-item age at flush END
     ):
         self.sync_wait = sync_wait
         self.batch_limit = batch_limit
+        # sync_wait as an occupancy-scaled cap (AdaptiveWait) vs the
+        # pre-round-6 fixed wait (tests that pin window timing).
+        self._adaptive = (
+            AdaptiveWait(sync_wait, batch_limit) if adaptive else None
+        )
         # Max items taken per flush CYCLE (None = drain everything).
         # Under overload an unbounded drain turns into one multi-second
         # flush that holds the GIL/core against the serving threads and
         # blows peer RPC deadlines (the GLOBAL p99 tail, PERF.md §15);
         # a bounded drain keeps each flush ~batch-sized and lets the
         # loop run back-to-back cycles until the queue is level.
+        # (Columnar flushes that aggregate their drain vectorized can
+        # safely take None + max_pending as the bound instead —
+        # item_drain_limit then still caps the DICT items per cycle,
+        # whose flush cost is per-key Python, not one numpy pass.)
         self._drain_limit = drain_limit
+        self._item_drain_limit = item_drain_limit
         # Queue bound.  overflow="block": producers wait for drain
         # space (the reference's unbuffered-channel backpressure,
         # global.go:68-74) — safe only where no flush path can
@@ -59,6 +131,8 @@ class IntervalBatcher(Generic[K, V]):
         self.dropped = 0
         self._combine = combine
         self._flush = flush
+        self._wait_stat = wait_stat
+        self._age_stat = age_stat
         # chunked=True: the flush callable accepts (dict, chunks) and
         # add_chunk is available — the columnar wire path queues whole
         # column slices in O(1) instead of per-item dict merges, and
@@ -77,10 +151,28 @@ class IntervalBatcher(Generic[K, V]):
         # older batcher snapshot would regress peer caches).
         self._turn_cv = threading.Condition(threading.Lock())
         self._next_turn = 0  # next turn number to hand out
-        self._done_turn = 0  # turns fully flushed
+        self._done_turn = 0  # turns fully flushed (ordered mode)
+        self._active_turns: set = set()  # in-flight turns (pooled mode)
         self._cv = threading.Condition(self._lock)
         self._space = threading.Condition(self._lock)  # drain freed room
         self._closing = False
+        # flush_workers > 0: flushes hop to a bounded pool so the NEXT
+        # window opens while this flush's RPCs are still in flight —
+        # the batching cadence overlaps the network instead of
+        # serializing behind it (the pipelined-GLOBAL-flush half of
+        # VERDICT r5 weak #2).  Only valid for commutative flushes
+        # (hit sums); supersedable traffic needs delivery order and
+        # keeps flush_workers=0.
+        self._flush_pool = None
+        self._flush_slots = None
+        if flush_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._flush_pool = ThreadPoolExecutor(
+                max_workers=flush_workers,
+                thread_name_prefix=f"{name}-flush",
+            )
+            self._flush_slots = threading.Semaphore(flush_workers)
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
@@ -103,6 +195,7 @@ class IntervalBatcher(Generic[K, V]):
                 self._space.wait(timeout=1.0)
             return not self._closing
         # drop_oldest: shed whole chunks first (cheap), then items.
+        shed_chunks = False
         while (
             len(self._items) + self._chunk_count + incoming
             > self._max_pending
@@ -111,6 +204,7 @@ class IntervalBatcher(Generic[K, V]):
             _, cnt, _ts = self._chunks.pop(0)
             self._chunk_count -= cnt
             self.dropped += cnt
+            shed_chunks = True
         while (
             len(self._items) + self._chunk_count + incoming
             > self._max_pending
@@ -118,6 +212,17 @@ class IntervalBatcher(Generic[K, V]):
         ):
             self._items.pop(next(iter(self._items)))
             self.dropped += 1
+        if shed_chunks:
+            # Re-anchor the backlog age on the oldest SURVIVING chunk —
+            # keeping the shed items' arrival time overstated the gauge
+            # for as long as the overload lasted (ADVICE r5).  With
+            # only dict items left the old anchor stands (per-key
+            # arrival is untracked; overestimating is the safe
+            # direction for an overload gauge).
+            if self._chunks:
+                self._oldest_ts = self._chunks[0][2]
+            elif not self._items:
+                self._oldest_ts = time.monotonic()
         return True
 
     def add(self, key: K, item) -> None:
@@ -142,6 +247,13 @@ class IntervalBatcher(Generic[K, V]):
             if not self._items and not self._chunks:
                 return 0.0
             return time.monotonic() - self._oldest_ts
+
+    def current_wait(self) -> float:
+        """The wait the next window will use (sync_wait when the
+        batcher is non-adaptive) — metrics gauge + tests."""
+        if self._adaptive is None:
+            return self.sync_wait
+        return self._adaptive.next_wait()
 
     def add_many(self, pairs) -> None:
         """Batch enqueue under ONE lock acquisition — a 1000-item wire
@@ -173,12 +285,25 @@ class IntervalBatcher(Generic[K, V]):
 
     def _run(self) -> None:
         while True:
+            if self._flush_slots is not None:
+                # Reserve a flush slot BEFORE draining: when the pool
+                # is saturated the queue keeps absorbing (bounded by
+                # max_pending) instead of a drained snapshot sitting in
+                # a handoff limbo the gauges can't see.
+                self._flush_slots.acquire()
             with self._lock:
                 while not self._items and not self._chunks and not self._closing:
                     self._cv.wait()
                 if self._closing and not self._items and not self._chunks:
+                    if self._flush_slots is not None:
+                        self._flush_slots.release()
                     return
-                deadline = time.monotonic() + self.sync_wait
+                wait = (
+                    self._adaptive.next_wait()
+                    if self._adaptive is not None
+                    else self.sync_wait
+                )
+                deadline = time.monotonic() + wait
                 while (
                     len(self._items) + self._chunk_count < self.batch_limit
                     and not self._closing
@@ -187,10 +312,16 @@ class IntervalBatcher(Generic[K, V]):
                     if remaining <= 0:
                         break
                     self._cv.wait(remaining)
+                drained_oldest = self._oldest_ts
                 batch, chunks = self._drain_locked()
                 turn = self._take_turn()
+            if self._flush_pool is not None:
+                self._flush_pool.submit(
+                    self._flush_pooled, turn, batch, chunks, drained_oldest
+                )
+                continue
             try:
-                self._flush_in_turn(turn, batch, chunks)
+                self._flush_in_turn(turn, batch, chunks, drained_oldest)
             except Exception:  # noqa: BLE001 — loop must survive flush errors
                 import logging
 
@@ -202,17 +333,62 @@ class IntervalBatcher(Generic[K, V]):
         """Take up to `drain_limit` queued items (caller holds the
         lock).  Returns (items_dict, chunk_list).  limit=None forces a
         full drain (flush_now / tests)."""
+        from_loop = limit == -1
         if limit == -1:
             limit = self._drain_limit
+        # item_drain_limit applies only to the loop's cycles; an
+        # explicit flush_now/close drain (limit=None from the caller)
+        # takes everything.
+        item_cap = self._item_drain_limit if from_loop else None
         if (
             limit is None
-            or len(self._items) + self._chunk_count <= limit
+            and item_cap is not None
+            and len(self._items) > item_cap
         ):
+            # Full chunk drain (vectorized flush) but a BOUNDED dict
+            # drain: dict items cost per-key Python in the flush, so
+            # an unbounded dict backlog would be the §15 monster
+            # flush all over again.
+            taken = 0
+            batch: Dict[K, V] = {}
+            for k in list(self._items.keys()):
+                if taken >= item_cap:
+                    break
+                batch[k] = self._items.pop(k)
+                taken += 1
+            pairs, self._chunks = self._chunks, []
+            drained = taken + self._chunk_count
+            self._chunk_count = 0
+            if self._adaptive is not None:
+                self._adaptive.observe(drained)
+            if self._wait_stat is not None:
+                self._wait_stat.observe(
+                    max(0.0, time.monotonic() - self._oldest_ts)
+                )
+            # Dict items remain and per-key arrival is untracked: the
+            # old anchor stands (overestimating age is the safe
+            # direction for an overload gauge).
+            self._space.notify_all()
+            return batch, [c for c, _, _ in pairs]
+        drained = len(self._items) + self._chunk_count
+        if limit is None or drained <= limit:
+            if self._adaptive is not None:
+                self._adaptive.observe(drained)
+            if self._wait_stat is not None and drained:
+                self._wait_stat.observe(
+                    max(0.0, time.monotonic() - self._oldest_ts)
+                )
             batch, self._items = self._items, {}
             pairs, self._chunks = self._chunks, []
             self._chunk_count = 0
             self._space.notify_all()
             return batch, [c for c, _, _ in pairs]
+        if self._adaptive is not None:
+            self._adaptive.observe(limit)
+        if self._wait_stat is not None:
+            self._wait_stat.observe(
+                max(0.0, time.monotonic() - self._oldest_ts)
+            )
         taken = 0
         batch: Dict[K, V] = {}
         # CPython dicts iterate in insertion order: oldest keys first.
@@ -246,34 +422,82 @@ class IntervalBatcher(Generic[K, V]):
         with self._turn_cv:
             turn = self._next_turn
             self._next_turn += 1
+            if self._flush_pool is not None:
+                self._active_turns.add(turn)
         return turn
 
-    def _flush_in_turn(self, turn: int, batch, chunks) -> None:
+    def _flush_in_turn(
+        self, turn: int, batch, chunks, drained_oldest: float = 0.0
+    ) -> None:
         """Run the flush when (and only when) its turn comes up, so
         snapshot order == delivery order; always advances the turn."""
         with self._turn_cv:
             while self._done_turn != turn:
                 self._turn_cv.wait()
         try:
-            if batch or chunks:
-                if self._chunked:
-                    self._flush(batch, chunks)
-                else:
-                    self._flush(batch)
+            self._flush_batch(batch, chunks, drained_oldest)
         finally:
             with self._turn_cv:
                 self._done_turn = turn + 1
                 self._turn_cv.notify_all()
 
+    def _flush_pooled(
+        self, turn: int, batch, chunks, drained_oldest: float
+    ) -> None:
+        """Pool-mode flush: runs CONCURRENTLY with other flushes (no
+        turn wait — only commutative flushes use the pool); completion
+        is tracked per turn so flush_now can wait out older snapshots."""
+        try:
+            self._flush_batch(batch, chunks, drained_oldest)
+        except Exception:  # noqa: BLE001 — pool must survive flush errors
+            import logging
+
+            logging.getLogger("gubernator_tpu").exception(
+                "batcher flush failed"
+            )
+        finally:
+            self._flush_slots.release()
+            with self._turn_cv:
+                self._active_turns.discard(turn)
+                self._turn_cv.notify_all()
+
+    def _flush_batch(self, batch, chunks, drained_oldest: float) -> None:
+        if batch or chunks:
+            if self._chunked:
+                self._flush(batch, chunks)
+            else:
+                self._flush(batch)
+            if self._age_stat is not None and drained_oldest:
+                # Enqueue→delivered age of the snapshot's oldest item:
+                # the stage a consumer of this batcher actually waits
+                # (broadcast age in the GLOBAL budget).
+                self._age_stat.observe(
+                    max(0.0, time.monotonic() - drained_oldest)
+                )
+
     def flush_now(self) -> None:
         """Flush everything queued immediately, on the caller's thread
         (operational drains + deterministic tests).  Returns only after
-        every OLDER snapshot's flush AND this drain complete (turn
-        ordering); producers never wait on flush execution."""
+        every OLDER snapshot's flush AND this drain complete; producers
+        never wait on flush execution."""
         with self._lock:
+            drained_oldest = self._oldest_ts
             batch, chunks = self._drain_locked(limit=None)
             turn = self._take_turn()
-        self._flush_in_turn(turn, batch, chunks)
+        if self._flush_pool is None:
+            self._flush_in_turn(turn, batch, chunks, drained_oldest)
+            return
+        try:
+            self._flush_batch(batch, chunks, drained_oldest)
+        finally:
+            with self._turn_cv:
+                self._active_turns.discard(turn)
+                self._turn_cv.notify_all()
+                # Older concurrent flushes may still be in flight;
+                # everything enqueued before this call is either in
+                # our snapshot or in one of them.
+                while any(t < turn for t in self._active_turns):
+                    self._turn_cv.wait()
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop, flushing anything still queued."""
@@ -284,3 +508,5 @@ class IntervalBatcher(Generic[K, V]):
             self._cv.notify_all()
             self._space.notify_all()
         self._thread.join(timeout)
+        if self._flush_pool is not None:
+            self._flush_pool.shutdown(wait=True)

@@ -49,6 +49,7 @@ class MultiRegionManager:
             _combine,
             self._send_hits,
             name="guber-multiregion",
+            adaptive=getattr(conf, "adaptive_windows", True),
         )
 
     def queue_hits(self, r: RateLimitReq) -> None:

@@ -511,8 +511,14 @@ void dispatch_loop(Server* srv) {
     int64_t total = 0;
     {
       std::lock_guard<std::mutex> lock(srv->q_mu);
+      // Always admit the FIRST queued RPC even when it alone exceeds
+      // max_batch: leaving it at the queue head would never drain it,
+      // starving every later RPC and busy-spinning this thread
+      // (reachable whenever max_batch is configured below the
+      // 1000-item per-RPC cap).
       while (!srv->queue.empty() &&
-             total + srv->queue.front().items <= srv->max_batch) {
+             (batch.empty() ||
+              total + srv->queue.front().items <= srv->max_batch)) {
         total += srv->queue.front().items;
         srv->queued_items -= srv->queue.front().items;
         batch.push_back(std::move(srv->queue.front()));

@@ -169,6 +169,7 @@ class Daemon:
             data_center=conf.data_center,
             peer_credentials=creds,
             local_batch_wait=conf.local_batch_wait,
+            global_serve_window=conf.global_serve_window,
             sketch_window_ms=conf.sketch_window_ms,
             sketch_depth=conf.sketch_depth,
             sketch_width=conf.sketch_width,
@@ -187,7 +188,10 @@ class Daemon:
         # second loopback server exists only for grpc-gateway's dial,
         # which our native gateway doesn't need).
         self.grpc_server = grpc.server(
-            ThreadPoolExecutor(max_workers=32, thread_name_prefix="guber-grpc"),
+            ThreadPoolExecutor(
+                max_workers=max(1, conf.grpc_workers),
+                thread_name_prefix="guber-grpc",
+            ),
             interceptors=[grpc_stats],
             options=[
                 ("grpc.max_receive_message_length", 1024 * 1024),  # daemon.go:103
@@ -289,16 +293,23 @@ class Daemon:
             except Exception:  # noqa: BLE001 — sweeping must not die
                 log.exception("expiry sweep failed")
 
-    @staticmethod
-    def _warmup(engine) -> None:
+    def _warmup(self, engine) -> None:
         """Pay the kernel jit compiles before serving, not on the first
         client requests (an XLA compile can exceed the peer batch
         timeout).  The default ladder (64..1024) covers every width the
         wire can produce — MAX_BATCH_SIZE=1000 pads to 1024 — for BOTH
         serving programs (dataclass + columnar); engine-level callers
         that exceed it (bench harnesses) warm their own widths.
+        Group-commit windows MERGE wire batches, so with a window
+        enabled the ladder extends to the window's merge bound (4096)
+        — a mid-serving compile of an unseen merged width was a
+        measured multi-second p99 spike.
         tests/test_warmup.py pins zero compile-cache misses."""
-        engine.warmup()
+        conf = self.conf
+        if conf.global_serve_window > 0 or conf.local_batch_wait > 0:
+            engine.warmup(max_width=4096)
+        else:
+            engine.warmup()
 
     # ------------------------------------------------------------------
 
@@ -306,7 +317,23 @@ class Daemon:
         """reference: daemon.go:185-220 (discovery selection switch)."""
         kind = self.conf.peer_discovery_type
         if kind == "none":
-            self.set_peers([self.peer_info()])
+            if self.conf.static_peers:
+                # Fixed-topology cluster (GUBER_STATIC_PEERS): the full
+                # membership is configuration, not discovery.  set_peers
+                # marks whichever entry matches our advertise address
+                # as self.
+                self.set_peers(
+                    [
+                        PeerInfo(
+                            grpc_address=a,
+                            http_address="",
+                            datacenter=self.conf.data_center,
+                        )
+                        for a in self.conf.static_peers
+                    ]
+                )
+            else:
+                self.set_peers([self.peer_info()])
             return
         from gubernator_tpu.discovery import create_discovery
 
@@ -372,6 +399,23 @@ class Daemon:
                 last_err = e
                 time.sleep(0.05)
         raise TimeoutError(f"daemon at {addr} never became ready: {last_err}")
+
+    def stage_budget(self) -> dict:
+        """The measured GLOBAL-path p50 budget on this node: per-stage
+        {count, mean_ms, max_ms} for the five pipeline stages (client
+        window wait, engine serve, hit-window wait, owner RPC,
+        broadcast age).  The same numbers /metrics exports as
+        gubernator_stage_duration — this is the operator/bench entry
+        (scripts/stage_budget.py commits it as an artifact)."""
+        assert self.instance is not None
+        out = {}
+        for stage, stat in self.instance.stage_timers.items():
+            out[stage] = {
+                "count": stat.count,
+                "mean_ms": round(stat.mean() * 1e3, 3),
+                "max_ms": round(stat.max * 1e3, 3),
+            }
+        return out
 
     def close(self) -> None:
         """Graceful stop. reference: daemon.go:342-367 (Close)."""

@@ -105,9 +105,24 @@ class H2FastFront:
         status_ptr,
     ) -> int:
         try:
-            payload = ctypes.string_at(buf, length)
             n = int(total)
             nr = int(n_rpcs)
+            if n == 0:
+                # A zero-item window (e.g. one empty GetRateLimitsReq)
+                # is a valid request and answers empty-OK, like the
+                # reference's zero-request batches.  out_ptr (and
+                # possibly buf) back empty C vectors whose data() may
+                # be NULL — touching them through np.ctypeslib raises
+                # and would fail the window INTERNAL(13) (ADVICE r5).
+                if nr > 0 and status_ptr:
+                    np.ctypeslib.as_array(
+                        ctypes.cast(
+                            status_ptr, ctypes.POINTER(ctypes.c_int64)
+                        ),
+                        shape=(nr,),
+                    )[:] = 0
+                return 0
+            payload = ctypes.string_at(buf, length)
             cols = np.ctypeslib.as_array(
                 ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int64)),
                 shape=(4 * n,),

@@ -104,6 +104,26 @@ def load():
     return _lib
 
 
+def gather_key_slices(key_buf: np.ndarray, starts: np.ndarray,
+                      lens: np.ndarray):
+    """Gather variable-length key slices out of a (possibly shared)
+    byte buffer into a contiguous buffer: returns (sub_buf,
+    sub_offsets) with sub_offsets[0] == 0.  One vectorized pass — the
+    per-output-byte source index is repeat(starts - dest_starts, lens)
+    + arange(total).  Shared by the serving partition, the hits
+    fan-out and the broadcast encode (the same offset/gather math must
+    not fork)."""
+    n = len(starts)
+    off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=off[1:])
+    total = int(off[-1])
+    pos = (
+        np.repeat(starts - off[:-1], lens)
+        + np.arange(total, dtype=np.int64)
+    )
+    return key_buf[pos], off
+
+
 def encode_peer_reqs(
     key_buf: np.ndarray,
     key_offsets: np.ndarray,

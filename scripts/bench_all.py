@@ -13,6 +13,10 @@ in the repo root as BENCH_<round>_<name>.json where <round> comes from
 BENCH_ROUND (default "r04").
 
 Usage: python scripts/bench_all.py [name ...]   (default: all)
+       python scripts/bench_all.py fast_capture
+         — the under-3-minute combined tier (default+latency+herdfast
+           with shortened knobs) writing BENCH_<round>_fast_capture.json
+           with per-config capture durations (VERDICT r5 #1).
 """
 
 from __future__ import annotations
@@ -161,6 +165,50 @@ CONFIGS: dict[str, dict] = {
 }
 
 
+# fast_capture tier (VERDICT r5 next-round #1): ONE combined run
+# capturing the three claims that matter — throughput (default), the
+# latency SLO (latency), and the native front (herdfast) — in under
+# 3 minutes, so even a short backend serving window produces the
+# on-chip artifact before the full BENCH_ORDER sweep starts.  Each
+# sub-config runs with shortened measure knobs; the combined artifact
+# records the per-config capture duration so window use is auditable.
+FAST_CAPTURE = ["default", "latency", "herdfast"]
+FAST_CAPTURE_OVERRIDES = {
+    "default": {"BENCH_SECONDS": "4", "BENCH_LATENCY_BATCHES": "100"},
+    "latency": {"BENCH_LATENCY_BATCHES": "400", "BENCH_SECONDS": "2"},
+    "herdfast": {"BENCH_SECONDS": "4"},
+}
+
+
+def run_fast_capture() -> dict:
+    """Run the fast tier and write BENCH_<round>_fast_capture.json
+    (plus the individual per-config artifacts)."""
+    import time
+
+    t_all = time.monotonic()
+    combined: dict = {"tier": "fast_capture", "configs": {}}
+    for name in FAST_CAPTURE:
+        overrides = dict(CONFIGS[name])
+        overrides.update(FAST_CAPTURE_OVERRIDES.get(name, {}))
+        t0 = time.monotonic()
+        result = run(name, overrides)
+        result["capture_seconds"] = round(time.monotonic() - t0, 1)
+        combined["configs"][name] = result
+        # Each sub-result also lands as its own artifact so the
+        # per-config files exist even if the window closes mid-tier.
+        path = os.path.join(ROOT, f"BENCH_{ROUND}_{name}.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        print(json.dumps(result), flush=True)
+    combined["total_seconds"] = round(time.monotonic() - t_all, 1)
+    path = os.path.join(ROOT, f"BENCH_{ROUND}_fast_capture.json")
+    with open(path, "w") as f:
+        json.dump(combined, f, indent=1)
+        f.write("\n")
+    return combined
+
+
 def run(name: str, overrides: dict) -> dict:
     env = dict(os.environ)
     env.update(overrides)
@@ -209,6 +257,9 @@ def run(name: str, overrides: dict) -> dict:
 
 def main() -> int:
     names = sys.argv[1:] or list(CONFIGS)
+    if "fast_capture" in names:
+        names.remove("fast_capture")
+        run_fast_capture()
     for name in names:
         print(f"=== {name}: {CONFIGS[name]}", file=sys.stderr, flush=True)
         result = run(name, CONFIGS[name])

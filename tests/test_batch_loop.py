@@ -109,12 +109,44 @@ def test_backlog_age_tracks_oldest():
     def flush(batch, chunks):
         seen.set()
 
-    b = IntervalBatcher(10.0, 10_000, _combine, flush, chunked=True)
+    # adaptive=False: the gauge check needs the item to SIT in the
+    # queue for a measurable time (an adaptive window flushes an idle
+    # batcher immediately — pinned by test_adaptive_window.py).
+    b = IntervalBatcher(
+        10.0, 10_000, _combine, flush, chunked=True, adaptive=False
+    )
     try:
         assert b.backlog_age() == 0.0
         b.add_chunk(("c", 0), 1)
         time.sleep(0.05)
         age = b.backlog_age()
         assert 0.04 <= age < 5.0
+    finally:
+        b.close()
+
+
+def test_backlog_age_reanchors_after_drop_oldest_shed():
+    """ADVICE r5: drop_oldest shedding must re-anchor the age gauge to
+    the oldest SURVIVING chunk — after the old chunks are shed, the
+    gauge must stop reporting their (dropped) arrival time."""
+    release = threading.Event()
+
+    def flush(batch, chunks):
+        release.wait(10.0)
+
+    b = IntervalBatcher(
+        3600.0, 10_000, _combine, flush, chunked=True, adaptive=False,
+        drain_limit=1, max_pending=300, overflow="drop_oldest",
+    )
+    try:
+        b.add_chunk(("old", 0), 100)
+        time.sleep(0.3)  # age the chunk the gauge must NOT keep
+        # These sheds the "old" chunk (cap 300): survivors are fresh.
+        for i in range(3):
+            b.add_chunk(("new", i), 100)
+        assert b.dropped >= 100
+        age = b.backlog_age()
+        assert age < 0.25, f"gauge still reports the shed chunk: {age}"
+        release.set()
     finally:
         b.close()

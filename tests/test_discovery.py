@@ -119,3 +119,62 @@ def test_k8s_backend_gated():
     with pytest.raises((RuntimeError, ImportError)) as exc:
         create_discovery(conf, daemon=None)
     assert "k8s" in str(exc.value) or "kubernetes" in str(exc.value)
+
+
+def test_static_peers_membership():
+    """GUBER_STATIC_PEERS (discovery 'none'): the full membership is
+    configuration — both daemons see both peers, each marks exactly
+    itself as owner, and cross-node routing works."""
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    addrs = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+    daemons = []
+    try:
+        for a in addrs:
+            daemons.append(
+                spawn_daemon(
+                    DaemonConfig(
+                        grpc_listen_address=a,
+                        http_listen_address="127.0.0.1:0",
+                        behaviors=cluster_behaviors(),
+                        cache_size=1 << 12,
+                        peer_discovery_type="none",
+                        static_peers=list(addrs),
+                        device_count=1,
+                        sweep_interval=0.0,
+                    )
+                )
+            )
+        for d in daemons:
+            members = d.instance.get_peer_list()
+            assert len(members) == 2
+            owners = [p for p in members if p.info.is_owner]
+            assert [p.info.grpc_address for p in owners] == [d.grpc_address]
+        # Routing probe: some key maps to the OTHER node from node 0,
+        # and a client decision round-trips through the cluster.
+        d0 = daemons[0]
+        assert any(
+            not d0.instance.get_peer(f"{i}_sp").info.is_owner
+            for i in range(64)
+        )
+        with V1Client(d0.grpc_address) as c:
+            rs = c.get_rate_limits(
+                [
+                    RateLimitReq(
+                        name="sp", unique_key=f"{i}k", hits=1,
+                        limit=100, duration=60_000,
+                    )
+                    for i in range(20)
+                ]
+            )
+        assert all(r.error == "" and r.remaining == 99 for r in rs)
+    finally:
+        for d in daemons:
+            d.close()

@@ -26,9 +26,13 @@ def _greq(key, hits=1, limit=50):
 def test_global_over_admission_bounded_and_converges(frozen_clock):
     # Windows that never fire on their own: the test drives every sync
     # explicitly, so the lag (and thus over-admission) is exact.
+    # adaptive_windows=False — an adaptive window fires an idle
+    # batcher immediately, which would forward hits/broadcasts mid-
+    # phase and destroy the controlled lag this test measures.
     behaviors = BehaviorConfig(
         global_sync_wait=3600.0, global_batch_limit=10**9,
         batch_wait=cluster_behaviors().batch_wait,
+        adaptive_windows=False,
     )
     h = ClusterHarness().start(
         2, clock=frozen_clock, behaviors=behaviors, cache_size=4096

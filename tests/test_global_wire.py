@@ -131,8 +131,18 @@ def test_global_wire_path_equivalence_single_owner():
         assert len(resp.responses) == 40
         assert all(r.remaining == 99 for r in resp.responses)
         assert all(r.error == "" for r in resp.responses)
-        # Broadcast updates were queued columnar.
-        assert d.instance.global_mgr._updates.pending() >= 40
+        # Broadcast updates were queued columnar — the adaptive window
+        # may already have flushed them (idle batchers fire fast), in
+        # which case the broadcast counter moved instead.
+        import time as _time
+
+        gm = d.instance.global_mgr
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if gm._updates.pending() >= 40 or gm.broadcasts >= 1:
+                break
+            _time.sleep(0.005)
+        assert gm._updates.pending() >= 40 or gm.broadcasts >= 1
     finally:
         d.close()
 

@@ -226,3 +226,55 @@ def test_fast_front_ownership_gate():
             front.close()
     finally:
         h.stop()
+
+
+def test_fast_front_zero_item_request(daemon):
+    """A zero-item GetRateLimitsReq must answer empty-OK, not
+    INTERNAL(13): the C side passes a NULL out_ptr for an empty
+    window and the Python entry must not dereference it (ADVICE r5)."""
+    stub = V1Stub(dial(daemon.h2_fast_address))
+    got = stub.GetRateLimits(pb.GetRateLimitsReq(), timeout=10)
+    assert len(got.responses) == 0
+
+
+def test_fast_front_oversized_rpc_not_starved(daemon):
+    """dispatch_loop starvation (ADVICE r5, medium): an RPC with more
+    items than max_batch must still be admitted and served — before
+    the fix it sat at the queue head forever, busy-spinning the
+    dispatch thread and starving every later RPC."""
+    from gubernator_tpu.net.h2_fast import H2FastFront
+
+    front = H2FastFront(
+        daemon.instance, window_s=0.001, max_batch=4, flush_items=4
+    )
+    try:
+        stub = V1Stub(dial(front.address))
+        got = stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="big", unique_key=f"{i}k", hits=1,
+                        limit=100, duration=60_000,
+                    )
+                    for i in range(9)  # > max_batch
+                ]
+            ),
+            timeout=15,
+        )
+        assert len(got.responses) == 9
+        assert all(r.remaining == 99 for r in got.responses)
+        # And later, smaller RPCs are not starved behind it.
+        got = stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="big", unique_key="0k", hits=1, limit=100,
+                        duration=60_000,
+                    )
+                ]
+            ),
+            timeout=15,
+        )
+        assert got.responses[0].remaining == 98
+    finally:
+        front.close()

@@ -236,6 +236,11 @@ def test_wire_window_group_commit(frozen_clock):
     d = spawn_daemon(conf, clock=frozen_clock)
     try:
         n_threads = 8
+        # The window is load-ADAPTIVE: a cold window fires immediately
+        # (no grouping).  Prime its occupancy EWMA as if the herd had
+        # been running, so the first windows sleep the cap and the
+        # burst below deterministically shares them.
+        d.instance._wire_window._ewma_rpcs = float(n_threads)
         results = [None] * n_threads
         barrier = threading.Barrier(n_threads)
 

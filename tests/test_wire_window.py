@@ -73,7 +73,9 @@ def test_follower_waits_out_inflight_apply_no_double_count():
     NOT push the follower to the fallback path (that would apply the
     same hits twice); it waits and gets the windowed result."""
     eng = _Engine(stall=0.5)
-    ww = WireWindow(eng, wait=0.05, follower_grace=0.01)
+    # adaptive=False: the scenario needs a real leader sleep so the
+    # followers deterministically join the first window.
+    ww = WireWindow(eng, wait=0.05, follower_grace=0.01, adaptive=False)
     results = {}
 
     def caller(name):
@@ -98,7 +100,9 @@ def test_leader_exception_during_window_releases_leadership():
     pending entries (followers unblock with None) and release
     _leader_active so the next request can lead."""
     eng = _Engine()
-    ww = WireWindow(eng, wait=0.05, follower_grace=0.2)
+    # adaptive=False: the injected exception targets the leader's
+    # fixed-length sleep (secs == ww.wait below).
+    ww = WireWindow(eng, wait=0.05, follower_grace=0.2, adaptive=False)
     orig_sleep = time.sleep
     fired = [False]
 
