@@ -403,6 +403,7 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
         peer_discovery_type="none",
         device_count=1,
         sweep_interval=0.0,
+        ledger=_ledger_enabled(),
         h2_fast_address="127.0.0.1:0" if fast else "",
         h2_fast_window=float(
             os.environ.get("BENCH_LOCAL_BATCH_WAIT", "0.002")
@@ -438,6 +439,7 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
             rpcs, errors, lats, _frame, connected = res
             rate = rpcs * wire_batch / MEASURE_SECONDS
             return {
+                "ledger": _ledger_stats_inproc(daemon),
                 "metric": "rate-limit decisions/sec, single node, "
                 f"native h2 fast front (batch={wire_batch}, "
                 f"{connected} native clients, {wire_batch} hot keys)",
@@ -473,6 +475,7 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
             else "rate-limit decisions/sec, single node, loopback gRPC "
         )
         return {
+            "ledger": _ledger_stats_inproc(daemon),
             "metric": label
             + f"(batch={wire_batch}, {n_threads} client threads, {N_KEYS} hot keys)",
             "value": round(rate, 1),
@@ -718,6 +721,7 @@ def _run_herd(np, platform: str) -> dict:
         peer_discovery_type="none",
         device_count=1,
         sweep_interval=0.0,
+        ledger=_ledger_enabled(),
         # The herd is what the group-commit window exists for: the
         # concurrent single-item RPCs share one engine dispatch per
         # window (net/wire_window.py).  2ms groups ~arrival_rate×2ms
@@ -760,6 +764,7 @@ def _run_herd(np, platform: str) -> dict:
                     "native h2 fast front" if fast else "grpc listener"
                 )
                 return {
+                    "ledger": _ledger_stats_inproc(daemon),
                     "metric": "rate-limit decisions/sec, thundering herd "
                     f"({connected} concurrent native h2 clients via "
                     f"{front}, 1 hot key, single-item RPCs)",
@@ -816,6 +821,7 @@ def _run_herd(np, platform: str) -> dict:
         all_lat = _np.asarray([x for ml in lats if ml for x in ml])
         rate = sum(counts) / elapsed
         return {
+            "ledger": _ledger_stats_inproc(daemon),
             "metric": "rate-limit decisions/sec, thundering herd "
             f"({n_threads} concurrent clients, 1 hot key, single-item RPCs)",
             "value": round(rate, 1),
@@ -831,6 +837,86 @@ def _run_herd(np, platform: str) -> dict:
         }
     finally:
         daemon.close()
+
+
+
+def _ledger_enabled() -> bool:
+    """GUBER_LEDGER must govern the in-process daemons too (the
+    process-per-node modes read it via setup_daemon_config; these
+    build DaemonConfig directly)."""
+    return os.environ.get("GUBER_LEDGER", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+def _ledger_stats_inproc(daemon) -> Optional[dict]:
+    """Ledger counters + the dispatches-per-decision gauge from an
+    in-process daemon (wire/herd modes) — every artifact claiming a
+    ledger hit rate must carry the counters that back it."""
+    inst = daemon.instance
+    led = getattr(inst, "ledger", None)
+    if led is None:
+        return None
+    out = led.stats()
+    eng = inst.engine
+    decisions = eng.requests_total + out["answered"]
+    out["dispatches_per_decision"] = (
+        round(eng.rounds_total / decisions, 4) if decisions else 0.0
+    )
+    return out
+
+
+_LEDGER_SCRAPE_KEYS = (
+    "gubernator_ledger_answered",
+    "gubernator_ledger_fallthrough",
+    "gubernator_ledger_settles",
+    "gubernator_check_counter",
+    "gubernator_engine_rounds",
+)
+
+
+def _scrape_ledger_raw(http_addrs: list) -> dict:
+    """Cumulative ledger counters summed across the nodes' /metrics."""
+    import re
+    import urllib.request
+
+    out: dict = {}
+    pat = re.compile(
+        r"^(gubernator_ledger_answered|gubernator_ledger_fallthrough|"
+        r"gubernator_ledger_settles|gubernator_check_counter|"
+        r"gubernator_engine_rounds)(?:_total)?\s+([0-9.e+-]+)",
+        re.M,
+    )
+    for addr in http_addrs:
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+        except OSError:
+            continue
+        for name, val in pat.findall(text):
+            out[name] = out.get(name, 0.0) + float(val)
+    return out
+
+
+def _ledger_diff(before: dict, after: dict) -> dict:
+    """Measured-window ledger summary from cumulative scrapes."""
+    d = {
+        k: int(after.get(k, 0.0) - before.get(k, 0.0))
+        for k in set(before) | set(after)
+    }
+    answered = d.get("gubernator_ledger_answered", 0)
+    rounds = d.get("gubernator_engine_rounds", 0)
+    engine_rows = d.get("gubernator_check_counter", 0)
+    decisions = engine_rows + answered
+    return {
+        "answered": answered,
+        "fallthrough": d.get("gubernator_ledger_fallthrough", 0),
+        "settles": d.get("gubernator_ledger_settles", 0),
+        "dispatches_per_decision": (
+            round(rounds / decisions, 4) if decisions else 0.0
+        ),
+    }
 
 
 def _scrape_stage_raw(http_addrs: list) -> tuple:
@@ -979,12 +1065,14 @@ def _run_global_procs(np, platform: str, n_nodes: int, wire_batch: int) -> dict:
                 seconds=warm_seconds,
             )
         stage_before = _scrape_stage_raw(http_addrs)
+        ledger_before = _scrape_ledger_raw(http_addrs)
         rate, p50_ms, p99_ms = _drive_grpc_procs(
             np, grpc_addrs, n_procs, wire_batch, behavior=behavior
         )
         budget = _stage_budget_diff(
             stage_before, _scrape_stage_raw(http_addrs)
         )
+        ledger = _ledger_diff(ledger_before, _scrape_ledger_raw(http_addrs))
         return {
             "metric": f"rate-limit decisions/sec, GLOBAL, {n_nodes}-node "
             f"cluster, one daemon process per node (batch={wire_batch}, "
@@ -997,6 +1085,7 @@ def _run_global_procs(np, platform: str, n_nodes: int, wire_batch: int) -> dict:
             "platform": platform,
             "topology": "process-per-node",
             "stage_budget_ms": budget,
+            "ledger": ledger,
         }
     finally:
         for p in procs:

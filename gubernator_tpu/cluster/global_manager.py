@@ -737,6 +737,13 @@ class GlobalManager:
             )
         except Exception:  # noqa: BLE001 — e.g. invalid Gregorian
             return None
+        ledger = getattr(self.instance, "ledger", None)
+        if ledger is not None:
+            # Leases PRE-DEBIT their credit, so the device UNDER-reports
+            # the logical remaining by the held (unconsumed) budget;
+            # the broadcast must add it back or peers under-admit.
+            rem = np.asarray(rem).copy()
+            ledger.readonly_overlay(keys_b, rem)
         key_buf = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
         key_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(k) for k in keys_b], out=key_off[1:])
@@ -792,6 +799,12 @@ class GlobalManager:
                 # invalid Gregorian interval; the dataclass path turns
                 # that into a per-item error response instead.
                 return self._reread_dataclass(items)
+            ledger = getattr(self.instance, "ledger", None)
+            if ledger is not None:
+                rem = np.asarray(rem).copy()
+                ledger.readonly_overlay(
+                    [k.encode() for k in keys_str], rem
+                )
             status_of = {int(s): s for s in Status}
             return [
                 UpdatePeerGlobal(

@@ -101,6 +101,26 @@ class Config:
     sketch_window_ms: int = 1_000
     sketch_depth: int = 4
     sketch_width: int = 1 << 20
+    # Host-tier decision ledger (core/ledger.py; GUBER_LEDGER, default
+    # on): sticky over-limit answers + bounded credit leases serve
+    # hot-key decisions without a device dispatch.  GUBER_LEDGER=0
+    # restores the dispatch-per-decision path exactly.
+    ledger: bool = True
+    # Per-key lease credit budget — also the per-key over-admission
+    # bound when an external racer reads the device before the lease
+    # settles (GUBER_LEDGER_LEASE).
+    ledger_lease: int = 512
+    # Lease lifetime (seconds); expiry settles consumed credits back to
+    # the device off the critical path (GUBER_LEDGER_LEASE_TTL).
+    ledger_lease_ttl: float = 0.2
+    # Hits within a 1s window before a key is granted a lease
+    # (GUBER_LEDGER_HOT_THRESHOLD).
+    ledger_hot_threshold: int = 8
+    # Ledger entry LRU capacity (GUBER_LEDGER_KEYS).
+    ledger_keys: int = 65536
+    # Background settle flush period, seconds; 0 = manual/tests only
+    # (GUBER_LEDGER_SETTLE_INTERVAL).
+    ledger_settle_interval: float = 0.05
 
 
 def _env(d: Dict[str, str], key: str, default: str = "") -> str:
@@ -256,6 +276,14 @@ class DaemonConfig:
     sketch_depth: int = 4
     sketch_width: int = 1 << 20
 
+    # Host-tier decision ledger (see Config.ledger_*).
+    ledger: bool = True
+    ledger_lease: int = 512
+    ledger_lease_ttl: float = 0.2
+    ledger_hot_threshold: int = 8
+    ledger_keys: int = 65536
+    ledger_settle_interval: float = 0.05
+
     # TLS (None = plaintext); see gubernator_tpu.net.tls.
     tls: Optional["object"] = None
 
@@ -397,6 +425,17 @@ def setup_daemon_config(
         ),
         sketch_depth=_env_int(d, "GUBER_SKETCH_DEPTH", 4),
         sketch_width=_env_int(d, "GUBER_SKETCH_WIDTH", 1 << 20),
+        ledger=_env(d, "GUBER_LEDGER", "1").strip().lower()
+        not in ("0", "false", "no", "off"),
+        ledger_lease=_env_int(d, "GUBER_LEDGER_LEASE", 512),
+        ledger_lease_ttl=_env_float_seconds(
+            d, "GUBER_LEDGER_LEASE_TTL", 0.2
+        ),
+        ledger_hot_threshold=_env_int(d, "GUBER_LEDGER_HOT_THRESHOLD", 8),
+        ledger_keys=_env_int(d, "GUBER_LEDGER_KEYS", 65536),
+        ledger_settle_interval=_env_float_seconds(
+            d, "GUBER_LEDGER_SETTLE_INTERVAL", 0.05
+        ),
         tls=tls,
         device_count=device_count,
         sweep_interval=_env_float_seconds(d, "GUBER_SWEEP_INTERVAL", 30.0),

@@ -765,6 +765,7 @@ class DecisionEngine:
         burst: np.ndarray,  # int64 [n]
         now_ms: Optional[int] = None,
         want_async: bool = False,
+        count_decisions: bool = True,
     ):
         """Vectorized decision path; returns (status, limit, remaining,
         reset_time) int64/int32 numpy arrays in request order — or,
@@ -775,6 +776,12 @@ class DecisionEngine:
         Requires no Store attached (the write-through path needs
         per-item dataclasses) and handles DURATION_IS_GREGORIAN via a
         per-item fallback only for the flagged lanes.
+
+        `count_decisions=False` applies the batch without bumping the
+        decision counters — the decision ledger's settle reconciliation
+        (core/ledger.py) is device work but not client decisions, and
+        counting it would flatter the dispatches-per-decision gauge's
+        denominator.
         """
         if self.store is not None:
             raise RuntimeError(
@@ -802,8 +809,9 @@ class DecisionEngine:
                 keys, algo, behavior, hits, limit, duration, burst,
                 greg_dur, greg_exp, greg_mask, now_ms,
             )
-            self.requests_total += n
-            self.batches_total += 1
+            if count_decisions:
+                self.requests_total += n
+                self.batches_total += 1
         return pending if want_async else pending.get()
 
     def _apply_columnar_locked(

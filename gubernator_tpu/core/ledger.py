@@ -1,0 +1,963 @@
+"""Host-tier decision ledger: answer hot-key checks without a device dispatch.
+
+PERF.md §10b: after the adaptive windows collapsed the stacked waits,
+the remaining request-path term is `engine_serve` — every decision,
+even the 1500th hit on the same hot key in the same second, pays a
+device dispatch on a dispatch-bound backend.  Token-bucket algebra
+makes most of those dispatches unnecessary, EXACTLY:
+
+* **Sticky over-limit** — a token bucket whose stored status is
+  OVER_LIMIT with remaining==0 cannot change before its recorded
+  reset time passes, as long as every request carries the same
+  limit/duration and no precondition-breaking flags
+  (models/spec.py: the status write happens only in the
+  "remaining==0 and hits>0" branch and the expiry check is
+  `expire_at < now`).  The ledger answers those hits locally —
+  (OVER_LIMIT, limit, 0, reset) — with zero device work until the
+  reset passes.  This path is *exact*: the engine application of the
+  same request is a state no-op producing the identical response.
+
+* **Credit leases** — the ENGINE grants the lease: when a token key's
+  observed hit rate crosses the hot threshold, the serving tier
+  appends an *acquisition row* (hits = bounded credit) to its next
+  engine batch.  An UNDER_LIMIT response means the credit is now
+  debited on the device and held by the ledger; subsequent uniform
+  hits decrement it locally with the same closed-form algebra as
+  `ops.bucket_kernel._collapsed_values` (shared helper
+  `token_extras_host`), reporting remaining/reset as the sequential
+  engine would — until the bucket's reset no term of the token update
+  depends on wall time, so the local answers are exact.  Every
+  precondition-breaking request (RESET_REMAINING, Gregorian,
+  limit/duration change, negative hits, leaky buckets, over-asks,
+  exhaustion, TTL expiry) revokes the lease: the *unused* credit rides
+  back as a negative-hit *return row* prepended to the SAME engine
+  batch, so the engine computes on exactly the sequential state.
+  Because admitted hits were debited up front, racing consumers can
+  never be over-admitted by lease accounting; the only exposure is
+  bounded UNDER-admission — up to the outstanding (unconsumed) lease
+  budget per key is temporarily invisible to other paths until
+  returned, the mirror image of GLOBAL's bounded staleness
+  (architecture.md:46-74).  Idle leases settle back via a background
+  flusher off the critical path.
+
+Exactness contract: with all traffic flowing through ledger-aware
+fronts (the columnar wire paths, the h2 fast front, the GLOBAL serve
+route, and the dataclass paths via `invalidate_keys`), decisions are
+bit-equal to the sequential engine (fuzzed against models/spec.py in
+tests/test_ledger.py), and over-admission under lease races is bounded
+by the configured lease budget (asserted there too).  Non-owner GLOBAL
+broadcast entries are the read-only tier of this ledger: a broadcast
+(status, remaining, reset) row is exactly a ledger entry the owner has
+already reconciled (service._GlobalStatusCache holds them;
+`attach_readonly` links the two and `readonly_overlay` keeps broadcast
+re-reads consistent with credit held by live leases).
+
+Enable/disable with GUBER_LEDGER (default on); knobs:
+GUBER_LEDGER_LEASE (credit budget), GUBER_LEDGER_LEASE_TTL,
+GUBER_LEDGER_HOT_THRESHOLD (hits/1s window before a key leases),
+GUBER_LEDGER_KEYS (entry LRU capacity), GUBER_LEDGER_SETTLE_INTERVAL.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from gubernator_tpu.ops.bucket_kernel import token_extras_host
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+log = logging.getLogger("gubernator_tpu.ledger")
+
+_TOKEN = int(Algorithm.TOKEN_BUCKET)
+_OVER = int(Status.OVER_LIMIT)
+_UNDER = int(Status.UNDER_LIMIT)
+# Flags that break the ledger's preconditions outright.  GLOBAL /
+# NO_BATCHING / BATCHING do not change the bucket update itself.
+_BREAKERS = int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.RESET_REMAINING)
+
+# Entry kinds.
+_K_COUNTER = 0
+_K_OVER = 1
+_K_LEASE = 2
+
+# Settle/return record: (key, hits, limit, duration, fnv1a, t_mono,
+# reset).  hits < 0 returns unused lease credit; the `reset` bound
+# drops records whose bucket window already ended (a return landing on
+# a FRESH bucket would overfill it).
+_ACQ_INFLIGHT_TIMEOUT_S = 2.0
+
+
+class _Entry:
+    """One tracked key: hit-rate counter, sticky-OVER record, or lease."""
+
+    __slots__ = (
+        "key", "kind", "count", "win_start", "want",
+        "limit", "duration", "reset", "rem", "credit", "consumed",
+        "expiry", "gen", "rem_hint", "acq_inflight",
+    )
+
+    def __init__(self, key: bytes, now_ms: int):
+        self.key = key
+        self.kind = _K_COUNTER
+        self.count = 0
+        self.win_start = now_ms
+        self.want = False
+        self.limit = 0
+        self.duration = 0
+        self.reset = 0
+        # Lease state: `rem` is the LOGICAL remaining at grant time
+        # (device remaining + held credit); answers report
+        # rem - consumed, exactly what the sequential engine would.
+        self.rem = 0
+        self.credit = 0
+        self.consumed = 0
+        self.expiry = 0
+        # Apply generation: bumped whenever a plan sends this key's
+        # rows to the engine.  Sticky-OVER inserts and rem_hint updates
+        # require gen equality between plan and learn — a racing row
+        # would otherwise install stale observations.
+        self.gen = 0
+        # Last engine-confirmed remaining (acquisition sizing); -1 =
+        # unknown.
+        self.rem_hint = -1
+        # time.monotonic() of an acquisition row in flight (0 = none):
+        # prevents concurrent plans from double-debiting the key.
+        self.acq_inflight = 0.0
+
+
+class _Lane:
+    """Engine-lane columns (settle/return rows + fall-through rows +
+    acquisition rows) shaped like a DecodedBatch so the group-commit
+    windows and apply_columnar can consume it unchanged."""
+
+    __slots__ = (
+        "n", "key_buf", "key_offsets", "algo", "behavior", "hits",
+        "limit", "duration", "burst", "fnv1a",
+    )
+
+
+def concat_lanes(a, b) -> _Lane:
+    """Concatenate two DecodedBatch/_Lane column sets (a first)."""
+    out = _Lane()
+    out.n = a.n + b.n
+    out.key_buf = np.concatenate([a.key_buf, b.key_buf])
+    off = np.concatenate(
+        [a.key_offsets, b.key_offsets[1:] + a.key_offsets[-1]]
+    )
+    out.key_offsets = off
+    for f in ("algo", "behavior", "hits", "limit", "duration", "burst",
+              "fnv1a"):
+        setattr(out, f, np.concatenate([getattr(a, f), getattr(b, f)]))
+    return out
+
+
+def _rows_lane(rows: List[tuple]) -> Optional[_Lane]:
+    """Build a lane from settle/return/acquisition records
+    [(key, hits, limit, duration, fnv1a, ...)]."""
+    if not rows:
+        return None
+    keys = [r[0] for r in rows]
+    m = len(keys)
+    lane = _Lane()
+    lane.n = m
+    lane.key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8).copy()
+    off = np.zeros(m + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=off[1:])
+    lane.key_offsets = off
+    lane.algo = np.zeros(m, dtype=np.int32)
+    lane.behavior = np.zeros(m, dtype=np.int32)
+    lane.hits = np.asarray([r[1] for r in rows], dtype=np.int64)
+    lane.limit = np.asarray([r[2] for r in rows], dtype=np.int64)
+    lane.duration = np.asarray([r[3] for r in rows], dtype=np.int64)
+    lane.burst = np.zeros(m, dtype=np.int64)
+    lane.fnv1a = np.asarray([r[4] for r in rows], dtype=np.uint64)
+    return lane
+
+
+class LedgerPlan:
+    """One batch's partition: locally-answered rows, return/settle rows
+    to prepend, the fall-through rows the engine must still decide, and
+    lease-acquisition rows to append.
+
+    Lifecycle: `plan()` → (caller dispatches the engine lane) →
+    `learn()` with the lane outputs in [settles..., fall...,
+    acquires...] order — or `rollback()` if the dispatch path failed
+    and the caller re-serves through another path.
+    """
+
+    __slots__ = (
+        "ledger", "dec", "now_ms", "idx", "n_considered",
+        "answered_rows", "ans_st", "ans_rem", "ans_rst",
+        "fall", "fall_elig", "settles", "acquires", "gens",
+        "_batch_hits", "_acq_candidates", "_consumed_log", "_done",
+    )
+
+    def __init__(self, ledger, dec, now_ms, idx):
+        self.ledger = ledger
+        self.dec = dec
+        self.now_ms = now_ms
+        self.idx = idx
+        self.answered_rows: List[int] = []
+        self.ans_st: List[int] = []
+        self.ans_rem: List[int] = []
+        self.ans_rst: List[int] = []
+        self.fall: List[int] = []
+        self.fall_elig: List[bool] = []
+        # Return/settle records (see module constant note).
+        self.settles: List[tuple] = []
+        # Acquisition records (key, hits>0, limit, duration, fnv1a).
+        self.acquires: List[tuple] = []
+        # hash → entry generation at THIS plan's last touch.
+        self.gens: Dict[int, int] = {}
+        # hash → engine-bound hits this batch (acquisition sizing).
+        self._batch_hits: Dict[int, int] = {}
+        self._acq_candidates: List[int] = []
+        self._consumed_log: List[tuple] = []  # (hash, delta)
+        self._done = False
+
+    # -- shape ---------------------------------------------------------
+
+    @property
+    def full(self) -> bool:
+        return not self.fall and not self.settles and not self.acquires
+
+    @property
+    def n_settles(self) -> int:
+        return len(self.settles)
+
+    @property
+    def n_acquires(self) -> int:
+        return len(self.acquires)
+
+    @property
+    def answered_idx(self) -> np.ndarray:
+        return np.asarray(self.answered_rows, dtype=np.int64)
+
+    @property
+    def fall_idx(self) -> np.ndarray:
+        return np.asarray(self.fall, dtype=np.int64)
+
+    def answered_cols(self):
+        """(status, remaining, reset) aligned to answered_idx; limit is
+        the request limit (the engine echoes it too)."""
+        return (
+            np.asarray(self.ans_st, dtype=np.int32),
+            np.asarray(self.ans_rem, dtype=np.int64),
+            np.asarray(self.ans_rst, dtype=np.int64),
+        )
+
+    def dense_cols(self):
+        """Full-length (status, limit, remaining, reset) in row order —
+        only valid when `full` (every considered row answered)."""
+        dec = self.dec
+        n = dec.n
+        st = np.zeros(n, dtype=np.int32)
+        lim = np.asarray(dec.limit, dtype=np.int64).copy()
+        rem = np.zeros(n, dtype=np.int64)
+        rst = np.zeros(n, dtype=np.int64)
+        rows = self.answered_idx
+        a_st, a_rem, a_rst = self.answered_cols()
+        st[rows] = a_st
+        rem[rows] = a_rem
+        rst[rows] = a_rst
+        return st, lim, rem, rst
+
+    # -- engine lane ---------------------------------------------------
+
+    def settle_lane(self) -> Optional[_Lane]:
+        return _rows_lane(self.settles)
+
+    def acq_lane(self) -> Optional[_Lane]:
+        return _rows_lane(self.acquires)
+
+    def build_engine_lane(self):
+        """Columns the engine must run: settle/return rows first, then
+        the fall-through rows, then acquisition rows.  Returns the
+        original dec unchanged when the plan changed nothing."""
+        dec = self.dec
+        if (
+            not self.settles
+            and not self.acquires
+            and self.idx is None
+            and len(self.fall) == self.n_considered == dec.n
+        ):
+            return dec
+        from gubernator_tpu.net.wire_codec import gather_key_slices
+
+        fall = self.fall_idx
+        lane = _Lane()
+        lane.n = len(fall)
+        offs = dec.key_offsets
+        lens = offs[1:] - offs[:-1]
+        lane.key_buf, lane.key_offsets = gather_key_slices(
+            dec.key_buf, offs[:-1][fall], lens[fall]
+        )
+        for f in ("algo", "behavior", "hits", "limit", "duration",
+                  "burst", "fnv1a"):
+            setattr(
+                lane, f, np.ascontiguousarray(np.asarray(getattr(dec, f))[fall])
+            )
+        s = self.settle_lane()
+        if s is not None:
+            lane = concat_lanes(s, lane)
+        a = self.acq_lane()
+        if a is not None:
+            lane = concat_lanes(lane, a)
+        return lane
+
+    def merge_outputs(self, st, rem, rst):
+        """Scatter the engine-lane outputs (in [settles..., fall...,
+        acquires...] order) and the locally-answered rows into dense
+        full-length (status, limit, remaining, reset) columns in row
+        order — the one reassembly shared by every ledger-aware front
+        (the slicing/learn-order contract must not fork per caller).
+        Limit is the request limit (the engine echoes it too)."""
+        dec = self.dec
+        n = dec.n
+        ns = self.n_settles
+        nf = len(self.fall)
+        status = np.zeros(n, dtype=np.int64)
+        limit = np.asarray(dec.limit, dtype=np.int64).copy()
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        fall = self.fall_idx
+        status[fall] = np.asarray(st)[ns:ns + nf]
+        remaining[fall] = np.asarray(rem)[ns:ns + nf]
+        reset[fall] = np.asarray(rst)[ns:ns + nf]
+        aidx = self.answered_idx
+        if len(aidx):
+            a_st, a_rem, a_rst = self.answered_cols()
+            status[aidx] = a_st
+            remaining[aidx] = a_rem
+            reset[aidx] = a_rst
+        return status, limit, remaining, reset
+
+    # -- post-dispatch -------------------------------------------------
+
+    def learn(self, st, lim, rem, rst) -> None:
+        """Absorb the engine outputs for the WHOLE engine lane, in
+        [settles..., fall (fall_idx order)..., acquires...] order:
+        return/settle accounting, rem_hint refreshes, sticky-OVER
+        inserts, and lease grants from acquisition responses."""
+        if self._done:
+            return
+        self._done = True
+        self.ledger._learn(self, st, lim, rem, rst)
+
+    def rollback(self) -> None:
+        """Undo this plan's ledger mutations — the caller's dispatch
+        path failed and the whole RPC will be re-served elsewhere (the
+        pb fallback), so locally-consumed credits must be restored,
+        revoked returns re-queued for the async flusher, and in-flight
+        acquisition marks cleared (the debit never happened)."""
+        if self._done:
+            return
+        self._done = True
+        led = self.ledger
+        with led._lock:
+            for h, delta in self._consumed_log:
+                e = led._items.get(h)
+                if e is not None and e.kind == _K_LEASE:
+                    e.consumed -= delta
+            for s in self.settles:
+                led._pending[s[4]] = s
+            for a in self.acquires:
+                e = led._items.get(a[4])
+                if e is not None:
+                    e.acq_inflight = 0.0
+            led.answered -= len(self.answered_rows)
+            led.fallthrough -= len(self.fall)
+
+
+class DecisionLedger:
+    """Host-side decision ledger over one engine (see module docstring)."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        lease_size: int = 512,
+        lease_ttl: float = 0.2,
+        hot_threshold: int = 8,
+        hot_window: float = 1.0,
+        max_keys: int = 65536,
+        settle_interval: float = 0.05,
+    ):
+        self.engine = engine
+        self.lease_size = max(1, lease_size)
+        self.lease_ttl_ms = max(1, int(lease_ttl * 1000))
+        self.hot_threshold = max(1, hot_threshold)
+        self.hot_window_ms = max(1, int(hot_window * 1000))
+        self.max_keys = max_keys
+        # Feature-detect the count_decisions kwarg ONCE: a try/except
+        # TypeError around the apply itself could double-apply return
+        # rows if a TypeError surfaced after the state mutation.
+        import inspect
+
+        try:
+            self._count_kw = "count_decisions" in inspect.signature(
+                engine.apply_columnar
+            ).parameters
+        except (TypeError, ValueError):  # builtins / odd callables
+            self._count_kw = False
+        self._items: "OrderedDict[int, _Entry]" = OrderedDict()
+        # OVER/LEASE entries indexed by key bytes — the dataclass-path
+        # invalidation hook must be O(1) per key with zero hashing.
+        self._key_index: Dict[bytes, int] = {}
+        # Revoked-but-unapplied returns keyed by fnv1a: a plan for the
+        # same key pulls its return into the synchronous batch; the
+        # flusher drains the rest.
+        self._pending: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        # Counters (exported via utils.metrics + bench artifacts).
+        self.answered = 0
+        self.fallthrough = 0
+        self.leases_granted = 0
+        self.leases_revoked = 0
+        self.settles = 0
+        self.over_entries = 0
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        self.settle_lag = DurationStat()
+        self._readonly = None  # optional _GlobalStatusCache (stats only)
+        self._stop = threading.Event()
+        self._flusher = None
+        if settle_interval > 0:
+            self._flusher = threading.Thread(
+                target=self._flush_loop,
+                args=(settle_interval,),
+                name="guber-ledger-settle",
+                daemon=True,
+            )
+            self._flusher.start()
+
+    # ------------------------------------------------------------------
+
+    def attach_readonly(self, cache) -> None:
+        """Link the owner-broadcast status cache as the ledger's
+        read-only tier (non-owner GLOBAL entries) — unified stats."""
+        self._readonly = cache
+
+    def plan(self, dec, now_ms: int, idx=None) -> LedgerPlan:
+        """Partition one decoded batch: which rows the ledger answers,
+        which return rows must precede the engine lane, which rows fall
+        through, which acquisition rows to append.  `idx` restricts
+        consideration to those rows (the GLOBAL route plans owned rows
+        only)."""
+        plan = LedgerPlan(self, dec, now_ms, idx)
+        # Column materialization happens OUTSIDE the lock and only for
+        # the considered rows: the GLOBAL route plans a small owned
+        # subset of a 1000-item batch, and six O(n) conversions under
+        # the global ledger lock would serialize serving threads behind
+        # full-batch work.  The lists below are indexed by POSITION in
+        # `rows`; `fall`/`answered` record absolute row numbers.
+        if idx is None:
+            rows = list(range(dec.n))
+            sub = lambda a: np.asarray(a).tolist()  # noqa: E731
+        else:
+            rows = idx.tolist()
+            sub = lambda a: np.asarray(a)[idx].tolist()  # noqa: E731
+        plan.n_considered = len(rows)
+        hh = sub(dec.fnv1a)
+        algo_l = sub(dec.algo)
+        beh_l = sub(dec.behavior)
+        hits_l = sub(dec.hits)
+        lim_l = sub(dec.limit)
+        dur_l = sub(dec.duration)
+        raw = None
+        offs = None
+        now = now_ms
+        answered_rows = plan.answered_rows
+        ans_st, ans_rem, ans_rst = plan.ans_st, plan.ans_rem, plan.ans_rst
+        with self._lock:
+            items = self._items
+            for k, row in enumerate(rows):
+                h = hh[k]
+                elig = (
+                    algo_l[k] == _TOKEN
+                    and (beh_l[k] & _BREAKERS) == 0
+                    and hits_l[k] >= 0
+                    and lim_l[k] > 0
+                )
+                e = items.get(h)
+                if e is None:
+                    if elig:
+                        if raw is None:
+                            raw = dec.key_buf.tobytes()
+                            offs = np.asarray(dec.key_offsets).tolist()
+                        e = _Entry(raw[offs[row]:offs[row + 1]], now)
+                        items[h] = e
+                        if len(items) > self.max_keys:
+                            self._evict_locked()
+                        self._bump_locked(e, now)
+                    self._fall_locked(plan, row, elig, h, e, hits_l[k], now, lim_l[k], dur_l[k])
+                    continue
+                items.move_to_end(h)
+                if e.kind == _K_COUNTER:
+                    if elig:
+                        self._bump_locked(e, now)
+                    self._fall_locked(plan, row, elig, h, e, hits_l[k], now, lim_l[k], dur_l[k])
+                    continue
+                # OVER / LEASE: verify the key (hash collisions must
+                # never serve another key's state).
+                if raw is None:
+                    raw = dec.key_buf.tobytes()
+                    offs = np.asarray(dec.key_offsets).tolist()
+                key = raw[offs[row]:offs[row + 1]]
+                if key != e.key:
+                    self._fall_locked(plan, row, elig, h, None, 0, now)
+                    continue
+                lapsed = now > e.reset
+                mismatch = (
+                    not elig
+                    or lim_l[k] != e.limit
+                    or dur_l[k] != e.duration
+                )
+                if e.kind == _K_OVER:
+                    if lapsed or mismatch:
+                        # Reset passed (bucket dead) or the config
+                        # changed (the recorded reset no longer binds):
+                        # demote and let the engine decide.
+                        self._demote_locked(e, h)
+                        if elig:
+                            self._bump_locked(e, now)
+                        self._fall_locked(
+                            plan, row, elig, h, e, hits_l[k], now,
+                            lim_l[k], dur_l[k],
+                        )
+                        continue
+                    self._bump_locked(e, now)
+                    answered_rows.append(row)
+                    ans_st.append(_OVER)
+                    ans_rem.append(0)
+                    ans_rst.append(e.reset)
+                    self.answered += 1
+                    continue
+                # LEASE
+                if lapsed:
+                    # The bucket window itself ended: the held credit
+                    # died with it — returning it would overfill the
+                    # NEXT window.
+                    self._demote_locked(e, h)
+                    self.leases_revoked += 1
+                    if elig:
+                        self._bump_locked(e, now)
+                    self._fall_locked(plan, row, elig, h, e, hits_l[k], now, lim_l[k], dur_l[k])
+                    continue
+                if mismatch or now > e.expiry:
+                    self._revoke_locked(plan, e, h, now)
+                    if elig:
+                        self._bump_locked(e, now)
+                    self._fall_locked(plan, row, elig, h, e, hits_l[k], now, lim_l[k], dur_l[k])
+                    continue
+                hi = hits_l[k]
+                self._bump_locked(e, now)
+                if hi == 0:
+                    answered_rows.append(row)
+                    ans_st.append(_UNDER)
+                    ans_rem.append(e.rem - e.consumed)
+                    ans_rst.append(e.reset)
+                    self.answered += 1
+                    continue
+                # Drain: same closed form as the collapsed kernel's
+                # extras (admitted = clip(avail // h, 0, 1) for one
+                # occurrence) applied to the lease's pre-debited credit.
+                avail = e.credit - e.consumed
+                admitted, _, _ = token_extras_host(avail, hi, 1)
+                if admitted:
+                    e.consumed += hi
+                    plan._consumed_log.append((h, hi))
+                    answered_rows.append(row)
+                    ans_st.append(_UNDER)
+                    ans_rem.append(e.rem - e.consumed)
+                    ans_rst.append(e.reset)
+                    self.answered += 1
+                else:
+                    # Exhausted (or an over-ask): return what we still
+                    # hold and let the engine make this call.
+                    self._revoke_locked(plan, e, h, now)
+                    self._fall_locked(plan, row, elig, h, e, hits_l[k], now, lim_l[k], dur_l[k])
+            # Acquisition pass: hot counter keys with a known remaining
+            # hint request a lease by appending a credit-debit row.
+            t_mono = time.monotonic()
+            for h in plan._acq_candidates:
+                e = items.get(h)
+                if (
+                    e is None
+                    or e.kind != _K_COUNTER
+                    or not e.want
+                    or e.rem_hint < 1
+                    or h in self._pending
+                ):
+                    continue
+                if (
+                    e.acq_inflight
+                    and t_mono - e.acq_inflight < _ACQ_INFLIGHT_TIMEOUT_S
+                ):
+                    continue
+                # Size the debit to what remains AFTER this batch's own
+                # engine rows — in a serialized history the acquisition
+                # then never over-asks, so it cannot perturb state (the
+                # engine rejects over-asks without consuming anyway).
+                avail = e.rem_hint - plan._batch_hits.get(h, 0)
+                acq = min(self.lease_size, avail)
+                if acq < 1:
+                    continue
+                e.acq_inflight = t_mono
+                plan.acquires.append(
+                    (e.key, acq, e.limit, e.duration, h)
+                )
+        return plan
+
+    # -- locked helpers ------------------------------------------------
+
+    def _fall_locked(self, plan, row, elig, h, e, hi, now, lim=0, dur=0) -> None:
+        plan.fall.append(row)
+        plan.fall_elig.append(elig)
+        self.fallthrough += 1
+        if e is not None:
+            e.gen += 1
+            plan.gens[h] = e.gen
+            if elig:
+                if e.kind == _K_COUNTER:
+                    if e.limit != lim or e.duration != dur:
+                        # Config change invalidates the remaining hint
+                        # (a limit delta folds into remaining) — defer
+                        # acquisitions until a fresh engine response.
+                        e.rem_hint = -1
+                    e.limit = lim
+                    e.duration = dur
+                plan._batch_hits[h] = plan._batch_hits.get(h, 0) + hi
+                if e.want and e.kind == _K_COUNTER:
+                    plan._acq_candidates.append(h)
+            else:
+                # A precondition-breaking row reaches the engine: the
+                # post-row remaining is unknowable here.
+                e.rem_hint = -1
+        # Pull this key's pending return (if any) into the synchronous
+        # batch so the engine sees the reconciled state for this
+        # request; drop it if its bucket window already ended.
+        s = self._pending.pop(h, None)
+        if s is not None and now <= s[6]:
+            plan.settles.append(s)
+
+    def _bump_locked(self, e: _Entry, now: int) -> None:
+        if now - e.win_start > self.hot_window_ms:
+            # Cooled: the hot flag decays with the window, or a
+            # once-hot key would churn acquire/expire/return cycles
+            # forever on trickle traffic.
+            e.count = 0
+            e.win_start = now
+            e.want = False
+        e.count += 1
+        if e.count >= self.hot_threshold:
+            e.want = True
+
+    def _demote_locked(self, e: _Entry, h: int) -> None:
+        self._key_index.pop(e.key, None)
+        e.kind = _K_COUNTER
+
+    def _revoke_locked(self, plan, e: _Entry, h: int, now: int) -> None:
+        """Revoke a live lease: consumed credit is already on the
+        device; the UNUSED remainder rides back as a negative-hit
+        return row in this plan's engine lane."""
+        unused = e.credit - e.consumed
+        if unused > 0:
+            plan.settles.append(
+                (e.key, -unused, e.limit, e.duration, h,
+                 time.monotonic(), e.reset)
+            )
+        # The next acquisition sizes off the post-revoke remaining.
+        e.rem_hint = e.rem - e.consumed
+        self.leases_revoked += 1
+        self._demote_locked(e, h)
+
+    def _evict_locked(self) -> None:
+        h, e = self._items.popitem(last=False)
+        if e.kind == _K_LEASE:
+            unused = e.credit - e.consumed
+            if unused > 0:
+                # The held credit must flow back to the device.
+                self._pending[h] = (
+                    e.key, -unused, e.limit, e.duration, h,
+                    time.monotonic(), e.reset,
+                )
+            self.leases_revoked += 1
+        self._key_index.pop(e.key, None)
+
+    # -- learn (post-dispatch) -----------------------------------------
+
+    def _learn(self, plan: LedgerPlan, st, lim, rem, rst) -> None:
+        ns = plan.n_settles
+        nf = len(plan.fall)
+        st_l = np.asarray(st).tolist()
+        rem_l = np.asarray(rem).tolist()
+        rst_l = np.asarray(rst).tolist()
+        with self._lock:
+            # Returns (negative hits) always land — the engine's
+            # consume branch adds them back unconditionally.
+            for s in plan.settles:
+                self.settles += 1
+                self.settle_lag.observe(time.monotonic() - s[5])
+            items = self._items
+            dec = plan.dec
+            hh = np.asarray(dec.fnv1a)
+            lim_a = np.asarray(dec.limit)
+            dur_a = np.asarray(dec.duration)
+            raw = None
+            offs = None
+            now = plan.now_ms
+            written: set = set()
+            for j, row in enumerate(plan.fall):
+                h = int(hh[row])
+                e = items.get(h)
+                if e is None:
+                    continue
+                if e.kind != _K_COUNTER and h not in written:
+                    # A racing plan already promoted this key; its view
+                    # is at least as fresh — keep it.  (Keys THIS learn
+                    # wrote are overwritten by later rows of the same
+                    # batch: the last row's response is the stored
+                    # state.)
+                    continue
+                if raw is None:
+                    raw = dec.key_buf.tobytes()
+                    offs = np.asarray(dec.key_offsets).tolist()
+                key = raw[offs[row]:offs[row + 1]]
+                if key != e.key:
+                    continue
+                fresh = plan.gens.get(h) == e.gen
+                if not plan.fall_elig[j]:
+                    # A precondition-breaking row (leaky, reset,
+                    # negative hits) ran on the engine AFTER anything
+                    # this learn recorded: the recorded state is stale.
+                    self._demote_locked(e, h)
+                    e.rem_hint = -1
+                    written.add(h)
+                    continue
+                s_i = st_l[ns + j]
+                r_i = rem_l[ns + j]
+                written.add(h)
+                if fresh:
+                    # Engine-confirmed remaining for acquisition
+                    # sizing.  ONLY an UNDER response may arm it: an
+                    # OVER response with remaining>0 means the stored
+                    # status is sticky OVER (limit raised on an
+                    # over-limit bucket), where an acquisition row
+                    # would CONSUME its hits while reporting OVER —
+                    # learn would read that as "not debited" and the
+                    # credit would be silently lost.
+                    e.rem_hint = r_i if s_i == _UNDER else -1
+                if s_i == _OVER and r_i == 0:
+                    if not fresh:
+                        # A plan raced in after us (possibly a config
+                        # change): our OVER observation may describe a
+                        # replaced bucket — insert nothing.
+                        continue
+                    # Stored status is OVER with remaining 0 (see the
+                    # module docstring's case analysis): exact until
+                    # the reset passes.
+                    if e.kind != _K_OVER:
+                        self.over_entries += 1
+                    e.kind = _K_OVER
+                    e.limit = int(lim_a[row])
+                    e.duration = int(dur_a[row])
+                    e.reset = rst_l[ns + j]
+                    self._key_index[key] = h
+                elif e.kind != _K_COUNTER:
+                    # The last row's response fits no fast path (e.g.
+                    # OVER with remaining>0 after a limit raise):
+                    # whatever this learn wrote earlier is stale.
+                    self._demote_locked(e, h)
+            # Acquisition responses: UNDER means the credit is debited
+            # on the device and the lease is live.
+            for i, a in enumerate(plan.acquires):
+                j = ns + nf + i
+                h = a[4]
+                e = items.get(h)
+                debited = st_l[j] == _UNDER
+                if e is None or e.key != a[0] or e.kind != _K_COUNTER:
+                    # Entry evicted or re-promoted by a racer: nobody
+                    # holds this credit — send it straight back.
+                    if debited:
+                        self._pending.setdefault(
+                            h,
+                            (a[0], -a[1], a[2], a[3], h,
+                             time.monotonic(), rst_l[j]),
+                        )
+                    continue
+                e.acq_inflight = 0.0
+                if not debited:
+                    # Rejected (raced below the ask) — or, in the
+                    # sticky-OVER corner, consumed-while-reporting-OVER
+                    # (ambiguous from the response alone): disarm
+                    # acquisitions until a fresh UNDER fall-row
+                    # response proves the stored status is UNDER.
+                    e.rem_hint = -1
+                    continue
+                e.kind = _K_LEASE
+                e.limit = a[2]
+                e.duration = a[3]
+                e.reset = rst_l[j]
+                e.rem = rem_l[j] + a[1]  # logical remaining at grant
+                e.credit = a[1]
+                e.consumed = 0
+                e.expiry = now + self.lease_ttl_ms
+                e.rem_hint = rem_l[j]
+                self._key_index[e.key] = h
+                self.leases_granted += 1
+
+    # -- dataclass-path coherence --------------------------------------
+
+    def invalidate_keys(self, keys: List[bytes]) -> None:
+        """A batch is about to run on the engine OUTSIDE the ledger
+        (the dataclass paths): revoke/drop any entry for these keys and
+        apply their returns synchronously so the engine computes on the
+        reconciled state.  O(1) dict probes per key — keys without
+        entries (the overwhelming case) cost one failed lookup."""
+        returns: List[tuple] = []
+        now = self.engine.clock.now_ms()
+        with self._lock:
+            for k in keys:
+                h = self._key_index.get(k)
+                if h is None:
+                    continue
+                e = self._items.get(h)
+                if e is None or e.key != k:
+                    continue
+                if e.kind == _K_LEASE:
+                    unused = e.credit - e.consumed
+                    if unused > 0 and now <= e.reset:
+                        returns.append(
+                            (e.key, -unused, e.limit, e.duration, h,
+                             time.monotonic(), e.reset)
+                        )
+                    self.leases_revoked += 1
+                self._demote_locked(e, h)
+                e.gen += 1  # the engine is about to run this key
+                e.rem_hint = -1
+                s = self._pending.pop(h, None)
+                if s is not None and now <= s[6]:
+                    returns.append(s)
+        if returns:
+            self._apply_settles(returns)
+
+    def readonly_overlay(self, keys: List[bytes], rem: np.ndarray) -> None:
+        """Overlay held lease credit onto a re-read's remaining column:
+        the device under-reports the logical remaining by the credit a
+        live lease still holds (the GLOBAL broadcast must carry the
+        logical value or peers under-admit by the outstanding
+        budget)."""
+        with self._lock:
+            for i, k in enumerate(keys):
+                h = self._key_index.get(k)
+                if h is None:
+                    continue
+                e = self._items.get(h)
+                if e is not None and e.kind == _K_LEASE and e.key == k:
+                    rem[i] = int(rem[i]) + (e.credit - e.consumed)
+
+    # -- background settle ---------------------------------------------
+
+    def _flush_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.flush_settles()
+            except Exception:  # noqa: BLE001 — settling must not die
+                log.exception("ledger settle flush failed")
+
+    def flush_settles(self) -> int:
+        """Return the unused credit of expired/idle leases and drain
+        the pending queue via one batched engine apply off the serving
+        path; returns rows applied."""
+        now = self.engine.clock.now_ms()
+        returns: List[tuple] = []
+        with self._lock:
+            for h in [
+                h for h, e in self._items.items() if e.kind == _K_LEASE
+            ]:
+                e = self._items[h]
+                if now > e.reset:
+                    # Window over: the held credit died with it.
+                    self._demote_locked(e, h)
+                    self.leases_revoked += 1
+                elif now > e.expiry:
+                    unused = e.credit - e.consumed
+                    if unused > 0:
+                        returns.append(
+                            (e.key, -unused, e.limit, e.duration, h,
+                             time.monotonic(), e.reset)
+                        )
+                        e.gen += 1  # return apply races stale learns
+                    e.rem_hint = e.rem - e.consumed
+                    self._demote_locked(e, h)
+                    self.leases_revoked += 1
+            for s in self._pending.values():
+                if now <= s[6]:
+                    returns.append(s)
+            self._pending.clear()
+        if returns:
+            self._apply_settles(returns)
+        return len(returns)
+
+    def _apply_settles(self, rows: List[tuple]) -> None:
+        engine = self.engine
+        for lo in range(0, len(rows), 4096):
+            chunk = rows[lo:lo + 4096]
+            m = len(chunk)
+            cols = (
+                [s[0] for s in chunk],
+                np.zeros(m, dtype=np.int32),
+                np.zeros(m, dtype=np.int32),
+                np.asarray([s[1] for s in chunk], dtype=np.int64),
+                np.asarray([s[2] for s in chunk], dtype=np.int64),
+                np.asarray([s[3] for s in chunk], dtype=np.int64),
+                np.zeros(m, dtype=np.int64),
+            )
+            try:
+                if self._count_kw:
+                    # Returns are reconciliation, not decisions — keep
+                    # them out of the decision counters where the
+                    # engine supports it.
+                    engine.apply_columnar(*cols, count_decisions=False)
+                else:
+                    engine.apply_columnar(*cols)
+            except Exception:  # noqa: BLE001
+                log.exception("ledger return apply failed (%d rows)", m)
+                continue
+            with self._lock:
+                self.settles += m
+            for s in chunk:
+                self.settle_lag.observe(time.monotonic() - s[5])
+
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "answered": self.answered,
+                "fallthrough": self.fallthrough,
+                "leases_granted": self.leases_granted,
+                "leases_revoked": self.leases_revoked,
+                "settles": self.settles,
+                "over_entries": self.over_entries,
+                "entries": len(self._items),
+                "pending_settles": len(self._pending),
+                "settle_lag_ms_mean": round(
+                    self.settle_lag.mean() * 1e3, 3
+                ),
+            }
+        if self._readonly is not None:
+            out["readonly_entries"] = len(self._readonly)
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=2.0)
+        self.flush_settles()

@@ -189,19 +189,53 @@ struct Server {
   std::vector<std::weak_ptr<Conn>> conns;
 };
 
+// One response whose DATA is (partially) blocked on the peer's
+// send-side flow-control windows (RFC 9113 §5.2): DATA queues here
+// until WINDOW_UPDATE / SETTINGS opens the window, trailers follow the
+// last DATA chunk.
+struct PendingSend {
+  uint32_t stream;
+  std::string data;     // full DATA payload (grpc-framed message)
+  size_t off = 0;       // bytes already sent
+  int64_t stream_window;
+  std::string trailers;  // pre-framed trailer HEADERS
+};
+
 struct Conn : std::enable_shared_from_this<Conn> {
   int fd;
   std::mutex write_mu;
   std::atomic<bool> dead{false};
   int64_t recv_since_update = 0;
+  // Peer's receive allowance for OUR sends (guarded by write_mu):
+  // connection-level window plus the initial per-stream window from
+  // the peer's SETTINGS.  Responses only move inside these.
+  int64_t conn_send_window = 65535;
+  int64_t initial_stream_window = 65535;
+  std::deque<PendingSend> blocked;
+  // WINDOW_UPDATE credit that arrived BEFORE the stream's response was
+  // queued (the client may grant window while the request is still in
+  // the dispatch queue) — it must not be dropped or the response can
+  // stall forever under a zero initial window.  Bounded: streams are
+  // short-lived; oldest entries are shed past the cap.
+  std::vector<std::pair<uint32_t, int64_t>> early_credits;
+  static constexpr size_t kMaxEarlyCredits = 128;
+
+  int64_t take_early_credit(uint32_t stream) {
+    for (size_t i = 0; i < early_credits.size(); ++i)
+      if (early_credits[i].first == stream) {
+        const int64_t c = early_credits[i].second;
+        early_credits.erase(early_credits.begin() + i);
+        return c;
+      }
+    return 0;
+  }
 
   explicit Conn(int f) : fd(f) {}
   ~Conn() {
     if (fd >= 0) ::close(fd);
   }
 
-  bool send_all(const std::string& buf) {
-    std::lock_guard<std::mutex> lock(write_mu);
+  bool send_locked(const std::string& buf) {
     const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
     size_t n = buf.size();
     while (n) {
@@ -214,6 +248,110 @@ struct Conn : std::enable_shared_from_this<Conn> {
       n -= static_cast<size_t>(w);
     }
     return true;
+  }
+
+  bool send_all(const std::string& buf) {
+    std::lock_guard<std::mutex> lock(write_mu);
+    return send_locked(buf);
+  }
+
+  // Drain blocked responses in FIFO preference as far as the windows
+  // allow — but a stream whose OWN window is exhausted must not
+  // head-of-line block later streams that still have credit (streams
+  // are independent; only the connection window is shared).  DATA is
+  // chunked to the default max frame size; a response's trailers go
+  // out only once its DATA fully drained.
+  void pump_locked() {
+    for (auto it = blocked.begin(); it != blocked.end() && !dead.load();) {
+      PendingSend& p = *it;
+      bool stream_blocked = false;
+      while (p.off < p.data.size()) {
+        if (conn_send_window <= 0) return;  // shared window: stop all
+        const int64_t allow = std::min(conn_send_window, p.stream_window);
+        if (allow <= 0) {  // this stream only: try the next one
+          stream_blocked = true;
+          break;
+        }
+        size_t chunk = std::min(
+            {static_cast<size_t>(allow), p.data.size() - p.off,
+             static_cast<size_t>(16384)});
+        std::string out;
+        frame_header(out, static_cast<uint32_t>(chunk), kData, 0,
+                     p.stream);
+        out.append(p.data, p.off, chunk);
+        if (!send_locked(out)) return;
+        conn_send_window -= static_cast<int64_t>(chunk);
+        p.stream_window -= static_cast<int64_t>(chunk);
+        p.off += chunk;
+      }
+      if (stream_blocked) {
+        ++it;
+        continue;
+      }
+      send_locked(p.trailers);
+      it = blocked.erase(it);
+    }
+  }
+
+  // Full response path: HEADERS immediately (not flow-controlled),
+  // DATA+trailers through the window-aware queue.
+  bool send_response(uint32_t stream, const std::string& hdr,
+                     std::string data, const std::string& trailers) {
+    std::lock_guard<std::mutex> lock(write_mu);
+    if (!send_locked(hdr)) return false;
+    PendingSend p;
+    p.stream = stream;
+    p.data = std::move(data);
+    p.stream_window = initial_stream_window + take_early_credit(stream);
+    p.trailers = trailers;
+    blocked.push_back(std::move(p));
+    pump_locked();
+    return !dead.load();
+  }
+
+  void window_update(uint32_t stream, uint32_t inc) {
+    std::lock_guard<std::mutex> lock(write_mu);
+    if (stream == 0) {
+      conn_send_window += inc;
+    } else {
+      bool found = false;
+      for (auto& p : blocked)
+        if (p.stream == stream) {
+          p.stream_window += inc;
+          found = true;
+        }
+      if (!found) {
+        // The response is not queued yet: bank the credit.
+        for (auto& ec : early_credits)
+          if (ec.first == stream) {
+            ec.second += inc;
+            found = true;
+            break;
+          }
+        if (!found) {
+          if (early_credits.size() >= kMaxEarlyCredits)
+            early_credits.erase(early_credits.begin());
+          early_credits.emplace_back(stream, inc);
+        }
+      }
+    }
+    pump_locked();
+  }
+
+  void set_initial_window(int64_t v) {
+    std::lock_guard<std::mutex> lock(write_mu);
+    const int64_t delta = v - initial_stream_window;
+    initial_stream_window = v;
+    // RFC 9113 §6.9.2: a SETTINGS change adjusts all open streams.
+    for (auto& p : blocked) p.stream_window += delta;
+    pump_locked();
+  }
+
+  void drop_stream_sends(uint32_t stream) {
+    std::lock_guard<std::mutex> lock(write_mu);
+    for (auto it = blocked.begin(); it != blocked.end();)
+      it = (it->stream == stream) ? blocked.erase(it) : it + 1;
+    take_early_credit(stream);
   }
 };
 
@@ -241,58 +379,70 @@ std::string trailers_block(int code) {
   return b;
 }
 
-// One RPC's full response: HEADERS + DATA(grpc frame) + trailers.
-std::string build_response(uint32_t stream, const int64_t* cols,
-                           int64_t offset, int64_t k, int64_t total,
-                           int grpc_status) {
-  static const std::string kHdr = resp_headers_block();
-  std::string out;
-  frame_header(out, static_cast<uint32_t>(kHdr.size()), kHeaders,
-               kFlagEndHeaders, stream);
-  out += kHdr;
-  if (grpc_status == 0) {
-    // GetRateLimitsResp{ repeated RateLimitResp responses = 1 }
-    std::string pb;
-    for (int64_t i = 0; i < k; ++i) {
-      std::string item;
-      const int64_t st = cols[0 * total + offset + i];
-      const int64_t li = cols[1 * total + offset + i];
-      const int64_t re = cols[2 * total + offset + i];
-      const int64_t rt = cols[3 * total + offset + i];
-      if (st) {
-        item.push_back(0x08);
-        put_varint(item, static_cast<uint64_t>(st));
-      }
-      if (li) {
-        item.push_back(0x10);
-        put_varint(item, static_cast<uint64_t>(li));
-      }
-      if (re) {
-        item.push_back(0x18);
-        put_varint(item, static_cast<uint64_t>(re));
-      }
-      if (rt) {
-        item.push_back(0x20);
-        put_varint(item, static_cast<uint64_t>(rt));
-      }
-      pb.push_back(0x0a);
-      put_varint(pb, item.size());
-      pb += item;
+// The grpc-framed message payload of a success response (the DATA
+// frame's payload; framing happens window-chunked in Conn::pump_locked).
+std::string build_data_payload(const int64_t* cols, int64_t offset,
+                               int64_t k, int64_t total) {
+  // GetRateLimitsResp{ repeated RateLimitResp responses = 1 }
+  std::string pb;
+  for (int64_t i = 0; i < k; ++i) {
+    std::string item;
+    const int64_t st = cols[0 * total + offset + i];
+    const int64_t li = cols[1 * total + offset + i];
+    const int64_t re = cols[2 * total + offset + i];
+    const int64_t rt = cols[3 * total + offset + i];
+    if (st) {
+      item.push_back(0x08);
+      put_varint(item, static_cast<uint64_t>(st));
     }
-    std::string data;
-    data.push_back(0);  // uncompressed
-    uint8_t len4[4];
-    put_u32(len4, static_cast<uint32_t>(pb.size()));
-    data.append(reinterpret_cast<char*>(len4), 4);
-    data += pb;
-    frame_header(out, static_cast<uint32_t>(data.size()), kData, 0, stream);
-    out += data;
+    if (li) {
+      item.push_back(0x10);
+      put_varint(item, static_cast<uint64_t>(li));
+    }
+    if (re) {
+      item.push_back(0x18);
+      put_varint(item, static_cast<uint64_t>(re));
+    }
+    if (rt) {
+      item.push_back(0x20);
+      put_varint(item, static_cast<uint64_t>(rt));
+    }
+    pb.push_back(0x0a);
+    put_varint(pb, item.size());
+    pb += item;
   }
-  const std::string tr = trailers_block(grpc_status);
-  frame_header(out, static_cast<uint32_t>(tr.size()), kHeaders,
+  std::string data;
+  data.push_back(0);  // uncompressed
+  uint8_t len4[4];
+  put_u32(len4, static_cast<uint32_t>(pb.size()));
+  data.append(reinterpret_cast<char*>(len4), 4);
+  data += pb;
+  return data;
+}
+
+// One RPC's full response: HEADERS immediately, then DATA under the
+// peer's send-side flow-control windows, trailers after the DATA.
+void send_rpc_response(const std::shared_ptr<Conn>& conn, uint32_t stream,
+                       const int64_t* cols, int64_t offset, int64_t k,
+                       int64_t total, int grpc_status) {
+  static const std::string kHdr = resp_headers_block();
+  std::string hdr;
+  frame_header(hdr, static_cast<uint32_t>(kHdr.size()), kHeaders,
+               kFlagEndHeaders, stream);
+  hdr += kHdr;
+  const std::string tr_block = trailers_block(grpc_status);
+  std::string tr;
+  frame_header(tr, static_cast<uint32_t>(tr_block.size()), kHeaders,
                kFlagEndHeaders | kFlagEndStream, stream);
-  out += tr;
-  return out;
+  tr += tr_block;
+  if (grpc_status == 0) {
+    conn->send_response(stream, hdr,
+                        build_data_payload(cols, offset, k, total), tr);
+  } else {
+    // Error replies carry no DATA — headers-only frames are exempt
+    // from flow control.
+    conn->send_all(hdr + tr);
+  }
 }
 
 struct StreamState {
@@ -366,6 +516,22 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
       switch (type) {
         case kSettings:
           if (!(flags & kFlagAck)) {
+            // Honor the peer's send-side windows: INITIAL_WINDOW_SIZE
+            // (id 4) caps how much response DATA each stream may carry
+            // before a WINDOW_UPDATE (RFC 9113 §6.5.2, §6.9.2).
+            for (uint32_t off = 0; off + 6 <= flen; off += 6) {
+              const uint16_t id =
+                  (uint16_t(payload[off]) << 8) | payload[off + 1];
+              const uint32_t val = get_u32(payload + off + 2);
+              if (id == 0x4) {
+                if (val > 0x7fffffffu) {  // FLOW_CONTROL_ERROR
+                  conn->dead.store(true);
+                  break;
+                }
+                conn->set_initial_window(static_cast<int64_t>(val));
+              }
+            }
+            if (conn->dead.load()) break;
             std::string s;
             frame_header(s, 0, kSettings, kFlagAck, 0);
             conn->send_all(s);
@@ -387,7 +553,7 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
           StreamState& st = stream_of(stream);
           if (flags & kFlagEndHeaders) st.headers_done = true;
           if (flags & kFlagEndStream) {
-            conn->send_all(build_response(stream, nullptr, 0, 0, 0, 12));
+            send_rpc_response(conn, stream, nullptr, 0, 0, 0, 12);
             drop_stream(stream);
           }
           break;
@@ -424,13 +590,12 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
           if (flags & kFlagEndStream) {
             // grpc frame: 1-byte compressed flag + u32 length + body.
             if (st.body.size() < 5 || st.body[0] != 0) {
-              conn->send_all(build_response(stream, nullptr, 0, 0, 0, 13));
+              send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
             } else {
               const uint32_t mlen =
                   get_u32(reinterpret_cast<const uint8_t*>(st.body.data()) + 1);
               if (5 + mlen > st.body.size()) {
-                conn->send_all(
-                    build_response(stream, nullptr, 0, 0, 0, 13));
+                send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
               } else {
                 std::string body = st.body.substr(5, mlen);
                 const int64_t items = count_items(
@@ -438,8 +603,7 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                     reinterpret_cast<const uint8_t*>(body.data()) +
                         body.size());
                 if (items < 0 || items > 1000) {
-                  conn->send_all(
-                      build_response(stream, nullptr, 0, 0, 0, 13));
+                  send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
                 } else {
                   std::lock_guard<std::mutex> lock(srv->q_mu);
                   srv->queue.push_back(PendingRpc{
@@ -465,13 +629,26 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
         }
         case kRst:
           drop_stream(stream);
+          conn->drop_stream_sends(stream);
           break;
         case kGoaway:
           conn->dead.store(true);
           break;
-        case kWindowUpdate:
+        case kWindowUpdate: {
+          if (flen != 4) {
+            conn->dead.store(true);
+            break;
+          }
+          const uint32_t inc = get_u32(payload) & 0x7fffffff;
+          if (inc == 0) {  // PROTOCOL_ERROR per RFC 9113 §6.9
+            conn->dead.store(true);
+            break;
+          }
+          conn->window_update(stream, inc);
+          break;
+        }
         default:
-          break;  // responses are tiny; send-window tracking unneeded
+          break;
       }
       pos += 9 + flen;
       if (conn->dead.load()) break;
@@ -554,12 +731,12 @@ void dispatch_loop(Server* srv) {
         continue;
       }
       if (st == 0) {
-        rpc.conn->send_all(build_response(rpc.stream, cols.data(), offset,
-                                          rpc.items, total, 0));
+        send_rpc_response(rpc.conn, rpc.stream, cols.data(), offset,
+                          rpc.items, total, 0);
         srv->rpcs.fetch_add(1);
       } else {
-        rpc.conn->send_all(build_response(
-            rpc.stream, nullptr, 0, 0, 0, static_cast<int>(st)));
+        send_rpc_response(rpc.conn, rpc.stream, nullptr, 0, 0, 0,
+                          static_cast<int>(st));
         srv->errors.fetch_add(1);
       }
       offset += rpc.items;

@@ -173,6 +173,12 @@ class Daemon:
             sketch_window_ms=conf.sketch_window_ms,
             sketch_depth=conf.sketch_depth,
             sketch_width=conf.sketch_width,
+            ledger=conf.ledger,
+            ledger_lease=conf.ledger_lease,
+            ledger_lease_ttl=conf.ledger_lease_ttl,
+            ledger_hot_threshold=conf.ledger_hot_threshold,
+            ledger_keys=conf.ledger_keys,
+            ledger_settle_interval=conf.ledger_settle_interval,
         )
         self.instance = V1Instance(service_conf, engine)
         self.registry = build_registry(

@@ -207,6 +207,9 @@ class H2FastFront:
         # listener's forward path.
         if not inst.all_locally_owned(dec):
             return None
+        ledger = getattr(inst, "ledger", None)
+        if ledger is not None:
+            return self._serve_ledger(ledger, engine, dec)
         packed = PackedKeys(dec.key_buf, dec.key_offsets, dec.n)
         if hasattr(engine, "tables"):
             return engine.apply_columnar(
@@ -217,6 +220,40 @@ class H2FastFront:
             packed, dec.algo, dec.behavior, dec.hits, dec.limit,
             dec.duration, dec.burst,
         )
+
+    @staticmethod
+    def _serve_ledger(ledger, engine, dec):
+        """Ledger-aware window serve: hot-key rows (sticky over-limit,
+        live lease credit) answer without any device work — for a fully
+        hot window the engine is never dispatched at all, which is the
+        front's whole point on a dispatch-bound backend."""
+        from gubernator_tpu.core.engine import PackedKeys
+
+        plan = ledger.plan(dec, engine.clock.now_ms())
+        if plan.full:
+            return plan.dense_cols()
+        lane = plan.build_engine_lane()
+        packed = PackedKeys(lane.key_buf, lane.key_offsets, lane.n)
+        try:
+            if hasattr(engine, "tables"):
+                out = engine.apply_columnar(
+                    packed, lane.algo, lane.behavior, lane.hits,
+                    lane.limit, lane.duration, lane.burst,
+                    route_hashes=lane.fnv1a,
+                )
+            else:
+                out = engine.apply_columnar(
+                    packed, lane.algo, lane.behavior, lane.hits,
+                    lane.limit, lane.duration, lane.burst,
+                )
+        except Exception:
+            plan.rollback()
+            raise
+        st, lim, rem, rst = out
+        plan.learn(st, lim, rem, rst)
+        if not plan.answered_rows and lane is dec:
+            return out
+        return plan.merge_outputs(st, rem, rst)
 
     # -- lifecycle ------------------------------------------------------
 

@@ -19,6 +19,12 @@ window at all, VERDICT r5 weak #2's stacked-window mechanism), and the
 wait grows toward the cap only while windows actually group concurrent
 RPCs (where the amortization pays).  `adaptive=False` restores the
 fixed wait for tests that pin window timing.
+
+Round 7: submissions are ENGINE LANES, not whole RPCs — the decision
+ledger (core/ledger.py) splits each batch before it reaches the window
+(ledger-answerable rows never enter; the lane may carry prepended
+credit-return rows and appended lease-acquisition rows), so a fully
+hot-key RPC skips the window — and the dispatch — entirely.
 """
 
 from __future__ import annotations

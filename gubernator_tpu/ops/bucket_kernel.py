@@ -1298,6 +1298,26 @@ def _collapsed_values(state: BucketState, pin: jax.Array):
     return slot, vals2, _pack_out(o_status.astype(_I32), o_rem, o_reset)
 
 
+def token_extras_host(R1: int, h: int, extras: int) -> tuple[int, int, bool]:
+    """Host-scalar twin of the token branch of `_collapsed_values`:
+    given remaining R1 after the first application, `extras` further
+    occurrences each consuming `h` admit
+    a2 = clip(R1 // h, 0, extras) of them (all, for h <= 0), leaving
+    rem2 = R1 - a2*h, with the sticky status flipping OVER iff some
+    extra actually saw remaining==0.  Returns (a2, rem2, sticky_over).
+
+    The decision ledger (core/ledger.py) drains its credit leases with
+    this same algebra — one source of truth for the closed form the
+    kernel fuzz pins (tests/test_collapse.py, tests/test_ledger.py)."""
+    if h > 0:
+        a2 = min(max(R1 // h, 0), extras)
+    else:
+        a2 = extras
+    rem2 = R1 - a2 * h
+    sticky = h > 0 and rem2 == 0 and a2 < extras
+    return a2, rem2, sticky
+
+
 def _collapsed_step_core(state: BucketState, pin: jax.Array):
     slot, vals2, packed = _collapsed_values(state, pin)
     return _scatter_values(state, slot, vals2), packed

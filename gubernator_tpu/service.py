@@ -276,6 +276,27 @@ class V1Instance:
         self.conf = conf
         self.engine = engine
         self.global_cache = _GlobalStatusCache(capacity=conf.cache_size)
+        # Host-tier decision ledger (core/ledger.py): sticky over-limit
+        # answers + bounded credit leases serve hot-key decisions with
+        # zero device work.  The owner-broadcast status cache above is
+        # its read-only tier (non-owner GLOBAL entries).
+        self.ledger = None
+        if getattr(conf, "ledger", True) and getattr(
+            engine, "apply_columnar", None
+        ) is not None and getattr(engine, "store", None) is None:
+            from gubernator_tpu.core.ledger import DecisionLedger
+
+            self.ledger = DecisionLedger(
+                engine,
+                lease_size=getattr(conf, "ledger_lease", 512),
+                lease_ttl=getattr(conf, "ledger_lease_ttl", 0.2),
+                hot_threshold=getattr(conf, "ledger_hot_threshold", 8),
+                max_keys=getattr(conf, "ledger_keys", 65536),
+                settle_interval=getattr(
+                    conf, "ledger_settle_interval", 0.05
+                ),
+            )
+            self.ledger.attach_readonly(self.global_cache)
         self.global_mgr = GlobalManager(conf.behaviors, self)
         self.multi_region_mgr = MultiRegionManager(conf.behaviors, self)
         from gubernator_tpu.cluster.hash_ring import make_picker
@@ -698,6 +719,9 @@ class V1Instance:
             self.counters["local"] += dec.n
         self.counters["columnar"] += dec.n
 
+        if self.ledger is not None:
+            return self._serve_columnar_ledger(dec)
+
         from gubernator_tpu.core.engine import PackedKeys
 
         if self._wire_window is not None:
@@ -722,6 +746,64 @@ class V1Instance:
             time.monotonic() - t_serve
         )
         return wire_codec.encode_resps(st, lim, rem, rst)
+
+    def _serve_columnar_ledger(self, dec) -> Optional[bytes]:
+        """The local columnar route through the decision ledger: rows
+        the ledger can answer exactly (sticky over-limit, live lease
+        credit) skip the device entirely; the rest — with any settle
+        rows prepended — ride the usual group-commit window / direct
+        apply, and the engine's responses teach the ledger (lease
+        grants, over-limit inserts)."""
+        from gubernator_tpu.net import wire_codec
+
+        engine = self.engine
+        plan = self.ledger.plan(dec, engine.clock.now_ms())
+        if plan.full:
+            st, lim, rem, rst = plan.dense_cols()
+            return wire_codec.encode_resps(st, lim, rem, rst)
+        lane = plan.build_engine_lane()
+        out = self._dispatch_lane(lane)
+        if out is None:
+            plan.rollback()
+            return None
+        st, lim, rem, rst = out
+        plan.learn(st, lim, rem, rst)
+        if not plan.answered_rows and lane is dec:
+            return wire_codec.encode_resps(st, lim, rem, rst)
+        return wire_codec.encode_resps(*plan.merge_outputs(st, rem, rst))
+
+    def _dispatch_lane(self, lane):
+        """Run one engine-lane column set through the group-commit
+        window (preferred) or a direct columnar apply; returns the
+        (status, limit, remaining, reset) columns or None on failure
+        (callers roll the ledger back and fall to the pb path)."""
+        from gubernator_tpu.core.engine import PackedKeys
+
+        engine = self.engine
+        if self._wire_window is not None:
+            out = self._wire_window.submit(lane)
+            if out is not None:
+                return out
+        packed = PackedKeys(lane.key_buf, lane.key_offsets, lane.n)
+        t_serve = time.monotonic()
+        try:
+            if hasattr(engine, "tables"):
+                return engine.apply_columnar(
+                    packed, lane.algo, lane.behavior, lane.hits,
+                    lane.limit, lane.duration, lane.burst,
+                    route_hashes=lane.fnv1a,
+                )
+            return engine.apply_columnar(
+                packed, lane.algo, lane.behavior, lane.hits, lane.limit,
+                lane.duration, lane.burst,
+            )
+        except Exception:  # noqa: BLE001 — callers fall back to pb
+            log.exception("ledger engine-lane apply failed")
+            return None
+        finally:
+            self.stage_timers["engine_serve"].observe(
+                time.monotonic() - t_serve
+            )
 
     def _serve_wire_global(
         self, dec, check_ownership: bool
@@ -773,7 +855,22 @@ class V1Instance:
         owner_meta_idx = np.full(n, -1, dtype=np.int32)
         owner_strs: List[bytes] = []
 
-        eng_parts = [owned_idx] if len(owned_idx) else []
+        # Owner-side ledger: sticky over-limit and leased hot keys
+        # answer without joining the merged engine apply (the answered
+        # columns still ride the broadcast below — the ledger's view IS
+        # the authoritative serve-time status).
+        led_plan = None
+        owned_eng = owned_idx
+        if len(owned_idx) and self.ledger is not None:
+            led_plan = self.ledger.plan(dec, now_ms, idx=owned_idx)
+            aidx = led_plan.answered_idx
+            if len(aidx):
+                a_st, a_rem, a_rst = led_plan.answered_cols()
+                status[aidx] = a_st
+                remaining[aidx] = a_rem
+                reset[aidx] = a_rst
+            owned_eng = led_plan.fall_idx
+        eng_parts = [owned_eng] if len(owned_eng) else []
         if len(non_idx):
             self.counters["global"] += len(non_idx)
             self.global_mgr.queue_hits_chunk(dec, non_idx)
@@ -817,21 +914,40 @@ class V1Instance:
             sub_buf, sub_off = _slice_key_columns(
                 dec.key_buf, dec.key_offsets, eng_idx
             )
-            packed = PackedKeys(sub_buf, sub_off, len(eng_idx))
             cols = tuple(
                 np.ascontiguousarray(np.asarray(a)[eng_idx])
                 for a in (dec.algo, dec.behavior, dec.hits, dec.limit,
                           dec.duration, dec.burst)
             )
+            sub = _SubBatch()
+            sub.n = len(eng_idx)
+            sub.key_buf = sub_buf
+            sub.key_offsets = sub_off
+            (sub.algo, sub.behavior, sub.hits, sub.limit,
+             sub.duration, sub.burst) = cols
+            sub.fnv1a = np.ascontiguousarray(dec.fnv1a[eng_idx])
+            n_settles = 0
+            n_acq = 0
+            n_eng = len(eng_idx)
+            if led_plan is not None and (
+                led_plan.n_settles or led_plan.n_acquires
+            ):
+                # Revoked leases return their credit IN this dispatch,
+                # ahead of the rows that broke their preconditions;
+                # lease acquisitions ride the tail.
+                from gubernator_tpu.core.ledger import concat_lanes
+
+                n_settles = led_plan.n_settles
+                n_acq = led_plan.n_acquires
+                pre = led_plan.settle_lane()
+                if pre is not None:
+                    sub = concat_lanes(pre, sub)
+                post = led_plan.acq_lane()
+                if post is not None:
+                    sub = concat_lanes(sub, post)
+            packed = PackedKeys(sub.key_buf, sub.key_offsets, sub.n)
             out = None
             if self._global_window is not None:
-                sub = _SubBatch()
-                sub.n = len(eng_idx)
-                sub.key_buf = sub_buf
-                sub.key_offsets = sub_off
-                (sub.algo, sub.behavior, sub.hits, sub.limit,
-                 sub.duration, sub.burst) = cols
-                sub.fnv1a = np.ascontiguousarray(dec.fnv1a[eng_idx])
                 # The window observes engine_serve itself — once per
                 # merged dispatch, not once per grouped RPC.
                 out = self._global_window.submit(sub)
@@ -839,20 +955,52 @@ class V1Instance:
                 st, lim, rem, rst = out
             else:
                 t_serve = time.monotonic()
-                if hasattr(engine, "tables"):
-                    st, lim, rem, rst = engine.apply_columnar(
-                        packed, *cols, now_ms=now_ms,
-                        route_hashes=np.ascontiguousarray(
-                            dec.fnv1a[eng_idx]
-                        ),
+                try:
+                    if hasattr(engine, "tables"):
+                        st, lim, rem, rst = engine.apply_columnar(
+                            packed, sub.algo, sub.behavior, sub.hits,
+                            sub.limit, sub.duration, sub.burst,
+                            now_ms=now_ms, route_hashes=sub.fnv1a,
+                        )
+                    else:
+                        st, lim, rem, rst = engine.apply_columnar(
+                            packed, sub.algo, sub.behavior, sub.hits,
+                            sub.limit, sub.duration, sub.burst,
+                            now_ms=now_ms,
+                        )
+                except Exception:
+                    # The lane never applied: restore consumed credits
+                    # and re-queue the pulled return rows, or the
+                    # revoked leases' unused credit would stay debited
+                    # on the device forever.
+                    if led_plan is not None:
+                        led_plan.rollback()
+                    raise
+                finally:
+                    self.stage_timers["engine_serve"].observe(
+                        time.monotonic() - t_serve
                     )
-                else:
-                    st, lim, rem, rst = engine.apply_columnar(
-                        packed, *cols, now_ms=now_ms
-                    )
-                self.stage_timers["engine_serve"].observe(
-                    time.monotonic() - t_serve
+            if led_plan is not None and (
+                len(owned_eng) or n_settles or n_acq
+            ):
+                # Engine outputs for the return rows + the owned
+                # fall-through rows + the acquisition rows teach the
+                # ledger (reconciliation, over-limit inserts, lease
+                # grants) — learn expects them in [settles..., fall...,
+                # acquires...] lane order.
+                pos = np.searchsorted(eng_idx, owned_eng) + n_settles
+                lidx = np.concatenate(
+                    [
+                        np.arange(n_settles, dtype=np.int64),
+                        pos,
+                        np.arange(n_acq, dtype=np.int64)
+                        + n_settles + n_eng,
+                    ]
                 )
+                led_plan.learn(st[lidx], lim[lidx], rem[lidx], rst[lidx])
+            if n_settles or n_acq:
+                sl = slice(n_settles, n_settles + n_eng)
+                st, lim, rem, rst = st[sl], lim[sl], rem[sl], rst[sl]
             status[eng_idx] = st
             limit[eng_idx] = lim
             remaining[eng_idx] = rem
@@ -928,6 +1076,10 @@ class V1Instance:
             # the dataclass peer path never bumps it either.
             self.counters["local"] += len(keys_bytes)
         self.counters["columnar"] += len(keys_bytes)
+        if self.ledger is not None:
+            # pb-decoded columns carry no fnv1a hashes, so this path
+            # cannot consult the ledger — keep it coherent instead.
+            self.ledger.invalidate_keys(keys_bytes)
         return apply_columnar(keys_bytes, algo, behavior, hits, limit, duration, burst)
 
     def get_peer_batch(self, keys: Sequence[str]) -> List:
@@ -1021,6 +1173,14 @@ class V1Instance:
         mr_items = [r for r in reqs if int(r.behavior) & _MULTI_REGION_I]
         for r in mr_items:
             self.multi_region_mgr.queue_hits(r)
+        if self.ledger is not None:
+            # This batch runs on the engine outside the ledger: settle
+            # and drop any ledger entry for its keys first, so the
+            # engine computes on the sequential state (O(1) dict probe
+            # per key; almost always a miss).
+            self.ledger.invalidate_keys(
+                [r.hash_key().encode() for r in reqs]
+            )
         return self.engine.get_rate_limits(reqs, now_ms=now_ms)
 
     # ------------------------------------------------------------------
@@ -1101,6 +1261,8 @@ class V1Instance:
         if self._closed:
             return
         self._closed = True
+        if self.ledger is not None:
+            self.ledger.close()
         self.global_mgr.close()
         self.multi_region_mgr.close()
         self._forward_pool.shutdown(wait=True)

@@ -252,6 +252,60 @@ class InstanceCollector(Collector):
             s.add_metric([stage], count_value=stat.count, sum_value=stat.total)
         yield s
 
+        # Decision-ledger counters (core/ledger.py): decisions answered
+        # on the host without a device dispatch, rows that fell through
+        # to the engine, lease lifecycle, and settle traffic.
+        led = getattr(inst, "ledger", None)
+        if led is not None:
+            c = CounterMetricFamily(
+                "gubernator_ledger_answered",
+                "Decisions answered by the host decision ledger "
+                "(sticky over-limit + lease credit) with zero device "
+                "work.",
+            )
+            c.add_metric([], led.answered)
+            yield c
+            c = CounterMetricFamily(
+                "gubernator_ledger_fallthrough",
+                "Ledger-considered rows that fell through to the "
+                "engine.",
+            )
+            c.add_metric([], led.fallthrough)
+            yield c
+            c = CounterMetricFamily(
+                "gubernator_ledger_leases",
+                "Lease lifecycle events by kind.",
+                labels=["event"],
+            )
+            c.add_metric(["granted"], led.leases_granted)
+            c.add_metric(["revoked"], led.leases_revoked)
+            yield c
+            c = CounterMetricFamily(
+                "gubernator_ledger_settles",
+                "Settle rows applied back to the device (consumed "
+                "lease credits reconciled).",
+            )
+            c.add_metric([], led.settles)
+            yield c
+            s = SummaryMetricFamily(
+                "gubernator_ledger_settle_lag",
+                "Seconds from lease revocation to the settle apply.",
+                count_value=led.settle_lag.count,
+                sum_value=led.settle_lag.total,
+            )
+            yield s
+        # Device dispatches per decision: the number the ledger exists
+        # to push below 1 on hot-key traffic.  Decisions = engine rows
+        # + ledger answers; dispatches = engine kernel rounds.
+        decisions = eng.requests_total + (led.answered if led else 0)
+        g = GaugeMetricFamily(
+            "gubernator_dispatches_per_decision",
+            "Engine kernel rounds per rate-limit decision "
+            "(cumulative ratio).",
+        )
+        g.add_metric([], eng.rounds_total / decisions if decisions else 0.0)
+        yield g
+
         # Window-size gauges: what the adaptive batching windows are
         # actually waiting right now (0 when idle, the configured cap
         # under sustained fill).

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# ci_fast.sh — the fast correctness + capture gate for one host.
+#
+# Runs exactly two things:
+#   1. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#      are excluded so the suite stays inside its 870 s timeout);
+#   2. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#      latency + herdfast with shortened knobs, writing
+#      BENCH_<round>_fast_capture.json with per-config durations.
+#
+# Usage: scripts/ci_fast.sh [BENCH_ROUND]
+#   BENCH_ROUND (or $1) tags the bench artifacts; default "ci".
+# Exit code: the pytest result (a failed capture still exits non-zero
+# via set -e unless the bench JSON was produced).
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+ROUND="${1:-${BENCH_ROUND:-ci}}"
+
+echo "=== tier-1 tests ===" >&2
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+  -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)" >&2
+
+echo "=== fast_capture bench tier (round ${ROUND}) ===" >&2
+BENCH_ROUND="${ROUND}" python scripts/bench_all.py fast_capture || rc=$((rc ? rc : 1))
+
+exit "$rc"

@@ -24,6 +24,14 @@ import pytest
 from gubernator_tpu.clock import Clock
 
 
+def pytest_configure(config):
+    # `slow` marks the long fuzz soaks; tier-1 runs -m 'not slow'
+    # (ROADMAP.md) so the suite stays inside its timeout.
+    config.addinivalue_line(
+        "markers", "slow: long-running soak, excluded from tier-1"
+    )
+
+
 @pytest.fixture
 def frozen_clock() -> Clock:
     """A frozen, manually advanced clock (reference: functional_test.go:160)."""

@@ -228,6 +228,183 @@ def test_fast_front_ownership_gate():
         h.stop()
 
 
+def _h2_frames(sock, deadline):
+    """Yield (type, flags, stream, payload) frames until the socket
+    times out or closes."""
+    import socket as _socket
+    import time
+
+    buf = b""
+    while True:
+        while len(buf) < 9:
+            sock.settimeout(max(0.05, deadline - time.monotonic()))
+            try:
+                chunk = sock.recv(65536)
+            except (_socket.timeout, TimeoutError):
+                return
+            if not chunk:
+                return
+            buf += chunk
+        flen = (buf[0] << 16) | (buf[1] << 8) | buf[2]
+        ftype, flags = buf[3], buf[4]
+        stream = struct.unpack(">I", buf[5:9])[0] & 0x7FFFFFFF
+        while len(buf) < 9 + flen:
+            sock.settimeout(max(0.05, deadline - time.monotonic()))
+            try:
+                chunk = sock.recv(65536)
+            except (_socket.timeout, TimeoutError):
+                return
+            if not chunk:
+                return
+            buf += chunk
+        yield ftype, flags, stream, buf[9 : 9 + flen]
+        buf = buf[9 + flen :]
+
+
+def test_fast_front_honors_send_flow_control(daemon):
+    """RFC 9113 send-side flow control (ADVICE r5 low #2): when the
+    peer advertises a tiny INITIAL_WINDOW_SIZE, response DATA must stop
+    at the window and resume only on WINDOW_UPDATE — before the fix the
+    front wrote the whole response regardless of the peer's windows."""
+    import socket
+    import time
+
+    host, port = daemon.h2_fast_address.rsplit(":", 1)
+    n_items = 120
+    body = pb.GetRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name="fc", unique_key=f"{i}k", hits=1, limit=1000,
+                duration=60_000,
+            )
+            for i in range(n_items)
+        ]
+    ).SerializeToString()
+    window = 32  # far below the response size
+
+    sock = socket.create_connection((host, int(port)), timeout=5)
+    try:
+        sock.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+        # SETTINGS: INITIAL_WINDOW_SIZE = 32.
+        sock.sendall(
+            struct.pack(">I", 6)[1:] + bytes([4, 0])
+            + struct.pack(">I", 0)            # stream 0
+            + struct.pack(">H", 4) + struct.pack(">I", window)
+        )
+        # HEADERS (empty block — the port is the route), then the
+        # grpc-framed request body with END_STREAM.
+        sock.sendall(struct.pack(">I", 0)[1:] + bytes([1, 4]) + struct.pack(">I", 1))
+        grpc_frame = b"\x00" + struct.pack(">I", len(body)) + body
+        sock.sendall(
+            struct.pack(">I", len(grpc_frame))[1:] + bytes([0, 1])
+            + struct.pack(">I", 1) + grpc_frame
+        )
+        # Phase 1: the server must send HEADERS and AT MOST `window`
+        # bytes of DATA, then stall.
+        data = b""
+        saw_headers = False
+        saw_trailers = False
+        deadline = time.monotonic() + 3.0
+        for ftype, flags, stream, payload in _h2_frames(sock, deadline):
+            if stream != 1:
+                continue
+            if ftype == 1:  # HEADERS
+                if not saw_headers:
+                    saw_headers = True
+                elif flags & 0x1:
+                    saw_trailers = True
+            elif ftype == 0:
+                data += payload
+        assert saw_headers
+        assert len(data) <= window, (
+            f"server sent {len(data)} DATA bytes into a {window}-byte "
+            "window"
+        )
+        assert not saw_trailers
+        # Phase 2: open the stream window; the rest must arrive.
+        sock.sendall(
+            struct.pack(">I", 4)[1:] + bytes([8, 0])
+            + struct.pack(">I", 1) + struct.pack(">I", 1 << 20)
+        )
+        deadline = time.monotonic() + 5.0
+        for ftype, flags, stream, payload in _h2_frames(sock, deadline):
+            if stream != 1:
+                continue
+            if ftype == 0:
+                data += payload
+            elif ftype == 1 and flags & 0x1:
+                saw_trailers = True
+                break
+        assert saw_trailers
+        assert data[0] == 0
+        (ln,) = struct.unpack(">I", data[1:5])
+        resp = pb.GetRateLimitsResp.FromString(data[5 : 5 + ln])
+        assert len(resp.responses) == n_items
+        assert all(r.remaining == 999 for r in resp.responses)
+    finally:
+        sock.close()
+
+
+def test_fast_front_banks_early_window_credit(daemon):
+    """WINDOW_UPDATE arriving BEFORE the response is queued must not
+    be dropped: with a zero initial window the response would
+    otherwise stall forever even though the client already granted
+    credit."""
+    import socket
+    import time
+
+    host, port = daemon.h2_fast_address.rsplit(":", 1)
+    body = pb.GetRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name="ec", unique_key=f"{i}k", hits=1, limit=10,
+                duration=60_000,
+            )
+            for i in range(40)
+        ]
+    ).SerializeToString()
+    sock = socket.create_connection((host, int(port)), timeout=5)
+    try:
+        sock.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+        # SETTINGS: INITIAL_WINDOW_SIZE = 0 — nothing moves on credit
+        # the server forgets.
+        sock.sendall(
+            struct.pack(">I", 6)[1:] + bytes([4, 0])
+            + struct.pack(">I", 0)
+            + struct.pack(">H", 4) + struct.pack(">I", 0)
+        )
+        sock.sendall(
+            struct.pack(">I", 0)[1:] + bytes([1, 4]) + struct.pack(">I", 1)
+        )
+        grpc_frame = b"\x00" + struct.pack(">I", len(body)) + body
+        sock.sendall(
+            struct.pack(">I", len(grpc_frame))[1:] + bytes([0, 1])
+            + struct.pack(">I", 1) + grpc_frame
+        )
+        # Credit granted IMMEDIATELY — likely before the window fires.
+        sock.sendall(
+            struct.pack(">I", 4)[1:] + bytes([8, 0])
+            + struct.pack(">I", 1) + struct.pack(">I", 1 << 20)
+        )
+        data = b""
+        saw_trailers = False
+        deadline = time.monotonic() + 5.0
+        for ftype, flags, stream, payload in _h2_frames(sock, deadline):
+            if stream != 1:
+                continue
+            if ftype == 0:
+                data += payload
+            elif ftype == 1 and flags & 0x1:
+                saw_trailers = True
+                break
+        assert saw_trailers, "response stalled: early credit was dropped"
+        (ln,) = struct.unpack(">I", data[1:5])
+        resp = pb.GetRateLimitsResp.FromString(data[5 : 5 + ln])
+        assert len(resp.responses) == 40
+    finally:
+        sock.close()
+
+
 def test_fast_front_zero_item_request(daemon):
     """A zero-item GetRateLimitsReq must answer empty-OK, not
     INTERNAL(13): the C side passes a NULL out_ptr for an empty

@@ -1,0 +1,394 @@
+"""Decision-ledger conformance: ledger-path decisions must be
+bit-equal to the sequential engine/spec (models/spec.py), and lease
+over-admission under races must stay inside the configured budget.
+
+The harness drives every batch through the SAME partition the serving
+fronts use (ledger.plan → engine lane with settles prepended → learn),
+and the oracle applies the identical rows one at a time through the
+scalar spec.  Covered: lease grant→drain→settle cycles, lease TTL
+expiry mid-stream, the sticky over-limit boundary exactly at reset
+time, RESET_REMAINING/limit-change/duration-change/negative-hit
+bypasses, leaky-bucket exclusion, and concurrent windows racing one
+lease."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core.engine import DecisionEngine, PackedKeys
+from gubernator_tpu.core.ledger import DecisionLedger
+from gubernator_tpu.hashing import fnv1a_64
+from gubernator_tpu.models.spec import SlotState, SpecInput, apply_spec
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+
+class _Dec:
+    """Minimal DecodedBatch stand-in (what wire_codec.decode_reqs
+    produces) built from per-row python values."""
+
+    __slots__ = (
+        "n", "key_buf", "key_offsets", "algo", "behavior", "hits",
+        "limit", "duration", "burst", "fnv1a",
+    )
+
+
+def make_dec(rows):
+    """rows: list of (key_bytes, algo, behavior, hits, limit, duration,
+    burst)."""
+    d = _Dec()
+    n = len(rows)
+    d.n = n
+    keys = [r[0] for r in rows]
+    d.key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=off[1:])
+    d.key_offsets = off
+    d.algo = np.asarray([r[1] for r in rows], np.int32)
+    d.behavior = np.asarray([r[2] for r in rows], np.int32)
+    d.hits = np.asarray([r[3] for r in rows], np.int64)
+    d.limit = np.asarray([r[4] for r in rows], np.int64)
+    d.duration = np.asarray([r[5] for r in rows], np.int64)
+    d.burst = np.asarray([r[6] for r in rows], np.int64)
+    d.fnv1a = np.asarray([fnv1a_64(k) for k in keys], np.uint64)
+    return d
+
+
+class Harness:
+    """Engine + ledger behind the same serve shape the fronts use."""
+
+    def __init__(self, clock, capacity=2048, **ledger_kw):
+        ledger_kw.setdefault("settle_interval", 0)  # deterministic
+        self.clock = clock
+        self.engine = DecisionEngine(capacity=capacity, clock=clock)
+        self.ledger = DecisionLedger(self.engine, **ledger_kw)
+
+    def serve(self, dec):
+        now = self.clock.now_ms()
+        plan = self.ledger.plan(dec, now)
+        if plan.full:
+            return plan.dense_cols()
+        lane = plan.build_engine_lane()
+        st, lim, rem, rst = self.engine.apply_columnar(
+            PackedKeys(lane.key_buf, lane.key_offsets, lane.n),
+            lane.algo, lane.behavior, lane.hits, lane.limit,
+            lane.duration, lane.burst, now_ms=now,
+        )
+        plan.learn(st, lim, rem, rst)
+        # The same reassembly the serving fronts use.
+        return plan.merge_outputs(st, rem, rst)
+
+    def device_view(self, key, limit, duration):
+        """Read the DEVICE state of one key (hits=0 query) bypassing
+        the ledger — what an external racer would observe."""
+        st, lim, rem, rst = self.engine.apply_columnar(
+            [key],
+            np.zeros(1, np.int32), np.zeros(1, np.int32),
+            np.zeros(1, np.int64),
+            np.asarray([limit], np.int64),
+            np.asarray([duration], np.int64),
+            np.zeros(1, np.int64),
+        )
+        return int(st[0]), int(rem[0]), int(rst[0])
+
+
+class SpecOracle:
+    """Sequential scalar-spec application of the same rows."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.states: dict = {}
+
+    def serve(self, rows):
+        now = self.clock.now_ms()
+        out = []
+        for key, algo, behavior, hits, limit, duration, burst in rows:
+            state = self.states.get(key)
+            inp = SpecInput(
+                hits=hits, limit=limit, duration=duration, burst=burst,
+                algorithm=algo, behavior=behavior,
+            )
+            new_state, resp = apply_spec(state, inp, now)
+            if new_state is None:
+                self.states.pop(key, None)
+            else:
+                self.states[key] = new_state
+            out.append(
+                (int(resp.status), int(resp.limit), int(resp.remaining),
+                 int(resp.reset_time))
+            )
+        return out
+
+
+def _check_batch(h, oracle, rows, tag=""):
+    st, lim, rem, rst = h.serve(make_dec(rows))
+    expect = oracle.serve(rows)
+    for i, (es, el, er, et) in enumerate(expect):
+        got = (int(st[i]), int(lim[i]), int(rem[i]), int(rst[i]))
+        assert got == (es, el, er, et), (
+            f"{tag} row {i} key={rows[i][0]!r} hits={rows[i][3]}: "
+            f"ledger={got} spec={(es, el, er, et)}"
+        )
+
+
+def _fuzz(seed, n_batches, batch, n_keys, lease_ttl=0.05, limit_hi=12):
+    rng = np.random.default_rng(seed)
+    clock = Clock().freeze()
+    h = Harness(
+        clock, lease_size=8, lease_ttl=lease_ttl, hot_threshold=2,
+    )
+    oracle = SpecOracle(clock)
+    keys = [b"led_k%d" % i for i in range(n_keys)]
+    limits = rng.integers(0, limit_hi, n_keys)
+    durations = rng.integers(1, 4, n_keys) * 40
+    try:
+        for b in range(n_batches):
+            clock.advance(ms=int(rng.integers(0, 30)))
+            if rng.random() < 0.1:
+                # Occasionally jump past resets / lease TTLs.
+                clock.advance(ms=int(rng.integers(40, 200)))
+            if rng.random() < 0.15:
+                # Config churn: a key's limit or duration changes.
+                j = int(rng.integers(0, n_keys))
+                if rng.random() < 0.5:
+                    limits[j] = int(rng.integers(0, limit_hi))
+                else:
+                    durations[j] = int(rng.integers(1, 4)) * 40
+            rows = []
+            for _ in range(batch):
+                j = int(rng.integers(0, n_keys))
+                algo = (
+                    int(Algorithm.LEAKY_BUCKET)
+                    if rng.random() < 0.1
+                    else int(Algorithm.TOKEN_BUCKET)
+                )
+                behavior = 0
+                r = rng.random()
+                if r < 0.04:
+                    behavior = int(Behavior.RESET_REMAINING)
+                hits = int(rng.integers(0, 4))
+                if rng.random() < 0.05:
+                    hits = int(rng.integers(4, 20))  # over-asks
+                if rng.random() < 0.03:
+                    hits = -int(rng.integers(1, 3))  # leaky refills etc
+                # Nonzero burst values pin that the token path (and so
+                # the ledger, which is token-only) is burst-inert —
+                # settle/acquisition rows carry burst=0 on purpose.
+                burst = int(rng.integers(0, 3)) * 7
+                rows.append(
+                    (keys[j], algo, behavior, hits, int(limits[j]),
+                     int(durations[j]), burst)
+                )
+            _check_batch(h, oracle, rows, tag=f"batch {b}")
+    finally:
+        h.ledger.close()
+    # The fuzz must actually exercise the fast paths.
+    stats = h.ledger.stats()
+    assert stats["answered"] > 0
+    assert stats["leases_granted"] > 0
+
+
+def test_ledger_fuzz_vs_spec_fast():
+    _fuzz(seed=7, n_batches=60, batch=48, n_keys=6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_ledger_fuzz_vs_spec_soak(seed):
+    _fuzz(seed=seed, n_batches=400, batch=96, n_keys=10)
+
+
+@pytest.mark.slow
+def test_ledger_fuzz_long_ttl_soak():
+    # Long TTL: leases persist across many batches; settles happen via
+    # precondition breaks and exhaustion only.
+    _fuzz(seed=11, n_batches=300, batch=64, n_keys=4, lease_ttl=10.0,
+          limit_hi=400)
+
+
+def _hot_rows(key, n, hits=1, limit=1000, duration=60000):
+    return [(key, 0, 0, hits, limit, duration, 0)] * n
+
+
+def test_sticky_over_limit_boundary_at_reset():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=4, hot_threshold=100)
+    oracle = SpecOracle(clock)
+    key = b"svc_sticky"
+    rows = [(key, 0, 0, 3, 3, 1000, 0)]
+    _check_batch(h, oracle, rows)          # consumes to 0
+    _check_batch(h, oracle, rows)          # OVER via engine; entry learned
+    assert h.ledger.stats()["over_entries"] == 1
+    before = h.engine.rounds_total
+    _check_batch(h, oracle, rows)          # answered by the ledger
+    _check_batch(h, oracle, [(key, 0, 0, 0, 3, 1000, 0)])  # query: OVER too
+    assert h.engine.rounds_total == before  # zero device work
+    # Exactly AT the reset the bucket is still live (expire >= now).
+    st0, _, _, rst = h.serve(make_dec(rows))
+    reset_ms = int(rst[0])
+    clock.advance(ms=reset_ms - clock.now_ms())
+    _check_batch(h, oracle, rows, tag="at reset")
+    # One past the reset the item is dead: a fresh bucket, UNDER again.
+    clock.advance(ms=1)
+    _check_batch(h, oracle, rows, tag="past reset")
+    st, _, rem, _ = h.serve(make_dec([(key, 0, 0, 0, 3, 1000, 0)]))
+    assert int(st[0]) == int(Status.UNDER_LIMIT)
+    h.ledger.close()
+
+
+def test_reset_remaining_bypasses_and_revokes():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=16, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"svc_reset"
+    _check_batch(h, oracle, _hot_rows(key, 1, limit=10, duration=5000))
+    _check_batch(h, oracle, _hot_rows(key, 1, limit=10, duration=5000))
+    assert h.ledger.stats()["leases_granted"] == 1
+    _check_batch(h, oracle, _hot_rows(key, 3, limit=10, duration=5000))
+    # RESET_REMAINING must reach the engine (removes the item), with
+    # the lease's consumed credits settled first in the same batch.
+    rows = [(key, 0, int(Behavior.RESET_REMAINING), 1, 10, 5000, 0)]
+    _check_batch(h, oracle, rows, tag="reset-remaining")
+    assert h.ledger.stats()["settles"] >= 1
+    # Post-reset state agrees with the spec.
+    _check_batch(h, oracle, _hot_rows(key, 2, limit=10, duration=5000))
+    h.ledger.close()
+
+
+def test_lease_expiry_mid_stream_settles():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=64, lease_ttl=0.02, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"svc_ttl"
+    for _ in range(4):
+        _check_batch(h, oracle, _hot_rows(key, 2, limit=100, duration=60000))
+    clock.advance(ms=25)  # past the lease TTL, inside the bucket window
+    _check_batch(h, oracle, _hot_rows(key, 2, limit=100, duration=60000),
+                 tag="post-ttl")
+    assert h.ledger.stats()["settles"] >= 1
+    h.ledger.close()
+
+
+def test_background_flush_settles_idle_lease():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=64, lease_ttl=0.02, hot_threshold=1)
+    key = b"svc_idle"
+    h.serve(make_dec(_hot_rows(key, 1, limit=100, duration=60000)))
+    # Second batch: 3 engine hits + the acquisition row debits the full
+    # 64-credit lease up front.
+    h.serve(make_dec(_hot_rows(key, 3, limit=100, duration=60000)))
+    assert h.ledger.stats()["leases_granted"] == 1
+    _, dev_rem, _ = h.device_view(key, 100, 60000)
+    assert dev_rem == 100 - 4 - 64  # hits + pre-debited credit
+    clock.advance(ms=30)  # past the lease TTL: flusher returns unused
+    settled = h.ledger.flush_settles()
+    assert settled == 1
+    _, dev_rem, _ = h.device_view(key, 100, 60000)
+    assert dev_rem == 96  # all 64 unused credits returned
+    h.ledger.close()
+
+
+def test_over_admission_bounded_by_lease_budget():
+    """Leases PRE-DEBIT their credit, so an external racer reading the
+    device mid-lease can never be over-admitted by lease accounting —
+    it sees AT MOST `lease_size` FEWER remaining than the ledger's
+    sequential truth (bounded under-admission, the mirror of GLOBAL's
+    staleness contract), never more."""
+    clock = Clock().freeze()
+    budget = 16
+    h = Harness(clock, lease_size=budget, lease_ttl=10.0, hot_threshold=1)
+    key = b"svc_bound"
+    limit = 1000
+    h.serve(make_dec(_hot_rows(key, 1, limit=limit)))   # counter
+    h.serve(make_dec(_hot_rows(key, 1, limit=limit)))   # grant (debit)
+    for _ in range(200):
+        st, _, rem, _ = h.serve(make_dec(_hot_rows(key, 1, limit=limit)))
+        assert int(st[0]) == int(Status.UNDER_LIMIT)
+        _, dev_rem, _ = h.device_view(key, limit, 60000)
+        ledger_rem = int(rem[0])
+        lag = dev_rem - ledger_rem  # device minus sequential truth
+        assert -budget <= lag <= 0, (dev_rem, ledger_rem)
+    h.ledger.close()
+
+
+def test_concurrent_windows_racing_one_lease():
+    """Threads hammer one leased key concurrently; the total admitted
+    never exceeds limit + lease budget, and the drained bucket ends
+    OVER for everyone."""
+    clock = Clock().freeze()
+    budget = 32
+    limit = 300
+    h = Harness(clock, lease_size=budget, lease_ttl=10.0, hot_threshold=1)
+    key = b"svc_race"
+    admitted = []
+    lock = threading.Lock()
+
+    def worker():
+        mine = 0
+        for _ in range(150):
+            st, _, _, _ = h.serve(make_dec(_hot_rows(key, 1, limit=limit)))
+            if int(st[0]) == int(Status.UNDER_LIMIT):
+                mine += 1
+        with lock:
+            admitted.append(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(admitted)
+    assert total <= limit + budget, total
+    # 4*150 > limit: the tail must be rejected.
+    st, _, rem, _ = h.serve(make_dec(_hot_rows(key, 1, limit=limit)))
+    assert int(st[0]) == int(Status.OVER_LIMIT)
+    h.ledger.close()
+
+
+def test_leaky_rows_never_ledger_answered():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=8, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"svc_leaky"
+    rows = [(key, int(Algorithm.LEAKY_BUCKET), 0, 1, 10, 1000, 0)]
+    for i in range(6):
+        clock.advance(ms=30)
+        _check_batch(h, oracle, rows, tag=f"leaky {i}")
+    assert h.ledger.stats()["answered"] == 0
+    assert h.ledger.stats()["leases_granted"] == 0
+    h.ledger.close()
+
+
+def test_rollback_restores_consumed_credits():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=16, lease_ttl=10.0, hot_threshold=1)
+    key = b"svc_rb"
+    h.serve(make_dec(_hot_rows(key, 1, limit=100)))
+    h.serve(make_dec(_hot_rows(key, 1, limit=100)))  # grant (rem 98)
+    plan = h.ledger.plan(make_dec(_hot_rows(key, 3, limit=100)),
+                         clock.now_ms())
+    assert len(plan.answered_rows) == 3
+    plan.rollback()
+    # The three consumed hits were restored: the next serve sees the
+    # same remaining the spec would.
+    st, _, rem, _ = h.serve(make_dec(_hot_rows(key, 1, limit=100)))
+    assert int(rem[0]) == 97
+    h.ledger.close()
+
+
+def test_invalidate_keys_settles_before_dataclass_path():
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=64, lease_ttl=10.0, hot_threshold=1)
+    key = b"svc_inv"
+    h.serve(make_dec(_hot_rows(key, 1, limit=50)))
+    h.serve(make_dec(_hot_rows(key, 5, limit=50)))  # grant + drain
+    h.serve(make_dec(_hot_rows(key, 5, limit=50)))
+    h.ledger.invalidate_keys([key, b"svc_absent"])
+    # Device now reflects every ledger-admitted hit.
+    _, dev_rem, _ = h.device_view(key, 50, 60000)
+    assert dev_rem == 50 - 11
+    assert h.ledger.stats()["entries"] >= 1  # counter remains
+    h.ledger.close()
