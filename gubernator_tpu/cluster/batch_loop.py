@@ -128,7 +128,7 @@ class IntervalBatcher(Generic[K, V]):
         # them (safe for supersedable traffic like status broadcasts).
         self._max_pending = max_pending
         self._overflow = overflow
-        self.dropped = 0
+        self.dropped = 0  # guberlint: guarded-by _lock
         self._combine = combine
         self._flush = flush
         self._wait_stat = wait_stat
@@ -138,10 +138,11 @@ class IntervalBatcher(Generic[K, V]):
         # column slices in O(1) instead of per-item dict merges, and
         # the flush thread does the per-key work off the serving path.
         self._chunked = chunked
-        self._items: Dict[K, V] = {}
-        self._chunks: list = []
-        self._chunk_count = 0
-        self._oldest_ts = 0.0  # arrival of the oldest queued item
+        self._items: Dict[K, V] = {}  # guberlint: guarded-by _lock
+        self._chunks: list = []  # guberlint: guarded-by _lock
+        self._chunk_count = 0  # guberlint: guarded-by _lock
+        # Arrival of the oldest queued item.
+        self._oldest_ts = 0.0  # guberlint: guarded-by _lock
         self._lock = threading.Lock()
         # Flush ORDERING without blocking producers: each snapshot
         # takes a turn number under the queue lock; flushes then run
@@ -150,12 +151,15 @@ class IntervalBatcher(Generic[K, V]):
         # flush (a later flush_now snapshot broadcasting before an
         # older batcher snapshot would regress peer caches).
         self._turn_cv = threading.Condition(threading.Lock())
-        self._next_turn = 0  # next turn number to hand out
-        self._done_turn = 0  # turns fully flushed (ordered mode)
-        self._active_turns: set = set()  # in-flight turns (pooled mode)
+        # Next turn number to hand out.
+        self._next_turn = 0  # guberlint: guarded-by _turn_cv
+        # Turns fully flushed (ordered mode).
+        self._done_turn = 0  # guberlint: guarded-by _turn_cv
+        # In-flight turns (pooled mode).
+        self._active_turns: set = set()  # guberlint: guarded-by _turn_cv
         self._cv = threading.Condition(self._lock)
         self._space = threading.Condition(self._lock)  # drain freed room
-        self._closing = False
+        self._closing = False  # guberlint: guarded-by _lock
         # flush_workers > 0: flushes hop to a bounded pool so the NEXT
         # window opens while this flush's RPCs are still in flight —
         # the batching cadence overlaps the network instead of
@@ -253,7 +257,11 @@ class IntervalBatcher(Generic[K, V]):
         batcher is non-adaptive) — metrics gauge + tests."""
         if self._adaptive is None:
             return self.sync_wait
-        return self._adaptive.next_wait()
+        # Under the queue lock: AdaptiveWait state is owned by the
+        # batcher thread's drain (observe() runs under _lock), so an
+        # unlocked scrape could read mid-update EWMA state.
+        with self._lock:
+            return self._adaptive.next_wait()
 
     def add_many(self, pairs) -> None:
         """Batch enqueue under ONE lock acquisition — a 1000-item wire
@@ -325,6 +333,9 @@ class IntervalBatcher(Generic[K, V]):
             except Exception:  # noqa: BLE001 — loop must survive flush errors
                 import logging
 
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("batcher.flush")
                 logging.getLogger("gubernator_tpu").exception(
                     "batcher flush failed"
                 )
@@ -452,6 +463,9 @@ class IntervalBatcher(Generic[K, V]):
         except Exception:  # noqa: BLE001 — pool must survive flush errors
             import logging
 
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("batcher.flush_pooled")
             logging.getLogger("gubernator_tpu").exception(
                 "batcher flush failed"
             )

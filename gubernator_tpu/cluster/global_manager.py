@@ -66,8 +66,8 @@ class GlobalManager:
         # tiny lock: hits flushes run CONCURRENTLY on the flush pool,
         # and `x += 1` is not atomic across bytecodes.
         self._counter_lock = threading.Lock()
-        self.async_sends = 0
-        self.broadcasts = 0
+        self.async_sends = 0  # guberlint: guarded-by _counter_lock
+        self.broadcasts = 0  # guberlint: guarded-by _counter_lock
         # Apply-order sequence for serve-time update chunks
         # (next_update_seq; itertools.count.__next__ is atomic).
         import itertools
@@ -699,6 +699,9 @@ class GlobalManager:
             try:
                 f.result()
             except Exception:  # noqa: BLE001 — peers must not sink peers
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("global.fanout")
                 log.exception("global fan-out task failed")
 
     def _reread_encoded(self, updates: Dict[str, RateLimitReq]):

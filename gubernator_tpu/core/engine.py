@@ -339,7 +339,7 @@ class DecisionEngine:
         self.table = make_intern_table(capacity)
         self.store = store
         with jax.default_device(device) if device else nullcontext():
-            self._state: BucketState = make_state(capacity)
+            self._state: BucketState = make_state(capacity)  # guberlint: guarded-by _lock
             # Reusable no-op clear argument for apply_batch (all lanes
             # out of range — real clears run via clear_occupied).
             self._noop_clear = jnp.asarray(
@@ -348,7 +348,8 @@ class DecisionEngine:
         # RLock: PumpTicket.fetch may flush from a thread already
         # inside the engine (dataclass-path dispatch fetches inline).
         self._lock = threading.RLock()
-        self._sweep_cursor = 0  # next window start for incremental sweep
+        # Next window start for incremental sweep.
+        self._sweep_cursor = 0  # guberlint: guarded-by _lock
         # ONE device op per round when XLA compiles the donated
         # gather→update→scatter in place; otherwise the split pair
         # (packed_compute + scatter_store, two ops) — probed once per
@@ -377,10 +378,10 @@ class DecisionEngine:
             self._pump = None
         # Metrics (reference: gubernator.go:59-113 catalog; wired to
         # prometheus in gubernator_tpu.utils.metrics).
-        self.requests_total = 0
-        self.over_limit_total = 0
-        self.batches_total = 0
-        self.rounds_total = 0
+        self.requests_total = 0  # guberlint: guarded-by _lock
+        self.over_limit_total = 0  # guberlint: guarded-by _lock
+        self.batches_total = 0  # guberlint: guarded-by _lock
+        self.rounds_total = 0  # guberlint: guarded-by _lock
         from gubernator_tpu.utils.metrics import DurationStat
 
         self.round_duration = DurationStat()
@@ -434,6 +435,7 @@ class DecisionEngine:
             self.batches_total += 1
         return responses  # type: ignore[return-value]
 
+    # guberlint: holds _lock
     def _apply_valid(
         self,
         requests: Sequence[RateLimitReq],
@@ -542,7 +544,7 @@ class DecisionEngine:
                 requests, valid_idx, greg_dur, now_ms, responses, host_expire
             )
 
-    def _dispatch(self, buf: np.ndarray, fused_fn, compute_fn):
+    def _dispatch(self, buf: np.ndarray, fused_fn, compute_fn):  # guberlint: holds _lock
         """One device round: single h2d of the packed buffer, then the
         fused donated kernel (or the split compute + scatter pair);
         returns the packed output (caller starts the async readback)."""
@@ -564,7 +566,7 @@ class DecisionEngine:
         self._flush_pump()
         return self._dispatch(buf, collapsed_step, collapsed_compute)
 
-    def _dispatch_uniform(self, buf: np.ndarray):
+    def _dispatch_uniform(self, buf: np.ndarray):  # guberlint: holds _lock
         """Narrow uniform-batch step (pump-only: requires the fused
         in-place program family)."""
         import time as _time
@@ -586,7 +588,7 @@ class DecisionEngine:
         if self._pump is not None:
             self._pump.flush_locked()
 
-    def _apply_clears(self, cleared: np.ndarray) -> None:
+    def _apply_clears(self, cleared: np.ndarray) -> None:  # guberlint: holds _lock
         """Eviction clears: a separate tiny scatter so the apply
         kernel's compiled shapes never depend on eviction pressure."""
         self._flush_pump()
@@ -599,7 +601,7 @@ class DecisionEngine:
             meta=clear_occupied(self._state.meta, jnp.asarray(c))
         )
 
-    def _apply_restores(self, restores: List[tuple]) -> None:
+    def _apply_restores(self, restores: List[tuple]) -> None:  # guberlint: holds _lock
         """Hydrate store-provided bucket values into fresh slots —
         one batched device scatter (see build_restore_record)."""
         self._flush_pump()
@@ -628,6 +630,7 @@ class DecisionEngine:
             {i: int(host_expire[j]) for j, i in enumerate(valid_idx)},
         )
 
+    # guberlint: holds _lock
     def _run_round(
         self,
         requests: Sequence[RateLimitReq],
@@ -908,6 +911,7 @@ class DecisionEngine:
             return None
         return (a0, b0, h0, l0, d0, u0)
 
+    # guberlint: holds _lock
     def _dispatch_rounds(
         self, slots, rounds_arr, max_round, algo, behavior, hits, limit,
         duration, burst, greg_dur, greg_exp, now_ms, evicted,
@@ -1008,6 +1012,7 @@ class DecisionEngine:
                     pieces.append((ticket, dst_idx, m, size))
         return pieces
 
+    # guberlint: holds _lock
     def _collapse_dataclass(
         self,
         requests: Sequence[RateLimitReq],
@@ -1075,6 +1080,7 @@ class DecisionEngine:
         self.over_limit_total += over  # rounds_total counted per piece
         return True
 
+    # guberlint: holds _lock
     def _try_collapse(
         self, slots, algo, behavior, hits, limit, duration, burst,
         greg_dur, greg_exp, now_ms, evicted, evict_rounds,
@@ -1265,112 +1271,118 @@ class DecisionEngine:
         1024) and every eviction-clear width, so no client request pays
         an XLA compile.  Warmup keys expire after 1ms, a sweep reclaims
         their slots, and metric counters are restored afterwards."""
-        saved = (
-            self.requests_total,
-            self.batches_total,
-            self.rounds_total,
-            self.table.hits,
-            self.table.misses,
-        )
-        # Warmup traffic must not reach a write-through Store (it would
-        # persist junk __warmup__ keys and pay external round-trips).
-        saved_store, self.store = self.store, None
-        try:
-            now = self.clock.now_ms()
-            width = 64
-            while width <= max_width:
-                reqs = [
-                    RateLimitReq(
-                        name="__warmup__",
-                        unique_key=str(i),
-                        hits=0,
-                        limit=1,
-                        duration=1,
-                    )
-                    for i in range(width)
-                ]
-                self.get_rate_limits(reqs, now_ms=now)
-                width *= 2
-            # Columnar-kernel ladder: the wire/bench fast path runs the
-            # packed columnar step, a DIFFERENT jitted program than
-            # apply_batch — without this ladder the first served
-            # columnar batch pays an XLA compile that can exceed the
-            # peer batch timeout ("timeout waiting for batched
-            # response").
-            width = 64
-            while width <= max_width:
-                self.apply_columnar(
-                    [b"__warmup___%d" % i for i in range(width)],
-                    np.zeros(width, dtype=_I32),
-                    np.zeros(width, dtype=_I32),
-                    np.zeros(width, dtype=_I64),  # hits=0: report-only
-                    np.ones(width, dtype=_I64),
-                    np.ones(width, dtype=_I64),
-                    np.zeros(width, dtype=_I64),
-                    now_ms=now,
-                )
-                # Duplicate keys → the collapsed-segment program (a
-                # separate compile family from the packed step).
-                self.apply_columnar(
-                    [b"__warmup__dup" for _ in range(width)],
-                    np.zeros(width, dtype=_I32),
-                    np.zeros(width, dtype=_I32),
-                    np.zeros(width, dtype=_I64),
-                    np.ones(width, dtype=_I64),
-                    np.ones(width, dtype=_I64),
-                    np.zeros(width, dtype=_I64),
-                    now_ms=now,
-                )
-                width *= 2
-            # Clear-scatter ladder (no-op out-of-range slots).
-            csize = 16
-            while csize <= max_width:
-                dummy = jnp.asarray(
-                    np.arange(self.capacity, self.capacity + csize, dtype=np.int64).astype(_I32)
-                )
-                self._state = self._state._replace(
-                    meta=clear_occupied(self._state.meta, dummy)
-                )
-                csize *= 2
-            # Readback-combiner stack ladder: concurrent/pipelined
-            # callers share one stacked d2h transfer; precompile the
-            # stack programs per output width (core/readback.py).
-            from gubernator_tpu.ops.bucket_kernel import PACKED_OUT_ROWS
-
-            width = 64
-            while width <= max_width:
-                self.readback.warmup_stacks((PACKED_OUT_ROWS, width), jnp.int32)
-                width *= 2
-            # Step-pump scan ladder: fused multi-round programs per
-            # width (core/pump.py) — the serving path under concurrent
-            # load groups cross-call rounds into these.
-            if self._pump is not None:
-                width = 64
-                while width <= max_width:
-                    self._pump.warmup(width)
-                    width *= 2
-            self.sweep(now_ms=now + 2)
-            (
+        # Under the engine lock end-to-end: warmup mutates _state
+        # (clear-scatter ladder, pump scans) and restores counters;
+        # the RLock keeps the nested get_rate_limits/apply_columnar/
+        # sweep calls re-entrant.  Serving traffic that arrives mid-
+        # warmup simply queues behind it.
+        with self._lock:
+            saved = (
                 self.requests_total,
                 self.batches_total,
                 self.rounds_total,
-                saved_hits,
-                saved_misses,
-            ) = saved
-            if hasattr(self.table, "discount_stats"):
-                # The native table mirrors cumulative C++ counters on
-                # every schedule(); plain attribute restore would be
-                # overwritten by the next mirror, so register discounts
-                # instead.
-                self.table.discount_stats(
-                    self.table.hits - saved_hits, self.table.misses - saved_misses
-                )
-            else:
-                self.table.hits, self.table.misses = saved_hits, saved_misses
-        finally:
-            # Exception-safety: a failed warmup (wedged backend,
-            # compile error) must not leave persistence disabled.
-            self.store = saved_store
+                self.table.hits,
+                self.table.misses,
+            )
+            # Warmup traffic must not reach a write-through Store (it would
+            # persist junk __warmup__ keys and pay external round-trips).
+            saved_store, self.store = self.store, None
+            try:
+                now = self.clock.now_ms()
+                width = 64
+                while width <= max_width:
+                    reqs = [
+                        RateLimitReq(
+                            name="__warmup__",
+                            unique_key=str(i),
+                            hits=0,
+                            limit=1,
+                            duration=1,
+                        )
+                        for i in range(width)
+                    ]
+                    self.get_rate_limits(reqs, now_ms=now)
+                    width *= 2
+                # Columnar-kernel ladder: the wire/bench fast path runs the
+                # packed columnar step, a DIFFERENT jitted program than
+                # apply_batch — without this ladder the first served
+                # columnar batch pays an XLA compile that can exceed the
+                # peer batch timeout ("timeout waiting for batched
+                # response").
+                width = 64
+                while width <= max_width:
+                    self.apply_columnar(
+                        [b"__warmup___%d" % i for i in range(width)],
+                        np.zeros(width, dtype=_I32),
+                        np.zeros(width, dtype=_I32),
+                        np.zeros(width, dtype=_I64),  # hits=0: report-only
+                        np.ones(width, dtype=_I64),
+                        np.ones(width, dtype=_I64),
+                        np.zeros(width, dtype=_I64),
+                        now_ms=now,
+                    )
+                    # Duplicate keys → the collapsed-segment program (a
+                    # separate compile family from the packed step).
+                    self.apply_columnar(
+                        [b"__warmup__dup" for _ in range(width)],
+                        np.zeros(width, dtype=_I32),
+                        np.zeros(width, dtype=_I32),
+                        np.zeros(width, dtype=_I64),
+                        np.ones(width, dtype=_I64),
+                        np.ones(width, dtype=_I64),
+                        np.zeros(width, dtype=_I64),
+                        now_ms=now,
+                    )
+                    width *= 2
+                # Clear-scatter ladder (no-op out-of-range slots).
+                csize = 16
+                while csize <= max_width:
+                    dummy = jnp.asarray(
+                        np.arange(self.capacity, self.capacity + csize, dtype=np.int64).astype(_I32)
+                    )
+                    self._state = self._state._replace(
+                        meta=clear_occupied(self._state.meta, dummy)
+                    )
+                    csize *= 2
+                # Readback-combiner stack ladder: concurrent/pipelined
+                # callers share one stacked d2h transfer; precompile the
+                # stack programs per output width (core/readback.py).
+                from gubernator_tpu.ops.bucket_kernel import PACKED_OUT_ROWS
+
+                width = 64
+                while width <= max_width:
+                    self.readback.warmup_stacks((PACKED_OUT_ROWS, width), jnp.int32)
+                    width *= 2
+                # Step-pump scan ladder: fused multi-round programs per
+                # width (core/pump.py) — the serving path under concurrent
+                # load groups cross-call rounds into these.
+                if self._pump is not None:
+                    width = 64
+                    while width <= max_width:
+                        self._pump.warmup(width)
+                        width *= 2
+                self.sweep(now_ms=now + 2)
+                (
+                    self.requests_total,
+                    self.batches_total,
+                    self.rounds_total,
+                    saved_hits,
+                    saved_misses,
+                ) = saved
+                if hasattr(self.table, "discount_stats"):
+                    # The native table mirrors cumulative C++ counters on
+                    # every schedule(); plain attribute restore would be
+                    # overwritten by the next mirror, so register discounts
+                    # instead.
+                    self.table.discount_stats(
+                        self.table.hits - saved_hits, self.table.misses - saved_misses
+                    )
+                else:
+                    self.table.hits, self.table.misses = saved_hits, saved_misses
+            finally:
+                # Exception-safety: a failed warmup (wedged backend,
+                # compile error) must not leave persistence disabled.
+                self.store = saved_store
 
     def cache_size(self) -> int:
         return len(self.table)

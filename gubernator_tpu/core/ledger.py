@@ -405,22 +405,24 @@ class DecisionLedger:
             ).parameters
         except (TypeError, ValueError):  # builtins / odd callables
             self._count_kw = False
-        self._items: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._items: "OrderedDict[int, _Entry]" = OrderedDict()  # guberlint: guarded-by _lock
         # OVER/LEASE entries indexed by key bytes — the dataclass-path
         # invalidation hook must be O(1) per key with zero hashing.
-        self._key_index: Dict[bytes, int] = {}
+        self._key_index: Dict[bytes, int] = {}  # guberlint: guarded-by _lock
         # Revoked-but-unapplied returns keyed by fnv1a: a plan for the
         # same key pulls its return into the synchronous batch; the
         # flusher drains the rest.
-        self._pending: Dict[int, tuple] = {}
+        self._pending: Dict[int, tuple] = {}  # guberlint: guarded-by _lock
         self._lock = threading.Lock()
         # Counters (exported via utils.metrics + bench artifacts).
-        self.answered = 0
-        self.fallthrough = 0
-        self.leases_granted = 0
-        self.leases_revoked = 0
-        self.settles = 0
-        self.over_entries = 0
+        # _Entry fields ride the same lock: entries are only reachable
+        # through _items, and every traversal holds it.
+        self.answered = 0  # guberlint: guarded-by _lock
+        self.fallthrough = 0  # guberlint: guarded-by _lock
+        self.leases_granted = 0  # guberlint: guarded-by _lock
+        self.leases_revoked = 0  # guberlint: guarded-by _lock
+        self.settles = 0  # guberlint: guarded-by _lock
+        self.over_entries = 0  # guberlint: guarded-by _lock
         from gubernator_tpu.utils.metrics import DurationStat
 
         self.settle_lag = DurationStat()
@@ -869,6 +871,9 @@ class DecisionLedger:
             try:
                 self.flush_settles()
             except Exception:  # noqa: BLE001 — settling must not die
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("ledger.settle_flush")
                 log.exception("ledger settle flush failed")
 
     def flush_settles(self) -> int:
@@ -928,6 +933,9 @@ class DecisionLedger:
                 else:
                     engine.apply_columnar(*cols)
             except Exception:  # noqa: BLE001
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("ledger.return_apply")
                 log.exception("ledger return apply failed (%d rows)", m)
                 continue
             with self._lock:

@@ -4,6 +4,14 @@ No pybind11/cffi-compile step: plain C ABI + ctypes.  The .so is built
 on demand next to the source and cached by source hash, so a fresh
 checkout self-builds on first use (~1s) and rebuilds only when the
 source changes.  Set GUBERNATOR_TPU_NATIVE=0 to skip native entirely.
+
+Sanitizer mode (guberlint's native runtime companion —
+STATIC_ANALYSIS.md): GUBER_NATIVE_SAN=thread|address (or =1 for
+thread) compiles with -fsanitize and a separate cache tag.  A
+sanitizer runtime cannot initialize when dlopen'd into an
+uninstrumented python, so instrumented .so's are meant for SUBPROCESS
+tests that LD_PRELOAD the runtime (see sanitizer_preload() and
+tests/test_h2_server_san.py), not for in-process serving.
 """
 
 from __future__ import annotations
@@ -21,13 +29,46 @@ _NATIVE_DIR = Path(__file__).parent / "native"
 _BUILD_DIR = _NATIVE_DIR / "build"
 
 
+def san_mode() -> str:
+    """'' (off), 'thread', or 'address' — from GUBER_NATIVE_SAN."""
+    v = os.environ.get("GUBER_NATIVE_SAN", "").strip().lower()
+    if v in ("", "0", "off", "none"):
+        return ""
+    if v in ("1", "thread", "tsan"):
+        return "thread"
+    if v in ("address", "asan"):
+        return "address"
+    log.warning("GUBER_NATIVE_SAN=%r not recognized; sanitizer off", v)
+    return ""
+
+
+def sanitizer_preload(mode: Optional[str] = None) -> Optional[str]:
+    """Path to the sanitizer runtime to LD_PRELOAD into a subprocess
+    running an instrumented .so, or None when unavailable."""
+    mode = san_mode() if mode is None else mode
+    if not mode:
+        return None
+    lib = {"thread": "libtsan.so", "address": "libasan.so"}[mode]
+    try:
+        out = subprocess.run(
+            ["g++", f"-print-file-name={lib}"],
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+        return None
+    return out if out and os.path.sep in out and Path(out).exists() else None
+
+
 def ensure_built(stem: str = "intern_table") -> Optional[Path]:
     """Compile `native/<stem>.cpp` if needed; returns the .so path or
     None on failure."""
     if os.environ.get("GUBERNATOR_TPU_NATIVE", "1") == "0":
         return None
+    san = san_mode()
     src = _NATIVE_DIR / f"{stem}.cpp"
     tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    if san:
+        tag = f"{tag}-{san[0]}san"
     so = _BUILD_DIR / f"{stem}-{tag}.so"
     if so.exists():
         return so
@@ -37,11 +78,17 @@ def ensure_built(stem: str = "intern_table") -> Optional[Path]:
     # newer CPU would SIGILL elsewhere (ctypes can't catch signals).
     cmd = [
         "g++",
-        "-O3",
+        # Sanitized builds keep frames/symbols and dial optimization
+        # back so TSan/ASan reports carry usable stacks.
+        "-O1" if san else "-O3",
         "-std=c++17",
         "-shared",
         "-fPIC",
         "-pthread",
+    ]
+    if san:
+        cmd += [f"-fsanitize={san}", "-g", "-fno-omit-frame-pointer"]
+    cmd += [
         "-o",
         str(tmp),
         str(src),
@@ -58,8 +105,17 @@ def ensure_built(stem: str = "intern_table") -> Optional[Path]:
         )
         return None
     os.replace(tmp, so)
-    # Drop stale builds of older source versions.
+    # Drop stale builds of older source versions — within the same
+    # variant only (a plain build must not evict a sanitized .so, nor
+    # tsan an asan one, and vice versa).
+    suffix = f"-{san[0]}san.so" if san else ".so"
     for old in _BUILD_DIR.glob(f"{stem}-*.so"):
-        if old != so:
+        if old == so:
+            continue
+        if san:
+            stale = old.name.endswith(suffix)
+        else:
+            stale = not old.name.endswith(("-tsan.so", "-asan.so"))
+        if stale:
             old.unlink(missing_ok=True)
     return so

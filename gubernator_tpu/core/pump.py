@@ -83,7 +83,13 @@ class PumpTicket:
 
 
 class StepPump:
-    """Per-engine queue of packed rounds awaiting a fused dispatch."""
+    """Per-engine queue of packed rounds awaiting a fused dispatch.
+
+    Shared state rides the ENGINE's RLock (dispatch order = queue
+    order is exactly the engine's serialization):
+    """
+
+    # guberlint: guard _queue, _noop, submitted, flushes, fused_rounds by engine._lock
 
     def __init__(self, engine, max_group: int = MAX_GROUP) -> None:
         import jax
@@ -112,7 +118,7 @@ class StepPump:
 
     # -- engine-lock-held API ------------------------------------------
 
-    def submit(self, buf: np.ndarray) -> PumpTicket:
+    def submit(self, buf: np.ndarray) -> PumpTicket:  # guberlint: holds engine._lock
         """Queue one packed [PACKED_IN_ROWS, W] round.  Caller holds
         the engine lock (dispatch order = queue order)."""
         t = PumpTicket(self, buf)
@@ -122,7 +128,7 @@ class StepPump:
             self.flush_locked()
         return t
 
-    def flush_locked(self) -> None:
+    def flush_locked(self) -> None:  # guberlint: holds engine._lock
         """Dispatch everything queued, in order, grouping maximal runs
         of equal shape (width AND format: the 16-row general and 2-row
         uniform buffers run different programs).  Caller holds the
@@ -153,7 +159,7 @@ class StepPump:
 
     # -- leader path (engine lock held) --------------------------------
 
-    def _noop_buf(self, shape) -> np.ndarray:
+    def _noop_buf(self, shape) -> np.ndarray:  # guberlint: holds engine._lock
         buf = self._noop.get(shape)
         if buf is None:
             from gubernator_tpu.ops.bucket_kernel import (
@@ -178,7 +184,7 @@ class StepPump:
             self._noop[shape] = buf
         return buf
 
-    def _flush_group(self, group: List[PumpTicket]) -> None:
+    def _flush_group(self, group: List[PumpTicket]) -> None:  # guberlint: holds engine._lock
         from gubernator_tpu.ops.bucket_kernel import (
             UNIFORM_IN_ROWS,
             multi_fused_step,
@@ -233,7 +239,7 @@ class StepPump:
 
     # -- warmup --------------------------------------------------------
 
-    def warmup(self, width: int) -> None:
+    def warmup(self, width: int) -> None:  # guberlint: holds engine._lock
         """Precompile the multi-step scan families {2,4,8,16} at one
         width — general AND uniform formats — plus the single uniform
         step (engine warmup calls this per ladder width).

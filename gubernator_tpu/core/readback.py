@@ -62,14 +62,18 @@ class ReadbackCombiner:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._queue: List[Ticket] = []
-        self._draining = False
+        self._queue: List[Ticket] = []  # guberlint: guarded-by _lock
+        self._draining = False  # guberlint: guarded-by _lock
+        # Program cache: deliberately unguarded — concurrent leaders
+        # may race-build the same stack program; dict assignment is
+        # atomic and last-wins costs one duplicate compile (warmup
+        # precompiles the whole universe anyway).
         self._stack_cache: Dict[Tuple, object] = {}
         # Telemetry (PERF.md): transfer RPCs saved = registered -
         # transfers.
-        self.registered = 0
-        self.transfers = 0
-        self.stacked = 0
+        self.registered = 0  # guberlint: guarded-by _lock
+        self.transfers = 0  # guberlint: guarded-by _lock
+        self.stacked = 0  # guberlint: guarded-by _lock
 
     def register(self, handle) -> Ticket:
         """Called at dispatch time (engine lock held is fine — this
@@ -90,6 +94,9 @@ class ReadbackCombiner:
             # by draining the oldest group on their behalf — OFF this
             # thread, which may hold the engine lock (a blocking d2h
             # here would stall every serving thread for the RPC).
+            # guberlint: ok thread — one-shot bounded drain (a single
+            # d2h RPC); completion is tracked by _draining under _lock,
+            # and at most one is in flight at a time.
             threading.Thread(
                 target=self._drain_detached,
                 name="guber-readback-drain",
@@ -110,6 +117,7 @@ class ReadbackCombiner:
         key = (count, tuple(shape), str(dtype))
         prog = self._stack_cache.get(key)
         if prog is None:
+            # guberlint: shapes fan-in/shape/dtype pinned by the cache key; universe {widths} x {2,4,8,16}, precompiled in warmup_stacks
             prog = jax.jit(lambda *xs: jnp.stack(xs))
             self._stack_cache[key] = prog
         return prog
@@ -173,7 +181,11 @@ class ReadbackCombiner:
 
     def _materialize_inner(self, group: List[Ticket]) -> None:
         k = len(group)
-        self.transfers += 1
+        with self._lock:
+            # Concurrent leaders (different shape groups) materialize
+            # in parallel: unlocked `+= 1` here lost increments and
+            # under-reported the RPC savings PERF.md is based on.
+            self.transfers += 1
         if k == 1:
             group[0].host = np.asarray(group[0].handle)
             group[0].handle = None
@@ -190,7 +202,8 @@ class ReadbackCombiner:
         )
         stacked = prog(*handles)
         host = np.asarray(stacked)  # ONE transfer for the whole group
-        self.stacked += k
+        with self._lock:
+            self.stacked += k
         for i, t in enumerate(group):
             t.host = host[i]
             t.handle = None

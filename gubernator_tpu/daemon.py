@@ -147,6 +147,12 @@ class Daemon:
     def start(self) -> None:
         """reference: daemon.go:82-339 (Daemon.Start)."""
         conf = self.conf
+        # Count XLA compiles from before the first engine build so the
+        # gubernator_jit_recompiles metric covers warmup too; a healthy
+        # daemon's count is flat after start() returns.
+        from gubernator_tpu.utils import jit_guard
+
+        jit_guard.install()
         self._probe_backend()
         engine = self._build_engine()
         self._warmup(engine)
@@ -297,6 +303,9 @@ class Daemon:
                     max_windows=self.SWEEP_WINDOWS_PER_TICK
                 )
             except Exception:  # noqa: BLE001 — sweeping must not die
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("daemon.sweep")
                 log.exception("expiry sweep failed")
 
     def _warmup(self, engine) -> None:
@@ -430,6 +439,9 @@ class Daemon:
         self._closed = True
         if getattr(self, "_sweep_stop", None) is not None:
             self._sweep_stop.set()
+            # A sweep tick may be mid-flight inside engine.sweep();
+            # join before tearing the engine down under it.
+            self._sweeper.join(timeout=5.0)
         if self._discovery is not None:
             self._discovery.close()
         if getattr(self, "h2_fast", None) is not None:

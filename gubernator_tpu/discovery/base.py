@@ -30,6 +30,9 @@ class DiscoveryBase:
         try:
             self.daemon.set_peers(peers)
         except Exception:  # noqa: BLE001 — discovery must survive pushes
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("discovery.set_peers")
             log.exception("SetPeers from discovery failed")
 
     def start(self) -> None:

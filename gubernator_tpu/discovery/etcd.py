@@ -138,6 +138,9 @@ class EtcdPool(DiscoveryBase):
                 if self._lease is not None:
                     self._lease.refresh()
             except Exception:  # noqa: BLE001
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("discovery.etcd_keepalive")
                 log.exception("etcd lease refresh failed; re-registering")
                 try:
                     self._register()
@@ -172,6 +175,10 @@ class EtcdPool(DiscoveryBase):
 
     def close(self) -> None:
         super().close()
+        # The keepalive loop wakes on the _closed event; reap it so a
+        # lease refresh can't race the deregister below.
+        if self._keepalive.is_alive():
+            self._keepalive.join(timeout=2.0)
         try:
             if self._watch_id is not None:
                 self._client.cancel_watch(self._watch_id)

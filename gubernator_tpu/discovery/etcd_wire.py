@@ -321,6 +321,10 @@ class MiniEtcdServer:
     def stop(self) -> None:
         self._closed.set()
         self._server.stop(grace=0.5).wait()
+        # The sweeper wakes on the _closed event; reap it so tests
+        # can assert no mini-etcd threads outlive stop().
+        if self._sweeper.is_alive():
+            self._sweeper.join(timeout=2.0)
 
     # -- core state ----------------------------------------------------
 
@@ -520,11 +524,16 @@ class MiniEtcdServer:
                                     )
                                 )
             except Exception:  # noqa: BLE001 — client went away
-                pass
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("etcd_wire.watch_reader")
             finally:
                 done.set()
                 out.put(None)
 
+        # guberlint: ok thread — reader exits when the client's request
+        # stream ends; completion is signaled via `done` + the None
+        # sentinel, and the generator's finally deregisters watchers.
         t = threading.Thread(
             target=reader, name="mini-etcd-watch-reader", daemon=True
         )

@@ -105,6 +105,9 @@ class K8sPool(DiscoveryBase):
                         return
                     self.on_update(self._list_peers())
             except Exception:  # noqa: BLE001
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("discovery.k8s_watch")
                 log.exception("k8s watch failed; retrying")
                 self._closed.wait(2.0)
 

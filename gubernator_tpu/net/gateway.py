@@ -163,4 +163,8 @@ class Gateway:
 
     def close(self) -> None:
         self._server.shutdown()
+        # serve_forever returns after shutdown(); reap the thread so
+        # the socket close below never races a final accept.
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
         self._server.server_close()

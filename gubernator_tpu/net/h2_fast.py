@@ -178,6 +178,9 @@ class H2FastFront:
                 i_off += k
             return 0
         except Exception:  # noqa: BLE001 — never unwind into C
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("h2_fast.window")
             log.exception("h2 fast window failed")
             return 13  # INTERNAL
 

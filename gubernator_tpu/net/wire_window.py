@@ -254,6 +254,9 @@ class WireWindow:
                 lo = hi
         except Exception:  # noqa: BLE001
             # Callers fall back to the protobuf path on None.
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("wire_window.apply")
             log.exception("wire window apply failed; callers fall back")
             for e in batch:
                 e.result = None

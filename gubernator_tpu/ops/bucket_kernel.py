@@ -322,6 +322,7 @@ def split_remf(v: jax.Array) -> tuple[jax.Array, jax.Array]:
     return wc.astype(_I32), ((v - w) * (2.0**32)).astype(_U32)
 
 
+# guberlint: shapes meta [capacity] fixed at engine build; slots [C], C in the pow2 clear ladder (warmup)
 def _clear_occupied_impl(meta: jax.Array, slots: jax.Array) -> jax.Array:
     """Mark evicted slots unoccupied (host eviction executed on device).
 
@@ -348,6 +349,7 @@ def _clear_occupied_impl(meta: jax.Array, slots: jax.Array) -> jax.Array:
 clear_occupied = jax.jit(_clear_occupied_impl, donate_argnums=(0,))
 
 
+# guberlint: shapes state fixed at capacity; batch lanes padded to the pow2 width ladder (warmup 64..1024)
 def _apply_batch_impl(
     state: BucketState,
     batch: BatchInput,
@@ -696,6 +698,7 @@ class SlotValues(NamedTuple):
     burst: jax.Array  # int64
 
 
+# guberlint: shapes state fixed at capacity; slot/vals [W] on the same pow2 width ladder as the compute step
 def _scatter_values(
     state: BucketState, slot: jax.Array, vals: SlotValues
 ) -> BucketState:
@@ -761,6 +764,7 @@ scatter_store = jax.jit(_scatter_values, donate_argnums=(0,))
 apply_batch = jax.jit(_apply_batch_impl, donate_argnums=(0,))
 
 
+# guberlint: shapes state fixed at capacity; batch lanes padded to the pow2 width ladder (warmup 64..1024)
 def _apply_batch_sorted_impl(
     state: BucketState,
     batch: BatchInput,  # lanes PRE-SORTED by slot ascending (host sorts)
@@ -797,6 +801,7 @@ def _apply_batch_sorted_impl(
 apply_batch_sorted = jax.jit(_apply_batch_sorted_impl, donate_argnums=(0,))
 
 
+# guberlint: shapes state fixed at capacity; batch lanes padded to the pow2 width ladder (warmup 64..1024)
 def _compute_update_sorted_impl(
     state: BucketState,
     batch: BatchInput,  # lanes PRE-SORTED by slot ascending (host sorts)
@@ -951,6 +956,7 @@ def unpack_out_host(arr: np.ndarray, m: int) -> tuple[np.ndarray, np.ndarray, np
     return status, rem, reset
 
 
+# guberlint: shapes pin [PACKED_IN_ROWS, W] int32, W on the pow2 width ladder; state fixed at capacity
 def _fused_step_core(state: BucketState, pin: jax.Array):
     batch, now = _unpack_in(pin)
     new_state, resp_status, resp_rem, resp_reset = _apply_core(
@@ -977,6 +983,7 @@ def _fused_step_core(state: BucketState, pin: jax.Array):
 fused_step = jax.jit(_fused_step_core, donate_argnums=(0,))
 
 
+# guberlint: shapes pins [R, PACKED_IN_ROWS, W], R in {2,4,8,16} (pump rounds up), W on the width ladder
 def _multi_fused_core(state: BucketState, pins: jax.Array):
     """R packed rounds applied SEQUENTIALLY in one device program.
 
@@ -1066,6 +1073,7 @@ def pack_uniform_host(
     return out
 
 
+# guberlint: shapes pin [UNIFORM_IN_ROWS, W] int32, W on the pow2 width ladder; state fixed at capacity
 def _uniform_step_core(state: BucketState, pin: jax.Array):
     hdr = pin[0]
     now = (hdr[0].astype(_I64) << 32) | (hdr[1].astype(_I64) & 0xFFFFFFFF)
@@ -1102,6 +1110,7 @@ def _uniform_step_core(state: BucketState, pin: jax.Array):
 uniform_step = jax.jit(_uniform_step_core, donate_argnums=(0,))
 
 
+# guberlint: shapes pins [R, UNIFORM_IN_ROWS, W], R in {2,4,8,16}; W on the width ladder
 def _multi_uniform_core(state: BucketState, pins: jax.Array):
     def body(st, pin):
         return _uniform_step_core(st, pin)
@@ -1149,6 +1158,7 @@ def multi_step_ok(capacity: int, rounds: int = 2, width: int = 64) -> bool:
         return False
 
 
+# guberlint: shapes pin [PACKED_IN_ROWS, W] int32, W on the pow2 width ladder; state fixed at capacity
 def _packed_compute_core(state: BucketState, pin: jax.Array):
     batch, now = _unpack_in(pin)
     vals, resp_status, resp_rem, resp_reset = _compute_update(
@@ -1216,6 +1226,7 @@ packed_compute = jax.jit(_packed_compute_core)
 COLLAPSED_IN_ROWS = 19
 
 
+# guberlint: shapes pin [COLLAPSED_IN_ROWS, W] int32, W on the pow2 width ladder; state fixed at capacity
 def _collapsed_values(state: BucketState, pin: jax.Array):
     now = (pin[0, 0].astype(_I64) << 32) | (pin[0, 1].astype(_I64) & 0xFFFFFFFF)
     slot = pin[1]
@@ -1318,6 +1329,7 @@ def token_extras_host(R1: int, h: int, extras: int) -> tuple[int, int, bool]:
     return a2, rem2, sticky
 
 
+# guberlint: shapes pin [COLLAPSED_IN_ROWS, W] int32, W on the pow2 width ladder; state fixed at capacity
 def _collapsed_step_core(state: BucketState, pin: jax.Array):
     slot, vals2, packed = _collapsed_values(state, pin)
     return _scatter_values(state, slot, vals2), packed
@@ -1426,6 +1438,7 @@ class SlotRecord(NamedTuple):
     invalid_at: jax.Array  # int64
 
 
+# guberlint: shapes rec columns padded to pow2 (build_restore_record _pad_size); state fixed at capacity
 def _load_slots_impl(state: BucketState, rec: SlotRecord) -> BucketState:
     """Hydrate persisted bucket values into their slots.
 

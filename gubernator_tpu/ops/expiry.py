@@ -35,6 +35,7 @@ from jax import lax
 from gubernator_tpu.ops.bucket_kernel import _HI11
 
 
+# guberlint: shapes columns [..., capacity] fixed at engine build; window static (SWEEP_WINDOW)
 @partial(jax.jit, static_argnames=("window",))
 def sweep_window_scan(
     meta: jax.Array,  # int32 [..., capacity]
@@ -72,6 +73,7 @@ def sweep_window_scan(
     return jnp.where(freed, meta_w & ~1, meta_w), order, count
 
 
+# guberlint: shapes meta [..., capacity] fixed; meta_window [..., SWEEP_WINDOW] fixed per capacity
 @partial(jax.jit, donate_argnums=(0,))
 def sweep_window_commit(
     meta: jax.Array,  # int32 [..., capacity] (donated)
@@ -124,6 +126,7 @@ def windowed_sweep(engine, cap: int, now_ms: int, max_windows, release) -> int:
     return freed_total
 
 
+# guberlint: shapes full-capacity columns fixed at engine build (legacy one-shot sweep)
 @jax.jit
 def sweep_expired(
     meta: jax.Array,  # int32

@@ -59,6 +59,7 @@ def make_sketch(depth: int = 4, width: int = 1 << 20) -> SketchState:
     )
 
 
+# guberlint: shapes state planes [depth, width] fixed at sketch build; epoch_now scalar
 def _rotate(state: SketchState, epoch_now: jax.Array) -> SketchState:
     """Advance to `epoch_now`: one step rotates planes (previous ←
     current, current ← zeros); a gap ≥ 2 windows zeroes both.
@@ -213,6 +214,7 @@ class SketchLimiter:
         import threading
 
         self._lock = threading.Lock()
+        # guberlint: shapes pin [rows, W] with W on the sketch pad ladder; depth static
         self._step = jax.jit(
             lambda s, pin, cur: _sketch_step_impl(s, pin, depth, cur),
             donate_argnums=(0,),

@@ -377,12 +377,17 @@ class ShardedDecisionEngine:
             slot, vals2, pout = _collapsed_values(_flatten(state), pin[0])
             return slot[None], _expand(vals2), pout[None]
 
+        # guberlint: shapes pin [1, PACKED_IN_ROWS, W] per shard, W on the width ladder; state [n_sh, cap] fixed
         self._flat_fused = jax.jit(flat_packed_fused, donate_argnums=(0,))
+        # guberlint: shapes same pin/state contract as _flat_fused (split compute half)
         self._flat_compute = jax.jit(flat_packed_compute)
+        # guberlint: shapes slot/vals [1, W] on the width ladder; state [n_sh, cap] fixed
         self._flat_scatter = jax.jit(flat_scatter, donate_argnums=(0,))
+        # guberlint: shapes pin [1, COLLAPSED_IN_ROWS, W] on the width ladder; state [n_sh, cap] fixed
         self._flat_collapsed_fused = jax.jit(
             flat_collapsed_fused, donate_argnums=(0,)
         )
+        # guberlint: shapes same pin/state contract as _flat_collapsed_fused (split compute half)
         self._flat_collapsed_compute = jax.jit(flat_collapsed_compute)
 
     # ------------------------------------------------------------------

@@ -301,6 +301,7 @@ class V1Instance:
         self.multi_region_mgr = MultiRegionManager(conf.behaviors, self)
         from gubernator_tpu.cluster.hash_ring import make_picker
 
+        # guberlint: guard local_picker, region_picker by _peer_lock
         self.local_picker: ReplicatedConsistentHash[PeerClient] = make_picker(
             getattr(conf, "peer_picker", "replicated-hash"),
             conf.hash_algorithm,
@@ -798,6 +799,9 @@ class V1Instance:
                 lane.duration, lane.burst,
             )
         except Exception:  # noqa: BLE001 — callers fall back to pb
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("service.ledger_lane")
             log.exception("ledger engine-lane apply failed")
             return None
         finally:
@@ -1192,10 +1196,13 @@ class V1Instance:
 
         reference: gubernator.go:657-740 (SetPeers).
         """
-        local_picker = self.local_picker.new()
-        region_picker = self.region_picker.new()
-
         with self._peer_lock:
+            # Snapshot INSIDE the lock: two concurrent set_peers calls
+            # (discovery push racing a manual static update) must not
+            # both build from the same superseded ring and silently
+            # drop the other's peers on publish.
+            local_picker = self.local_picker.new()
+            region_picker = self.region_picker.new()
             creds = self.conf.peer_credentials
             local_members: List[PeerClient] = []
             for info in peer_infos:
@@ -1239,6 +1246,10 @@ class V1Instance:
             if p.info.grpc_address not in keep
         ]
         for p in dropped:
+            # guberlint: ok thread — bounded one-shot drain mirroring
+            # the reference's goroutine (gubernator.go:719-731);
+            # peer.shutdown() has an internal flush timeout, and the
+            # peer object is unreachable afterwards.
             threading.Thread(target=p.shutdown, daemon=True).start()
 
     def get_peer(self, key: str) -> PeerClient:

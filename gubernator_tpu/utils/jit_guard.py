@@ -1,0 +1,69 @@
+"""JIT recompile guard: count XLA backend compiles at runtime.
+
+A steady-state serving process must not recompile: every serve-path
+program is precompiled by warmup (daemon._warmup, tests/test_warmup.py)
+and batch shapes are pinned by the columnar layout (pad ladders).  A
+recompile in the serve path is a multi-second p99 spike — the exact
+failure mode guberlint's trace pass exists to keep out of the code.
+This module closes the loop at RUNTIME: it counts actual backend
+compiles via jax's monitoring events and exports the count as the
+``gubernator_jit_recompiles`` metric, so a soak (tests/
+test_recompile_guard.py) or a production scrape can assert the count
+stays flat after warmup.
+
+The hook is jax's semi-private ``jax._src.monitoring`` listener API
+(the '/jax/core/compile/backend_compile_duration' duration event fires
+once per backend compile, never on cache hits — pinned by a test).
+If the API moves, install() degrades to unavailable and the metric
+reports 0; the guard test skips.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_count = 0  # guberlint: guarded-by _lock
+_installed = False
+_available = False
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    global _count
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _count += 1
+
+
+def install() -> bool:
+    """Register the compile-event listener (idempotent).  Returns
+    whether the counter is live."""
+    global _installed, _available
+    with _lock:
+        if _installed:
+            return _available
+        _installed = True
+    try:
+        from jax._src import monitoring
+    except Exception:  # noqa: BLE001 — private API moved; degrade
+        from gubernator_tpu.utils.metrics import record_swallowed
+
+        record_swallowed("jit_guard.install")
+        return False
+    monitoring.register_event_duration_secs_listener(_on_event_duration)
+    with _lock:
+        _available = True
+    return True
+
+
+def available() -> bool:
+    with _lock:
+        return _available
+
+
+def compile_count() -> int:
+    """Backend compiles observed since install() (0 if unavailable)."""
+    with _lock:
+        return _count

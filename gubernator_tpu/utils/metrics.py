@@ -25,6 +25,27 @@ if TYPE_CHECKING:
     from gubernator_tpu.service import V1Instance
 
 
+# Swallowed-exception visibility (guberlint thread pass): background
+# threads that catch-and-continue MUST count the swallow here so a
+# failing loop is a metric spike, not silence.  Module-level because
+# the swallow sites span discovery/cluster/core objects with no shared
+# instance.
+_swallowed_lock = threading.Lock()
+_swallowed: dict = {}  # guberlint: guarded-by _swallowed_lock
+
+
+def record_swallowed(site: str) -> None:
+    """Count one swallowed exception for the
+    ``gubernator_swallowed_exceptions{site=...}`` counter."""
+    with _swallowed_lock:
+        _swallowed[site] = _swallowed.get(site, 0) + 1
+
+
+def swallowed_counts() -> dict:
+    with _swallowed_lock:
+        return dict(_swallowed)
+
+
 class DurationStat:
     """Cheap duration summary (count + sum seconds), exported as a
     prometheus summary.  Observations happen on flush/round boundaries
@@ -32,6 +53,8 @@ class DurationStat:
     never touches one."""
 
     __slots__ = ("count", "total", "max", "_lock")
+
+    # guberlint: guard count, total, max by _lock
 
     def __init__(self) -> None:
         self.count = 0
@@ -47,7 +70,10 @@ class DurationStat:
                 self.max = seconds
 
     def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        # Under the lock so count/total come from the same observation
+        # (a torn pair between two observes skews the scrape).
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
 
 
 class InstanceCollector(Collector):
@@ -167,6 +193,7 @@ class InstanceCollector(Collector):
             try:
                 g.add_metric([peer.info.grpc_address], peer.queue_length())
             except Exception:  # noqa: BLE001 — peer mid-shutdown
+                record_swallowed("metrics.peer_queue_scrape")
                 continue
         yield g
 
@@ -321,6 +348,32 @@ class InstanceCollector(Collector):
         if inst._global_window is not None:
             g.add_metric(["global_serve"], inst._global_window.next_wait())
         yield g
+
+        # Swallowed exceptions by site: background threads that catch
+        # and continue count here (guberlint thread pass) — a failing
+        # loop shows as a rate spike instead of silence.
+        c = CounterMetricFamily(
+            "gubernator_swallowed_exceptions",
+            "Exceptions swallowed by catch-and-continue sites, by site.",
+            labels=["site"],
+        )
+        for site, n in sorted(swallowed_counts().items()):
+            c.add_metric([site], n)
+        yield c
+
+        # XLA backend compiles observed at runtime (utils/jit_guard).
+        # Flat after warmup in a healthy steady-state server; growth
+        # means an unpinned shape/dtype reached a jit program in the
+        # serve path (the trace pass + recompile-guard soak).
+        from gubernator_tpu.utils import jit_guard
+
+        c = CounterMetricFamily(
+            "gubernator_jit_recompiles",
+            "XLA backend compiles observed since process start "
+            "(0 when the jax monitoring hook is unavailable).",
+        )
+        c.add_metric([], jit_guard.compile_count())
+        yield c
 
 
 def build_registry(

@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly two things:
-#   1. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+# Runs exactly three things:
+#   1. guberlint (tools/guberlint): fails on static-analysis findings
+#      not in the committed guberlint_baseline.json — lock discipline,
+#      JAX trace hygiene, thread lifecycle (STATIC_ANALYSIS.md);
+#   2. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout);
-#   2. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#   3. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -17,6 +20,13 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 
 ROUND="${1:-${BENCH_ROUND:-ci}}"
+
+echo "=== guberlint (static analysis vs baseline) ===" >&2
+if ! python -m tools.guberlint; then
+  echo "guberlint: NEW findings vs guberlint_baseline.json — fix or" >&2
+  echo "suppress with '# guberlint: ok <pass> — <why>' (STATIC_ANALYSIS.md)" >&2
+  exit 1
+fi
 
 echo "=== tier-1 tests ===" >&2
 rm -f /tmp/_t1.log

@@ -36,3 +36,43 @@ def pytest_configure(config):
 def frozen_clock() -> Clock:
     """A frozen, manually advanced clock (reference: functional_test.go:160)."""
     return Clock().freeze()
+
+
+class JitRecompileGuard:
+    """Snapshot/assert helper over utils.jit_guard's compile counter.
+
+    Usage: warm the code under test, call `snapshot()`, run the
+    steady-state traffic, then `assert_flat("phase name")` — any XLA
+    backend compile in between fails the test with the delta."""
+
+    def __init__(self):
+        from gubernator_tpu.utils import jit_guard
+
+        self._guard = jit_guard
+        self.live = jit_guard.install()
+        self._mark = None
+
+    def count(self) -> int:
+        return self._guard.compile_count()
+
+    def snapshot(self) -> int:
+        self._mark = self.count()
+        return self._mark
+
+    def assert_flat(self, what: str = "steady state") -> None:
+        assert self._mark is not None, "call snapshot() after warmup first"
+        now = self.count()
+        assert now == self._mark, (
+            f"{now - self._mark} XLA recompile(s) during {what} — an "
+            "unpinned shape/dtype reached a jit program after warmup"
+        )
+
+
+@pytest.fixture
+def jit_recompile_guard():
+    """Recompile guard over a steady-state soak (skips if the jax
+    monitoring hook is unavailable on this jax version)."""
+    g = JitRecompileGuard()
+    if not g.live:
+        pytest.skip("jax monitoring hook unavailable; recompiles untracked")
+    return g

@@ -1,0 +1,508 @@
+"""guberlint proves each pass catches its seeded bad fixture.
+
+Each case writes a known-bad snippet, runs the pass directly, and
+asserts the finding (and that the suppression escape hatch silences
+it).  STATIC_ANALYSIS.md documents the grammar these fixtures pin.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.guberlint import baseline as baseline_mod
+from tools.guberlint import lockcheck, threadcheck, tracecheck
+from tools.guberlint.common import Finding, SourceFile
+
+
+def _src(tmp_path: Path, code: str, name: str = "fix.py") -> SourceFile:
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return SourceFile(p, name)
+
+
+def _lock_findings(src):
+    edges = set()
+    out = lockcheck.check_file(src, edges)
+    return out, edges
+
+
+# ---------------------------------------------------------------- lock
+
+
+LOCK_BAD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0  # guberlint: guarded-by _lock
+
+        def good(self):
+            with self._lock:
+                self._n += 1
+
+        def bad(self):
+            return self._n
+"""
+
+
+def test_lock_pass_catches_unguarded_access(tmp_path):
+    findings, _ = _lock_findings(_src(tmp_path, LOCK_BAD))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "unguarded-access"
+    assert f.scope == "Counter.bad"
+    assert "self._n" in f.message
+
+
+def test_lock_pass_suppression_escape_hatch(tmp_path):
+    code = LOCK_BAD.replace(
+        "return self._n",
+        "return self._n  # guberlint: ok lock — racy read tolerated, metrics only",
+    )
+    findings, _ = _lock_findings(_src(tmp_path, code))
+    assert findings == []
+
+
+def test_lock_pass_suppression_requires_reason(tmp_path):
+    code = LOCK_BAD.replace(
+        "return self._n", "return self._n  # guberlint: ok lock"
+    )
+    src = _src(tmp_path, code)
+    assert any(
+        f.rule == "bad-suppression" for f in src.bad_suppressions
+    ), "reasonless suppression must itself be a finding"
+
+
+def test_lock_pass_holds_annotation_and_locked_convention(tmp_path):
+    code = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guberlint: guarded-by _lock
+
+            def _bump_locked(self):
+                self._n += 1
+
+            def bump_held(self):  # guberlint: holds _lock
+                self._n += 1
+    """
+    findings, _ = _lock_findings(_src(tmp_path, code))
+    assert findings == []
+
+
+def test_lock_pass_condition_alias(tmp_path):
+    code = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []  # guberlint: guarded-by _lock
+
+            def put(self, x):
+                with self._cv:
+                    self._items.append(x)
+    """
+    findings, _ = _lock_findings(_src(tmp_path, code))
+    assert findings == [], "acquiring the condition acquires the wrapped lock"
+
+
+def test_lock_pass_nested_def_resets_held_context(tmp_path):
+    code = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guberlint: guarded-by _lock
+
+            def kick(self, pool):
+                with self._lock:
+                    def later():
+                        return self._items.pop()
+                    pool.submit(later)
+    """
+    findings, _ = _lock_findings(_src(tmp_path, code))
+    assert len(findings) == 1, "closure may run after the with exits"
+
+
+def test_lock_order_inversion_detected(tmp_path):
+    code = """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self.x = 0  # guberlint: guarded-by _a_lock
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """
+    _, edges = _lock_findings(_src(tmp_path, code))
+    cyc = lockcheck.order_findings(edges)
+    assert len(cyc) == 1
+    assert cyc[0].rule == "lock-order-inversion"
+    assert "AB._a_lock" in cyc[0].message and "AB._b_lock" in cyc[0].message
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    code = """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self.x = 0  # guberlint: guarded-by _a_lock
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+    """
+    _, edges = _lock_findings(_src(tmp_path, code))
+    assert lockcheck.order_findings(edges) == []
+
+
+# --------------------------------------------------------------- trace
+
+
+def test_trace_pass_catches_tracer_branch(tmp_path):
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        # guberlint: shapes x [n] on the pad ladder
+        @jax.jit
+        def f(x):
+            if x.sum() > 0:
+                return x
+            return -x
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert [f.rule for f in findings] == ["trace-branch"]
+
+
+def test_trace_pass_static_shape_branch_ok(tmp_path):
+    code = """
+        import jax
+
+        # guberlint: shapes x [n] on the pad ladder
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 4:
+                return x
+            return x + 1
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert findings == [], "shape tests are static under trace"
+
+
+def test_trace_pass_static_argnames_not_tainted(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+
+        # guberlint: shapes x [n]; window static
+        @partial(jax.jit, static_argnames=("window",))
+        def f(x, window):
+            if window > 4:
+                return x
+            return x + 1
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert findings == []
+
+
+def test_trace_pass_catches_host_transfer(tmp_path):
+    code = """
+        import jax
+        import numpy as np
+
+        # guberlint: shapes x [n]
+        @jax.jit
+        def f(x):
+            y = x + 1
+            return np.asarray(y)
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert [f.rule for f in findings] == ["trace-transfer"]
+
+
+def test_trace_pass_transfer_reaches_helpers(tmp_path):
+    code = """
+        import jax
+
+        def helper(v):
+            return float(v)
+
+        # guberlint: shapes x [n]
+        @jax.jit
+        def f(x):
+            return helper(x * 2)
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert any(
+        f.rule == "trace-transfer" and f.scope == "helper" for f in findings
+    ), "helpers called from jit roots execute traced"
+
+
+def test_trace_pass_requires_shapes_annotation(tmp_path):
+    code = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x + 1
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert [f.rule for f in findings] == ["trace-shapes"]
+    # ... and the annotation satisfies it (any of the eligible lines).
+    ok = code.replace(
+        "@jax.jit", "# guberlint: shapes x [n] padded pow2\n@jax.jit"
+    )
+    assert tracecheck.check_file(_src(tmp_path, ok, "ok.py")) == []
+
+
+def test_trace_pass_suppression(tmp_path):
+    code = """
+        import jax
+
+        # guberlint: ok trace — host callback by design (io_callback wrapper)
+        @jax.jit
+        def f(x):
+            return x + 1
+    """
+    findings = tracecheck.check_file(_src(tmp_path, code))
+    assert findings == []
+
+
+# -------------------------------------------------------------- thread
+
+
+def test_thread_pass_catches_orphan_daemon(tmp_path):
+    code = """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert [f.rule for f in findings] == ["thread-orphan"]
+
+
+def test_thread_pass_joined_daemon_ok(tmp_path):
+    code = """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                while not self._stop.wait(1.0):
+                    pass
+
+            def close(self):
+                self._stop.set()
+                self._t.join(timeout=2.0)
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert findings == []
+
+
+def test_thread_pass_local_threads_joined_via_loop(tmp_path):
+    code = """
+        import threading
+
+        def run(n):
+            threads = [
+                threading.Thread(target=print, daemon=True) for _ in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert findings == []
+
+
+def test_thread_pass_fire_and_forget_needs_suppression(tmp_path):
+    code = """
+        import threading
+
+        def kick(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert [f.rule for f in findings] == ["thread-orphan"]
+    ok = code.replace(
+        "    threading.Thread",
+        "    # guberlint: ok thread — bounded one-shot drain\n"
+        "    threading.Thread",
+    )
+    assert threadcheck.check_file(_src(tmp_path, ok, "ok.py")) == []
+
+
+def test_thread_pass_catches_silent_swallow(tmp_path):
+    code = """
+        import threading
+
+        def loop():
+            while True:
+                try:
+                    work()
+                except Exception:
+                    pass
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert [f.rule for f in findings] == ["thread-swallow"]
+
+
+def test_thread_pass_logged_swallow_ok(tmp_path):
+    code = """
+        import logging
+        import threading
+
+        def loop():
+            while True:
+                try:
+                    work()
+                except Exception:
+                    logging.getLogger("x").exception("work failed")
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert findings == []
+
+
+def test_thread_pass_non_threaded_module_exempt_from_swallow(tmp_path):
+    code = """
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+    """
+    findings = threadcheck.check_file(_src(tmp_path, code))
+    assert findings == []
+
+
+# ------------------------------------------------------------ baseline
+
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    f1 = Finding("lock", "unguarded-access", "a.py", 3, "C.m", "self.x", "x")
+    f2 = Finding("trace", "trace-branch", "b.py", 9, "f", "if@f", "y")
+    path = tmp_path / "base.json"
+    baseline_mod.save(path, [f1, f2])
+    base = baseline_mod.load(path)
+    assert len(base) == 2
+    # f2 fixed; f3 new.
+    f3 = Finding("thread", "thread-orphan", "c.py", 1, "S", "thread@S._t", "z")
+    new, accepted, stale = baseline_mod.partition([f1, f3], base)
+    assert [f.rule for f in new] == ["thread-orphan"]
+    assert [f.rule for f in accepted] == ["unguarded-access"]
+    assert len(stale) == 1 and stale[0][1] == "trace-branch"
+
+
+def test_baseline_save_preserves_audit_record(tmp_path):
+    path = tmp_path / "base.json"
+    path.write_text(json.dumps({"findings": [], "audited_clean": {"lock": {}}}))
+    baseline_mod.save(path, [])
+    assert "audited_clean" in json.loads(path.read_text())
+
+
+def test_repo_is_clean_against_committed_baseline():
+    """The acceptance gate: `python -m tools.guberlint` exits 0."""
+    from tools.guberlint.__main__ import main
+
+    assert main([]) == 0
+
+
+# ----------------------------------------------------- fix-annotations
+
+
+def test_fix_annotations_inserts_stub(tmp_path, monkeypatch):
+    import tools.guberlint.__main__ as main_mod
+
+    p = tmp_path / "mod.py"
+    p.write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+            """
+        )
+    )
+    monkeypatch.setattr(main_mod, "REPO_ROOT", tmp_path)
+    inserted = main_mod.fix_annotations([p])
+    assert inserted == 1
+    assert "self._n = 0  # guberlint: guarded-by _lock" in p.read_text()
+    # The annotated file now verifies clean.
+    src = SourceFile(p, "mod.py")
+    findings, _ = _lock_findings(src)
+    assert findings == []
+
+
+def test_fix_annotations_skips_mixed_lock_attrs(tmp_path, monkeypatch):
+    import tools.guberlint.__main__ as main_mod
+
+    p = tmp_path / "mod.py"
+    p.write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def read(self):
+                    return self._n
+            """
+        )
+    )
+    monkeypatch.setattr(main_mod, "REPO_ROOT", tmp_path)
+    assert main_mod.fix_annotations([p]) == 0, (
+        "an attr with unlocked accesses must not get a stub"
+    )

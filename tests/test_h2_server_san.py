@@ -1,0 +1,200 @@
+"""ThreadSanitizer stress of the native h2 server (guberlint's native
+runtime companion, STATIC_ANALYSIS.md).
+
+Builds core/native/h2_server.cpp with GUBER_NATIVE_SAN=thread (separate
+cache tag, -fsanitize=thread -O1 -g) and hammers it from concurrent
+gRPC clients in a SUBPROCESS with the TSan runtime LD_PRELOADed — a
+sanitizer runtime cannot initialize inside an already-running
+uninstrumented python, so in-process loading is not an option.  Any
+data race inside the instrumented .so fails the subprocess
+(halt_on_error=1, exitcode=66).
+
+Marked slow: TSan startup + the hammer take tens of seconds; run it
+with `GUBER_NATIVE_SAN=1 pytest -m slow tests/test_h2_server_san.py`
+or via the scheduled soak, not tier-1.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from gubernator_tpu.core.native_build import ensure_built, sanitizer_preload
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Runs PRELOADED (TSan): the instrumented server + a flat columnar
+# callback.  It prints its port, then blocks on stdin until the parent
+# closes it — the server process must NEVER fork once its C threads
+# run (fork from a TSan'd multithreaded process deadlocks), so the
+# unpreloaded pytest parent is the one that spawns the client hammer.
+_SERVER_SRC = r"""
+import ctypes, sys
+import numpy as np
+
+from gubernator_tpu.net import h2_fast
+
+lib = h2_fast.load()
+assert lib is not None, "sanitized h2_server build unavailable"
+
+def window(buf, length, counts_ptr, lens_ptr, n_rpcs, total, out_ptr,
+           status_ptr):
+    n = int(total); nr = int(n_rpcs)
+    if nr > 0 and status_ptr:
+        np.ctypeslib.as_array(
+            ctypes.cast(status_ptr, ctypes.POINTER(ctypes.c_int64)),
+            shape=(nr,),
+        )[:] = 0
+    if n > 0 and out_ptr:
+        cols = np.ctypeslib.as_array(
+            ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int64)),
+            shape=(4 * n,),
+        )
+        cols[:n] = 0          # status UNDER_LIMIT
+        cols[n:2 * n] = 100   # limit
+        cols[2 * n:3 * n] = 99  # remaining
+        cols[3 * n:] = 0      # reset
+    return 0
+
+cb = h2_fast._CALLBACK(window)
+handle = lib.h2s_start(0, 500, 16384, 4096, cb)
+assert handle, "h2 server failed to bind"
+print("PORT", int(lib.h2s_port(handle)), flush=True)
+sys.stdin.read()  # parent closes stdin when the hammer is done
+# Stats BEFORE stop: h2s_stop frees the server (TSan caught this
+# harness's original stats-after-stop as a heap-use-after-free).
+stats = np.zeros(8, dtype=np.int64)
+lib.h2s_stats(handle, stats.ctypes.data_as(ctypes.c_void_p))
+lib.h2s_stop(handle)
+print("san stress ok rpcs=%d windows=%d" % (stats[0], stats[1]), flush=True)
+"""
+
+_CLIENT_SRC = r"""
+import sys, threading
+import grpc
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+port = int(sys.argv[1])
+payload = pb.GetRateLimitsReq(
+    requests=[
+        pb.RateLimitReq(name="san", unique_key=str(i), hits=1, limit=100,
+                        duration=60000)
+        for i in range(8)
+    ]
+).SerializeToString()
+
+N_THREADS = 8
+N_RPCS = 60
+errs = []
+
+def hammer(tid):
+    try:
+        ch = grpc.insecure_channel("127.0.0.1:%d" % port)
+        stub = ch.unary_unary(
+            "/pb.gubernator.V1/GetRateLimits",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        for i in range(N_RPCS):
+            resp = stub(payload, timeout=30)
+            out = pb.GetRateLimitsResp.FromString(resp)
+            assert len(out.responses) == 8, len(out.responses)
+        ch.close()
+    except Exception as e:
+        errs.append("t%d: %r" % (tid, e))
+
+threads = [threading.Thread(target=hammer, args=(t,)) for t in range(N_THREADS)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+if errs:
+    print("CLIENT ERRORS:", errs[:5], file=sys.stderr)
+    sys.exit(1)
+print("client ok: %d rpcs" % (N_THREADS * N_RPCS))
+"""
+
+
+@pytest.mark.slow
+def test_h2_server_threaded_stress_under_tsan():
+    if os.environ.get("GUBER_NATIVE_SAN", "") in ("", "0"):
+        pytest.skip("set GUBER_NATIVE_SAN=1 to run the TSan stress")
+    preload = sanitizer_preload("thread")
+    if preload is None:
+        pytest.skip("libtsan not available from this toolchain")
+    # Build the instrumented .so in-process (compilation needs no
+    # preload); the subprocess then dlopens the cached artifact.
+    orig_san = os.environ.get("GUBER_NATIVE_SAN")
+    env = dict(os.environ, GUBER_NATIVE_SAN="thread")
+    os.environ["GUBER_NATIVE_SAN"] = "thread"
+    try:
+        so = ensure_built("h2_server")
+    finally:
+        if orig_san is None:
+            os.environ.pop("GUBER_NATIVE_SAN", None)
+        else:
+            os.environ["GUBER_NATIVE_SAN"] = orig_san
+    if so is None:
+        pytest.skip("sanitized h2_server build failed (no g++?)")
+
+    supp = REPO / "tests" / "tsan_suppressions.txt"
+    server_env = dict(
+        env,
+        LD_PRELOAD=preload,
+        TSAN_OPTIONS=(
+            # Mutex-misuse reports are off: gcc-10's libtsan
+            # false-positives "double lock" on pthread_cond_wait
+            # re-acquisition (and on uninstrumented Eigen pools in
+            # jaxlib).  Data-race detection — what this stress is
+            # for — stays fully on.
+            "halt_on_error=1 exitcode=66 report_thread_leaks=0 "
+            f"report_mutex_bugs=0 detect_deadlocks=0 suppressions={supp}"
+        ),
+        # Import gubernator_tpu without jax: TSan instruments every
+        # malloc; the XLA runtime under TSan is noise we don't want.
+        GUBERNATOR_TPU_X64="0",
+        GUBERNATOR_TPU_COMPILE_CACHE="0",
+    )
+    server = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SRC],
+        cwd=REPO,
+        env=server_env,
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        port_line = server.stdout.readline()
+        assert port_line.startswith("PORT "), (
+            f"server failed to start: {port_line!r}\n"
+            + server.stderr.read()[-4000:]
+        )
+        port = int(port_line.split()[1])
+        client = subprocess.run(
+            [sys.executable, "-c", _CLIENT_SRC, str(port)],
+            cwd=REPO,
+            env=dict(env, GUBERNATOR_TPU_X64="0",
+                     GUBERNATOR_TPU_COMPILE_CACHE="0"),
+            capture_output=True,
+            text=True,
+            timeout=240,
+        )
+        assert client.returncode == 0, (
+            f"client hammer failed rc={client.returncode}\n"
+            f"{client.stdout[-1000:]}\n{client.stderr[-2000:]}"
+        )
+        out, err = server.communicate(input="", timeout=120)
+    except Exception:
+        server.kill()
+        raise
+    assert "ThreadSanitizer" not in err, (
+        "TSan report from h2_server:\n" + err[-4000:]
+    )
+    assert server.returncode == 0, (
+        f"san server failed rc={server.returncode}\n"
+        f"stdout: {out[-2000:]}\nstderr: {err[-4000:]}"
+    )
+    assert "san stress ok" in out

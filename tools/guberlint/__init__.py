@@ -1,0 +1,18 @@
+"""guberlint — repo-native static analysis for gubernator_tpu.
+
+Three AST passes over the concurrent host tier (STATIC_ANALYSIS.md):
+
+- ``lock``   — guarded-attribute discipline + lock acquisition-order
+  inversions (tools/guberlint/lockcheck.py);
+- ``trace``  — JAX trace hygiene over the jit-reachable kernel code
+  (tools/guberlint/tracecheck.py);
+- ``thread`` — daemon-thread lifecycle + silent exception swallowing
+  (tools/guberlint/threadcheck.py).
+
+Run locally with ``python -m tools.guberlint``; CI fails on findings
+not present in the committed ``guberlint_baseline.json``.
+"""
+
+from tools.guberlint.common import Finding, SourceFile  # noqa: F401
+
+__all__ = ["Finding", "SourceFile"]

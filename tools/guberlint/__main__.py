@@ -1,0 +1,247 @@
+"""guberlint driver: ``python -m tools.guberlint [paths...]``.
+
+Exit codes: 0 = no findings outside the baseline; 1 = new findings (or
+a parse failure); 2 = bad invocation.
+
+Options:
+  --baseline FILE    baseline JSON (default: guberlint_baseline.json
+                     at the repo root)
+  --write-baseline   rewrite the baseline to the current finding set
+  --fix-annotations  insert `# guberlint: guarded-by` stubs for
+                     attributes whose every non-__init__ access already
+                     happens under one consistent lock (review the diff
+                     before committing)
+  --json             machine-readable output
+  --no-baseline      ignore the baseline (report everything)
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from tools.guberlint import baseline as baseline_mod
+from tools.guberlint import lockcheck, threadcheck, tracecheck
+from tools.guberlint.common import Finding, SourceFile, attr_path, iter_py_files
+from tools.guberlint.config import EXCLUDE, LINT_ROOTS, TRACE_SCOPES
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def run(paths: List[Path]) -> List[Finding]:
+    files = iter_py_files(paths, REPO_ROOT, exclude=EXCLUDE)
+    findings: List[Finding] = []
+    edges: Set[Tuple[str, str, str, int]] = set()
+    for src in files:
+        if src.parse_error:
+            findings.append(
+                Finding(
+                    "meta", "parse-error", src.rel, 0, "<module>",
+                    "parse", f"syntax error: {src.parse_error}",
+                )
+            )
+            continue
+        findings.extend(src.bad_suppressions)
+        findings.extend(lockcheck.check_file(src, edges))
+        if any(src.rel.startswith(s) for s in TRACE_SCOPES):
+            findings.extend(tracecheck.check_file(src))
+        findings.extend(threadcheck.check_file(src))
+    findings.extend(lockcheck.order_findings(edges))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# -- --fix-annotations -------------------------------------------------
+
+
+def fix_annotations(paths: List[Path]) -> int:
+    """Insert `# guberlint: guarded-by <lock>` stubs on __init__
+    assignment lines of attributes whose every access outside __init__
+    is under one consistent `with self.<lock>` block.  Conservative:
+    skips attrs with any unlocked access or mixed locks."""
+    files = iter_py_files(paths, REPO_ROOT, exclude=EXCLUDE)
+    inserted = 0
+    for src in files:
+        if src.tree is None:
+            continue
+        new_lines = list(src.lines)
+        changed = False
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            usage = _attr_lock_usage(cls)
+            init = next(
+                (
+                    n for n in cls.body
+                    if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+                ),
+                None,
+            )
+            if init is None:
+                continue
+            declared = _declared_attrs(src, cls)
+            for stmt in ast.walk(init):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for tgt in stmt.targets:
+                    path = attr_path(tgt)
+                    if not path or not path.startswith("self."):
+                        continue
+                    attr = path[len("self."):]
+                    if "." in attr or attr in declared:
+                        continue
+                    locks = usage.get(attr)
+                    if not locks or len(locks) != 1 or None in locks:
+                        continue
+                    ln = stmt.lineno - 1
+                    if "guberlint" in new_lines[ln]:
+                        continue
+                    new_lines[ln] = (
+                        new_lines[ln].rstrip()
+                        + f"  # guberlint: guarded-by {next(iter(locks))}"
+                    )
+                    changed = True
+                    inserted += 1
+        if changed:
+            src.path.write_text("\n".join(new_lines) + "\n")
+            print(f"annotated {src.rel}")
+    return inserted
+
+
+def _declared_attrs(src: SourceFile, cls: ast.ClassDef) -> Set[str]:
+    end = max(getattr(cls, "end_lineno", cls.lineno), cls.lineno)
+    declared = set(src.class_registry(cls.lineno, end))
+    for stmt in ast.walk(cls):
+        if isinstance(stmt, ast.Assign) and src.guarded_by(stmt.lineno):
+            for tgt in stmt.targets:
+                path = attr_path(tgt)
+                if path and path.startswith("self."):
+                    declared.add(path.split(".")[1])
+    return declared
+
+
+def _attr_lock_usage(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    """attr -> set of lock names (None = some unlocked access) over
+    every method except __init__."""
+    usage: Dict[str, Set[str]] = {}
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            add = []
+            for item in node.items:
+                path = attr_path(item.context_expr)
+                if path and path.startswith("self.") and path.count(".") == 1:
+                    add.append(path.split(".")[1])
+            for stmt in node.body:
+                walk(stmt, held + tuple(add))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for stmt in body:
+                walk(stmt, ())
+            return
+        if isinstance(node, ast.Attribute):
+            path = attr_path(node)
+            if path and path.startswith("self.") and path.count(".") >= 1:
+                attr = path.split(".")[1]
+                usage.setdefault(attr, set()).add(held[-1] if held else None)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if item.name == "__init__":
+                continue
+            for stmt in item.body:
+                walk(stmt, ())
+    return usage
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="guberlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=[])
+    ap.add_argument("--baseline", default=str(REPO_ROOT / "guberlint_baseline.json"))
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--fix-annotations", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.paths:
+        paths = [Path(p).resolve() for p in args.paths]
+    else:
+        paths = [REPO_ROOT / r for r in LINT_ROOTS]
+    for p in paths:
+        if not p.exists():
+            print(f"guberlint: no such path: {p}", file=sys.stderr)
+            return 2
+        try:
+            p.relative_to(REPO_ROOT)
+        except ValueError:
+            print(
+                f"guberlint: path outside the repo root ({REPO_ROOT}): {p}",
+                file=sys.stderr,
+            )
+            return 2
+
+    if args.fix_annotations:
+        n = fix_annotations(paths)
+        print(f"guberlint: inserted {n} guarded-by stub(s) — review the diff")
+        return 0
+
+    findings = run(paths)
+    base_path = Path(args.baseline)
+    base = set() if args.no_baseline else baseline_mod.load(base_path)
+
+    if args.write_baseline:
+        baseline_mod.save(base_path, findings)
+        print(
+            f"guberlint: wrote {len(set(f.fingerprint() for f in findings))} "
+            f"fingerprint(s) to {base_path}"
+        )
+        return 0
+
+    new, accepted, stale = baseline_mod.partition(findings, base)
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "new": [f.__dict__ for f in new],
+                    "accepted": [f.__dict__ for f in accepted],
+                    "stale_baseline": [list(s) for s in stale],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        if accepted:
+            print(f"guberlint: {len(accepted)} baselined finding(s) suppressed")
+        for s in stale:
+            print(f"guberlint: stale baseline entry (fixed?): {s}")
+    if new:
+        print(
+            f"guberlint: {len(new)} new finding(s) — fix, suppress with a "
+            "reasoned '# guberlint: ok <pass> — <why>', or (last resort) "
+            "re-run with --write-baseline",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"guberlint: clean ({len(accepted)} baselined, "
+        f"{len(stale)} stale baseline entr{'y' if len(stale)==1 else 'ies'})"
+        if (accepted or stale) else "guberlint: clean"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Baseline load/save/compare.
+
+The committed ``guberlint_baseline.json`` pins the accepted findings
+(ideally empty).  CI fails on findings NOT in the baseline; stale
+baseline entries (fixed findings still listed) are reported so the
+file shrinks monotonically.  Fingerprints exclude line numbers, so
+unrelated edits don't churn the file.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, List, Set, Tuple
+
+from tools.guberlint.common import Finding
+
+_KEYS = ("pass", "rule", "file", "scope", "detail")
+
+
+def load(path: Path) -> Set[Tuple[str, str, str, str, str]]:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {
+        (e["pass"], e["rule"], e["file"], e["scope"], e["detail"])
+        for e in data.get("findings", [])
+    }
+
+
+def save(path: Path, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        {f.fingerprint() for f in findings}
+    )
+    doc = {
+        "comment": (
+            "guberlint accepted-findings baseline — see "
+            "STATIC_ANALYSIS.md.  Prefer fixing or suppressing "
+            "with a reasoned '# guberlint: ok <pass> — <why>' "
+            "over growing this file."
+        ),
+        "findings": [dict(zip(_KEYS, fp)) for fp in entries],
+    }
+    if path.exists():
+        try:
+            old = json.loads(path.read_text())
+            # The audit record (clean modules per pass) is maintained
+            # by hand; rewriting the fingerprints must not drop it.
+            if "audited_clean" in old:
+                doc["audited_clean"] = old["audited_clean"]
+        except ValueError:
+            pass
+    path.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def partition(
+    findings: List[Finding], base: Set[Tuple[str, str, str, str, str]]
+) -> Tuple[List[Finding], List[Finding], List[Tuple[str, ...]]]:
+    """(new, accepted, stale-baseline-entries)."""
+    new: List[Finding] = []
+    accepted: List[Finding] = []
+    current = set()
+    for f in findings:
+        fp = f.fingerprint()
+        current.add(fp)
+        (accepted if fp in base else new).append(f)
+    stale = sorted(base - current)
+    return new, accepted, stale

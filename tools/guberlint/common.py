@@ -1,0 +1,219 @@
+"""Shared guberlint plumbing: findings, annotations, suppressions.
+
+Annotation grammar (STATIC_ANALYSIS.md documents the full contract):
+
+- ``# guberlint: guarded-by <lock>`` — trailing comment on a
+  ``self.attr = ...`` line: every read/write of ``attr`` outside
+  ``__init__`` must happen under ``with <receiver>.<lock>``.
+- ``# guberlint: guard a, b by <lock>`` — per-class registry form, a
+  standalone comment anywhere in the class body.
+- ``# guberlint: holds <lock>[, <lock>...]`` — trailing comment on a
+  ``def`` line: the method is documented to be CALLED with those locks
+  held (the ``*_locked`` naming convention implies holding every lock
+  the class declares).
+- ``# guberlint: shapes <contract>`` — on (or directly above) a
+  ``jax.jit`` definition site: documents what pins the function's
+  argument shapes/dtypes (the columnar layout / warmup ladder).
+- ``# guberlint: ok <pass> — <reason>`` — suppression: silences the
+  named pass on that line (or, as a standalone comment, on the next
+  code line).  A suppression without a reason is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+PASS_NAMES = ("lock", "trace", "thread")
+
+# Reason separator accepts em/en dash, hyphen, or colon.
+_SUPPRESS_RE = re.compile(
+    r"#\s*guberlint:\s*ok\s+(\w+)\s*(?:[—–:-]+\s*(.*))?$"
+)
+_GUARDED_RE = re.compile(r"#\s*guberlint:\s*guarded-by\s+([A-Za-z_][\w.]*)")
+_GUARD_CLASS_RE = re.compile(
+    r"#\s*guberlint:\s*guard\s+([\w,\s]+?)\s+by\s+([A-Za-z_][\w.]*)"
+)
+_HOLDS_RE = re.compile(r"#\s*guberlint:\s*holds\s+([\w.]+(?:\s*,\s*[\w.]+)*)")
+_SHAPES_RE = re.compile(r"#\s*guberlint:\s*shapes\b[:\s]*(.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One guberlint finding.
+
+    ``detail`` is the stable fingerprint component (attribute / symbol
+    name) so baselines survive line drift; ``line`` is for humans.
+    """
+
+    pass_name: str
+    rule: str
+    file: str  # repo-relative posix path
+    line: int
+    scope: str  # "Class.method", "func", or "<module>"
+    detail: str
+    message: str
+
+    def fingerprint(self) -> Tuple[str, str, str, str, str]:
+        return (self.pass_name, self.rule, self.file, self.scope, self.detail)
+
+    def render(self) -> str:
+        return (
+            f"{self.file}:{self.line}: [{self.pass_name}/{self.rule}] "
+            f"{self.scope}: {self.message}"
+        )
+
+
+class SourceFile:
+    """One parsed module: AST + raw lines + suppression/annotation maps."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text)
+        except SyntaxError as e:  # surfaced as a finding by the driver
+            self.parse_error = str(e)
+        # line (1-based) -> set of pass names suppressed there
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.bad_suppressions: List[Finding] = []
+        self._scan_suppressions()
+
+    # -- suppressions --------------------------------------------------
+
+    def _scan_suppressions(self) -> None:
+        for i, raw in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            pass_name = m.group(1)
+            reason = (m.group(2) or "").strip()
+            if pass_name not in PASS_NAMES:
+                self.bad_suppressions.append(
+                    Finding(
+                        "meta", "bad-suppression", self.rel, i, "<module>",
+                        f"unknown-pass:{pass_name}",
+                        f"suppression names unknown pass {pass_name!r} "
+                        f"(one of {PASS_NAMES})",
+                    )
+                )
+                continue
+            if not reason:
+                self.bad_suppressions.append(
+                    Finding(
+                        "meta", "bad-suppression", self.rel, i, "<module>",
+                        f"missing-reason:{pass_name}:{i}",
+                        "suppression without a reason — write "
+                        "'# guberlint: ok %s — <why>'" % pass_name,
+                    )
+                )
+                continue
+            target = i
+            if raw.lstrip().startswith("#"):
+                # Standalone comment: applies to the next code line.
+                target = self._next_code_line(i)
+            self.suppressions.setdefault(target, set()).add(pass_name)
+
+    def _next_code_line(self, after: int) -> int:
+        for j in range(after + 1, len(self.lines) + 1):
+            stripped = self.lines[j - 1].strip()
+            if stripped and not stripped.startswith("#"):
+                return j
+        return after
+
+    def suppressed(self, line: int, pass_name: str) -> bool:
+        return pass_name in self.suppressions.get(line, set())
+
+    def suppressed_span(self, node: ast.AST, pass_name: str) -> bool:
+        """Suppression on the node's first line (or the `def` line of a
+        decorated statement)."""
+        line = getattr(node, "lineno", 0)
+        if self.suppressed(line, pass_name):
+            return True
+        for deco in getattr(node, "decorator_list", []):
+            if self.suppressed(deco.lineno, pass_name):
+                return True
+        return False
+
+    # -- annotations ---------------------------------------------------
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def guarded_by(self, lineno: int) -> Optional[str]:
+        m = _GUARDED_RE.search(self.line_text(lineno))
+        return m.group(1) if m else None
+
+    def class_registry(self, start: int, end: int) -> Dict[str, str]:
+        """``# guberlint: guard a, b by lock`` lines in [start, end]."""
+        out: Dict[str, str] = {}
+        for i in range(start, min(end, len(self.lines)) + 1):
+            m = _GUARD_CLASS_RE.search(self.lines[i - 1])
+            if m:
+                lock = m.group(2)
+                for attr in re.split(r"[,\s]+", m.group(1).strip()):
+                    if attr:
+                        out[attr] = lock
+        return out
+
+    def holds(self, node: ast.AST) -> Set[str]:
+        """Locks a `def` is annotated as holding (def line, decorator
+        lines, or the line directly above)."""
+        lines = [getattr(node, "lineno", 0)]
+        lines += [d.lineno for d in getattr(node, "decorator_list", [])]
+        first = min(lines)
+        lines.append(first - 1)
+        out: Set[str] = set()
+        for ln in lines:
+            m = _HOLDS_RE.search(self.line_text(ln))
+            if m:
+                out |= {s.strip() for s in m.group(1).split(",") if s.strip()}
+        return out
+
+    def shapes_annotation(self, *linenos: int) -> bool:
+        """A ``# guberlint: shapes`` contract on any of the given lines
+        or the line directly above any of them (decorator line, def
+        line, or jit-assignment line all work)."""
+        check = set(linenos)
+        check |= {ln - 1 for ln in linenos}
+        return any(_SHAPES_RE.search(self.line_text(ln)) for ln in check)
+
+
+def attr_path(node: ast.AST) -> Optional[str]:
+    """Dotted path of a Name/Attribute chain ('self.engine._lock'), or
+    None when the chain includes calls/subscripts."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_py_files(
+    roots: Iterable[Path], repo_root: Path, exclude: Tuple[str, ...] = ()
+) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    seen: Set[Path] = set()
+    for root in roots:
+        paths = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for p in paths:
+            if p in seen or p.suffix != ".py":
+                continue
+            rel = p.relative_to(repo_root).as_posix()
+            if any(rel.startswith(e) for e in exclude):
+                continue
+            seen.add(p)
+            out.append(SourceFile(p, rel))
+    return out

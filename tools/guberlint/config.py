@@ -1,0 +1,60 @@
+"""Repo-specific guberlint configuration.
+
+Everything here is DATA the passes consult; the pass logic itself is
+repo-agnostic.  Documented in STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+# Files/dirs (repo-relative prefixes) scanned by the trace pass: the
+# jit-reachable kernel surface.  The lock and thread passes scan the
+# whole package.
+TRACE_SCOPES = (
+    "gubernator_tpu/ops/",
+    "gubernator_tpu/core/engine.py",
+    "gubernator_tpu/core/pump.py",
+    "gubernator_tpu/core/readback.py",
+    "gubernator_tpu/parallel/",
+)
+
+# Lint roots (repo-relative).
+LINT_ROOTS = ("gubernator_tpu",)
+
+# Prefixes excluded from all passes (generated code).
+EXCLUDE = ("gubernator_tpu/net/pb/",)
+
+# Attribute-name -> class hints for qualifying dotted lock paths in
+# the acquisition-order graph: `with self.engine._lock` inside
+# StepPump orders against DecisionEngine's own `with self._lock`.
+ATTR_CLASS_HINTS = {
+    "engine": "DecisionEngine",
+    "_engine": "DecisionEngine",
+    "ledger": "DecisionLedger",
+    "led": "DecisionLedger",
+    "pump": "StepPump",
+    "_hits": "IntervalBatcher",
+    "_updates": "IntervalBatcher",
+    "combiner": "ReadbackCombiner",
+}
+
+# Methods known to acquire a lock at their top level: a call to one of
+# these while holding other locks creates an acquisition-order edge
+# (one level of indirection across the ledger/batch_loop/
+# global_manager/pump trio).
+KNOWN_LOCKING_CALLS = {
+    # DecisionEngine serializes on its RLock.
+    "apply_columnar": "DecisionEngine._lock",
+    "get_rate_limits": "DecisionEngine._lock",
+    "sweep": "DecisionEngine._lock",
+    # DecisionLedger entry points.
+    "plan": "DecisionLedger._lock",
+    "flush_settles": "DecisionLedger._lock",
+    "invalidate_keys": "DecisionLedger._lock",
+    "readonly_overlay": "DecisionLedger._lock",
+    # IntervalBatcher producers/drains.
+    "add_chunk": "IntervalBatcher._lock",
+    "add_many": "IntervalBatcher._lock",
+    "flush_now": "IntervalBatcher._lock",
+    # StepPump flush path runs under the engine lock.
+    "flush_for": "DecisionEngine._lock",
+}

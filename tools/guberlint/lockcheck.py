@@ -1,0 +1,358 @@
+"""Pass 1 — lock discipline.
+
+Classes declare guarded attributes (``# guberlint: guarded-by <lock>``
+on the attribute's init line, or a per-class ``# guberlint: guard a, b
+by <lock>`` registry).  The pass verifies every read/write of a guarded
+attribute happens lexically inside ``with <receiver>.<lock>`` (or a
+method annotated ``# guberlint: holds <lock>``; the repo's ``*_locked``
+naming convention implies holding every lock the class declares), and
+builds a lock acquisition-order graph across the concurrent trio
+(ledger / batch_loop / global_manager / pump / engine) to flag
+ordering inversions (cycles).
+
+Soundness notes (documented limits, STATIC_ANALYSIS.md §lock):
+
+- The analysis is lexical and receiver-textual: ``led._items`` requires
+  ``with led._lock`` (same receiver text).  Attribute aliasing through
+  containers or threads is out of scope.
+- ``threading.Condition(self.X)`` aliases the condition attribute to
+  ``X`` (acquiring the condition acquires the wrapped lock);
+  ``threading.Condition()`` is its own lock.
+- Nested ``def``/``lambda`` bodies reset the held-lock context: they
+  may run on another thread after the enclosing ``with`` exits.
+- ``__init__`` is exempt (construction happens before publication).
+- Only intra-class access is checked for ``self.attr``; cross-object
+  reads of plain counters (metrics scrapes) are outside the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.guberlint.common import Finding, SourceFile, attr_path
+from tools.guberlint.config import ATTR_CLASS_HINTS, KNOWN_LOCKING_CALLS
+
+PASS = "lock"
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.guards: Dict[str, str] = {}  # attr -> lock path (self-rel)
+        self.aliases: Dict[str, str] = {}  # condition attr -> base lock
+        self.lock_names: Set[str] = set()
+
+    def resolve(self, lock: str) -> str:
+        """Map a condition-variable attr to its wrapped base lock."""
+        return self.aliases.get(lock, lock)
+
+
+def _collect_class(src: SourceFile, node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node.name)
+    end = max(getattr(node, "end_lineno", node.lineno), node.lineno)
+    info.guards.update(src.class_registry(node.lineno, end))
+    for stmt in ast.walk(node):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for tgt in targets:
+            path = attr_path(tgt)
+            if path is None or not path.startswith("self."):
+                continue
+            attr = path[len("self."):]
+            if "." in attr:
+                continue
+            lock = src.guarded_by(stmt.lineno)
+            if lock:
+                info.guards[attr] = lock
+            # Condition aliasing: self.cv = threading.Condition(self.X)
+            val = stmt.value
+            if (
+                isinstance(val, ast.Call)
+                and attr_path(val.func) in ("threading.Condition", "Condition")
+            ):
+                if val.args:
+                    base = attr_path(val.args[0])
+                    if base and base.startswith("self."):
+                        info.aliases[attr] = base[len("self."):]
+                else:
+                    info.aliases[attr] = attr
+    info.lock_names = set(info.guards.values())
+    return info
+
+
+def _qualify(owner_class: str, lock_path: str) -> str:
+    """Normalize a receiver-relative lock path to a graph node name:
+    'self._lock' in class C -> 'C._lock'; 'self.engine._lock' ->
+    'DecisionEngine._lock' via ATTR_CLASS_HINTS; otherwise keep the
+    dotted tail as-is (receiver-stripped)."""
+    parts = lock_path.split(".")
+    if parts and parts[0] == "self":
+        parts = parts[1:]
+    if len(parts) == 1:
+        return f"{owner_class}.{parts[0]}"
+    hint = ATTR_CLASS_HINTS.get(parts[-2])
+    if hint:
+        return f"{hint}.{parts[-1]}"
+    return ".".join(parts)
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one method body tracking the lexically-held lock set."""
+
+    def __init__(
+        self,
+        src: SourceFile,
+        cls: _ClassInfo,
+        module_guards: Dict[str, Tuple[str, str]],
+        scope: str,
+        held: Set[str],
+        findings: List[Finding],
+        edges: Set[Tuple[str, str, str, int]],
+    ):
+        self.src = src
+        self.cls = cls
+        self.module_guards = module_guards
+        self.scope = scope
+        self.held = set(held)
+        self.findings = findings
+        self.edges = edges
+
+    # -- helpers -------------------------------------------------------
+
+    def _lock_node_of(self, path: str) -> Optional[str]:
+        """Held-set entry for a `with` target path, or None when the
+        expression is not a lock-ish attribute chain."""
+        if path is None:
+            return None
+        parts = path.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            return "self." + self.cls.resolve(parts[1])
+        return path
+
+    def _record_acquire(self, lock: str, lineno: int) -> None:
+        qual = _qualify(self.cls.name, lock)
+        for h in self.held:
+            hq = _qualify(self.cls.name, h)
+            if hq != qual:
+                self.edges.add((hq, qual, self.src.rel, lineno))
+
+    # -- visitors ------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # e.g. `with self._lock:` vs acquire()
+                path = attr_path(expr)
+                # with span(...), with self._lock.acquire_timeout(...):
+                if path and path.endswith((".acquire", ".acquire_timeout")):
+                    path = path.rsplit(".", 1)[0]
+                elif path and not path.endswith(("_lock", "_cv", "_mutex")):
+                    path = None
+            else:
+                path = attr_path(expr)
+            lock = self._lock_node_of(path) if path else None
+            if lock and (
+                lock.split(".")[-1] in self.cls.lock_names
+                or lock.split(".")[-1].endswith(("_lock", "_cv", "_mutex"))
+                or lock.split(".")[-1] in self.cls.aliases
+            ):
+                self._record_acquire(lock, node.lineno)
+                if lock not in self.held:
+                    acquired.append(lock)
+                    self.held.add(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in acquired:
+            self.held.discard(lock)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._nested(node)
+
+    def _nested(self, node) -> None:
+        # A nested callable may run on another thread after the
+        # enclosing `with` exits: reset the held-lock context, honoring
+        # any `holds` annotation on the nested def itself.
+        held = {
+            h if h.startswith("self.") else "self." + h
+            for h in self.src.holds(node)
+        }
+        sub = _MethodChecker(
+            self.src, self.cls, self.module_guards,
+            self.scope + ".<nested>", held, self.findings, self.edges,
+        )
+        for stmt in node.body if isinstance(node.body, list) else [node.body]:
+            sub.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # One-level indirection: calls into methods known (config) to
+        # acquire a lock create an ordering edge from every held lock.
+        path = attr_path(node.func)
+        if path and self.held:
+            target = KNOWN_LOCKING_CALLS.get(path.split(".")[-1])
+            if target:
+                for h in self.held:
+                    hq = _qualify(self.cls.name, h)
+                    if hq != target:
+                        self.edges.add((hq, target, self.src.rel, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if id(node) in getattr(self, "_chain_seen", ()):
+            self.generic_visit(node)
+            return
+        path = attr_path(node)
+        if path:
+            # Mark the whole chain visited so nested Attribute nodes
+            # don't re-report the same access.
+            seen = self.__dict__.setdefault("_chain_seen", set())
+            sub = node
+            while isinstance(sub, ast.Attribute):
+                seen.add(id(sub))
+                sub = sub.value
+            parts = path.split(".")
+            # Check the GUARDED attribute segment wherever it appears
+            # in the chain (e.g. `self._items.get`, `led._pending[...]`).
+            for i in range(1, len(parts)):
+                recv = ".".join(parts[:i])
+                attr = parts[i]
+                self._check_access(recv, attr, node)
+        self.generic_visit(node)
+
+    def _check_access(self, recv: str, attr: str, node: ast.Attribute) -> None:
+        if recv == "self":
+            lock = self.cls.guards.get(attr)
+            owner = self.cls.name
+        else:
+            entry = self.module_guards.get(attr)
+            if entry is None:
+                return
+            owner, lock = entry
+            # Receiver-based matching only where the config vouches
+            # for the receiver's class (`led` -> DecisionLedger):
+            # attribute names alone collide across classes
+            # (LedgerPlan.settles vs DecisionLedger.settles).
+            hinted = ATTR_CLASS_HINTS.get(recv.split(".")[-1])
+            if hinted != owner:
+                return
+        if lock is None:
+            return
+        required = f"{recv}.{self.cls.resolve(lock) if recv == 'self' else lock}"
+        if required in self.held:
+            return
+        # `holds` annotations may name the lock without the receiver.
+        if recv == "self" and ("self." + lock) in self.held:
+            return
+        if self.src.suppressed(node.lineno, PASS):
+            return
+        self.findings.append(
+            Finding(
+                PASS, "unguarded-access", self.src.rel, node.lineno,
+                self.scope, f"{recv}.{attr}",
+                f"access to {recv}.{attr} (guarded by {lock} in {owner}) "
+                f"outside `with {required}`",
+            )
+        )
+
+
+def check_file(
+    src: SourceFile,
+    edges: Set[Tuple[str, str, str, int]],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    if src.tree is None:
+        return findings
+    classes = [
+        n for n in ast.walk(src.tree) if isinstance(n, ast.ClassDef)
+    ]
+    infos = {c: _collect_class(src, c) for c in classes}
+    # Module-wide attr -> (class, lock) map for non-self receivers;
+    # attrs guarded in more than one class are checked via self only.
+    module_guards: Dict[str, Tuple[str, str]] = {}
+    conflicted: Set[str] = set()
+    for info in infos.values():
+        for attr, lock in info.guards.items():
+            if attr in module_guards and module_guards[attr][1] != lock:
+                conflicted.add(attr)
+            else:
+                module_guards[attr] = (info.name, lock)
+    for attr in conflicted:
+        module_guards.pop(attr, None)
+
+    for cls_node, info in infos.items():
+        if not info.guards:
+            continue
+        for item in cls_node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            scope = f"{info.name}.{item.name}"
+            held: Set[str] = set()
+            for lock in src.holds(item):
+                held.add(lock if lock.startswith("self.") else "self." + lock)
+            if item.name.endswith("_locked"):
+                # Repo convention: *_locked methods run with the
+                # class's declared locks held by the caller.
+                for lock in info.lock_names:
+                    held.add(
+                        lock if lock.startswith("self.") else "self." + lock
+                    )
+            checker = _MethodChecker(
+                src, info, module_guards, scope, held, findings, edges,
+            )
+            for stmt in item.body:
+                checker.visit(stmt)
+    return findings
+
+
+def order_findings(
+    edges: Set[Tuple[str, str, str, int]]
+) -> List[Finding]:
+    """Cycle detection over the acquisition-order graph.  An edge
+    A -> B means 'B acquired while A held'; any cycle is a potential
+    deadlock between threads taking the locks in opposite orders."""
+    graph: Dict[str, Set[str]] = {}
+    where: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for a, b, f, ln in edges:
+        graph.setdefault(a, set()).add(b)
+        where.setdefault((a, b), (f, ln))
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                canon = tuple(sorted(set(cyc)))
+                if canon in seen_cycles:
+                    continue
+                seen_cycles.add(canon)
+                f, ln = where.get((node, nxt), ("<graph>", 0))
+                findings.append(
+                    Finding(
+                        PASS, "lock-order-inversion", f, ln,
+                        "<lock-graph>", "->".join(cyc),
+                        "lock acquisition-order cycle: "
+                        + " -> ".join(cyc),
+                    )
+                )
+            elif nxt in graph:
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return findings

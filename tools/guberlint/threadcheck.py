@@ -1,0 +1,233 @@
+"""Pass 3 — thread lifecycle.
+
+- ``thread-orphan`` — every ``threading.Thread(daemon=True)`` must have
+  a reachable stop/join path registered with its owner's shutdown:
+
+  * assigned to ``self.X`` → some method of the same class must call
+    ``self.X.join(...)`` (directly or via an attribute collection the
+    class joins);
+  * assigned to a local / collected into a local list → the enclosing
+    function must join it;
+  * fire-and-forget ``threading.Thread(...).start()`` → finding unless
+    suppressed with a reasoned ``# guberlint: ok thread — <why>``.
+
+  Non-daemon threads are exempt (the interpreter already refuses to
+  exit while they run, so they cannot silently outlive their owner).
+
+- ``thread-swallow`` — in modules that import ``threading``, an
+  ``except Exception:``/bare ``except:`` whose body neither re-raises,
+  logs, returns a value, nor records the swallow metric
+  (``record_swallowed``) is banned: a background thread dying silently
+  is the failure mode this repo can least afford (STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.guberlint.common import Finding, SourceFile, attr_path
+
+PASS = "thread"
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    return attr_path(node.func) in ("threading.Thread", "Thread")
+
+
+def _is_daemon(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is False
+            )
+    return False
+
+
+def _class_joins(cls: ast.ClassDef) -> Set[str]:
+    """Attribute names X for which `self.X.join(...)` (or
+    `<anything>.join(...)` over an iteration of self.X) appears in the
+    class."""
+    joined: Set[str] = set()
+    iterated: Set[str] = set()
+    has_bare_join = False
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                path = attr_path(node.func.value)
+                if path and path.startswith("self."):
+                    joined.add(path.split(".")[1])
+                else:
+                    has_bare_join = True
+        if isinstance(node, ast.For):
+            path = attr_path(node.iter)
+            if path and path.startswith("self."):
+                iterated.add(path.split(".")[1])
+    if has_bare_join:
+        # `for t in self._threads: t.join()` — credit iterated attrs.
+        joined |= iterated
+    return joined
+
+
+def _func_joins(fn: ast.AST) -> Set[str]:
+    """Local names joined within the function (directly or via a loop
+    over a local list)."""
+    joined: Set[str] = set()
+    loops = []  # (target name, iterated name)
+    bare = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                if isinstance(node.func.value, ast.Name):
+                    joined.add(node.func.value.id)
+                else:
+                    bare = True
+        if isinstance(node, ast.For) and isinstance(node.iter, ast.Name):
+            if isinstance(node.target, ast.Name):
+                loops.append((node.target.id, node.iter.id))
+    # `for t in threads: t.join()` joins the whole collection.
+    for target, coll in loops:
+        if target in joined:
+            joined.add(coll)
+        if bare:
+            joined.add(coll)
+    return joined
+
+
+def _swallows_silently(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body is pure swallow: only ``pass``/
+    ``continue``/``...``.  Any raise, return-with-value, assignment,
+    or call (logging, metrics, fallback work) counts as handling — the
+    ban is on the literal `except Exception: pass` shape."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+        ):
+            continue  # docstring / ellipsis
+        if isinstance(stmt, ast.Return) and stmt.value is None:
+            continue  # bare return is still a swallow
+        return False
+    return True
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names = []
+    if isinstance(handler.type, ast.Tuple):
+        names = [attr_path(e) for e in handler.type.elts]
+    else:
+        names = [attr_path(handler.type)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def check_file(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if src.tree is None:
+        return findings
+    threaded = "threading" in src.text and any(
+        isinstance(n, (ast.Import, ast.ImportFrom))
+        and (
+            any(a.name.split(".")[0] == "threading" for a in n.names)
+            if isinstance(n, ast.Import)
+            else (n.module or "").split(".")[0] == "threading"
+        )
+        for n in ast.walk(src.tree)
+    )
+
+    # -- thread-swallow -----------------------------------------------
+    if threaded:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _catches_broad(node):
+                continue
+            if not _swallows_silently(node):
+                continue
+            if src.suppressed(node.lineno, PASS):
+                continue
+            findings.append(
+                Finding(
+                    PASS, "thread-swallow", src.rel, node.lineno,
+                    "<module>", f"except@{node.lineno}",
+                    "broad `except Exception` swallowed silently in a "
+                    "threaded module — narrow it, or log + "
+                    "record_swallowed() so the failure is visible",
+                )
+            )
+
+    # -- thread-orphan -------------------------------------------------
+    # Map every Thread(...) creation to its binding context.
+    classes = {
+        id(n): n for n in ast.walk(src.tree) if isinstance(n, ast.ClassDef)
+    }
+    funcs = [
+        n for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+    def enclosing(node: ast.AST, pool) -> Optional[ast.AST]:
+        best = None
+        for cand in pool:
+            if (
+                cand.lineno <= node.lineno
+                and getattr(cand, "end_lineno", cand.lineno) >= node.lineno
+            ):
+                if best is None or cand.lineno > best.lineno:
+                    best = cand
+        return best
+
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        if not _is_daemon(node):
+            continue
+        if src.suppressed(node.lineno, PASS):
+            continue
+        cls = enclosing(node, classes.values())
+        fn = enclosing(node, funcs)
+        ok = False
+        # Find the assignment target wrapping this call (self.X = ... /
+        # local = ... / element of a list literal that is assigned).
+        target_attr = None
+        target_local = None
+        for stmt in ast.walk(src.tree):
+            if isinstance(stmt, ast.Assign) and any(
+                node is sub or any(node is c for c in ast.walk(sub))
+                for sub in [stmt.value]
+            ):
+                for tgt in stmt.targets:
+                    path = attr_path(tgt)
+                    if path and path.startswith("self."):
+                        target_attr = path.split(".")[1]
+                    elif isinstance(tgt, ast.Name):
+                        target_local = tgt.id
+                break
+        if target_attr and cls is not None:
+            ok = target_attr in _class_joins(cls)
+        elif target_local and fn is not None:
+            ok = target_local in _func_joins(fn)
+        elif fn is not None and not target_attr and not target_local:
+            # Thread in an expression (list literal arg, direct
+            # .start()): credit a join anywhere in the same function
+            # over a comprehension/list the thread landed in.
+            ok = bool(_func_joins(fn)) and ".start()" not in (
+                src.line_text(node.lineno)
+            )
+        if not ok:
+            findings.append(
+                Finding(
+                    PASS, "thread-orphan", src.rel, node.lineno,
+                    getattr(cls, "name", None) or getattr(fn, "name", "<module>"),
+                    f"thread@{getattr(cls, 'name', '')}."
+                    f"{target_attr or target_local or node.lineno}",
+                    "daemon thread without a reachable stop/join path "
+                    "registered with its owner's shutdown — join it in "
+                    "close(), or suppress with a reasoned "
+                    "`# guberlint: ok thread — <why>`",
+                )
+            )
+    return findings

@@ -1,0 +1,347 @@
+"""Pass 2 — JAX trace hygiene.
+
+Over the jit-reachable kernel code (``ops/``, ``core/engine.py``,
+``core/pump.py``, ``core/readback.py``, ``parallel/`` — see
+config.TRACE_SCOPES), flag:
+
+- ``trace-branch`` — Python ``if``/``while``/``assert`` on a
+  traced-value expression inside a jit-reachable function (tracers
+  raise ``TracerBoolConversionError`` at runtime, or worse, silently
+  specialize and recompile per value when the input is weakly typed);
+- ``trace-transfer`` — host transfers (``np.asarray``/``np.array``/
+  ``float()``/``int()``/``bool()``/``.item()``/``.tolist()``) applied
+  to traced values inside jit-reachable code: each one is a device
+  sync + d2h round trip in the serve path;
+- ``trace-shapes`` — every jit definition site must carry a
+  ``# guberlint: shapes <contract>`` annotation documenting what pins
+  its argument shapes/dtypes (the columnar layout / warmup ladder), so
+  an unpinned call surface — the source of surprise XLA recompiles —
+  is visible in review.
+
+Taint model: function parameters are traced (minus ``static_argnums``/
+``static_argnames`` declared by the jit wrapper), taint propagates
+through assignments; ``.shape``/``.ndim``/``.dtype``/``len()``/
+``isinstance()`` and attribute constants strip taint (they are static
+under trace).  jit-reachability is the transitive closure of
+module-level calls from jit roots within each scanned file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.guberlint.common import Finding, SourceFile, attr_path
+
+PASS = "trace"
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap"}
+_STATIC_STRIP_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "range", "tuple", "type", "hasattr",
+                 "getattr"}
+_TRANSFER_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "np.frombuffer", "float", "int", "bool", "np.ascontiguousarray",
+}
+_TRANSFER_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    path = attr_path(node.func)
+    if path in _JIT_NAMES:
+        return True
+    # functools.partial(jax.jit, ...) used as a decorator factory.
+    if path in ("partial", "functools.partial") and node.args:
+        inner = attr_path(node.args[0])
+        return inner in _JIT_NAMES
+    return False
+
+
+def _static_args_of(call: ast.Call) -> Set[str]:
+    """static_argnames declared on the jit call (names only; positional
+    static_argnums are resolved against the wrapped def by the caller)."""
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.add(elt.value)
+    return names
+
+
+def _static_argnums_of(call: ast.Call) -> Set[int]:
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    nums.add(elt.value)
+    return nums
+
+
+class _JitRoot:
+    def __init__(self, func: ast.AST, call: Optional[ast.Call],
+                 site_lines: Tuple[int, ...]):
+        self.func = func  # FunctionDef or Lambda
+        self.call = call  # the jax.jit(...) call, when present
+        self.site_lines = site_lines  # lines eligible for the shapes tag
+
+
+def _collect_roots(src: SourceFile) -> Tuple[List[_JitRoot], Dict[str, ast.FunctionDef]]:
+    """(jit roots, module-level function table)."""
+    funcs: Dict[str, ast.FunctionDef] = {}
+    roots: List[_JitRoot] = []
+    assert src.tree is not None
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+
+    def root_from_call(call: ast.Call, assign_line: int) -> None:
+        if not call.args:
+            return
+        target = call.args[0]
+        if isinstance(target, ast.Lambda):
+            roots.append(_JitRoot(target, call, (assign_line,)))
+        else:
+            name = attr_path(target)
+            fn = funcs.get(name.split(".")[-1]) if name else None
+            if fn is not None:
+                roots.append(_JitRoot(fn, call, (assign_line, fn.lineno)))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) and _is_jit_call(deco):
+                    roots.append(
+                        _JitRoot(node, deco, (deco.lineno, node.lineno))
+                    )
+                elif attr_path(deco) in _JIT_NAMES:
+                    roots.append(
+                        _JitRoot(node, None, (deco.lineno, node.lineno))
+                    )
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_call(node.value):
+                root_from_call(node.value, node.lineno)
+    return roots, funcs
+
+
+def _reachable(
+    roots: List[_JitRoot], funcs: Dict[str, ast.FunctionDef]
+) -> Set[str]:
+    """Names of module-level functions transitively called from jit
+    roots (they execute traced)."""
+    queue = []
+    for r in roots:
+        queue.append(r.func)
+    seen: Set[str] = set()
+    out: Set[str] = set()
+    while queue:
+        fn = queue.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = attr_path(node.func)
+                if name and name.split(".")[-1] in funcs:
+                    callee = funcs[name.split(".")[-1]]
+                    if callee.name not in out:
+                        out.add(callee.name)
+                        queue.append(callee)
+    return out
+
+
+class _TaintChecker(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, scope: str, params: Set[str],
+                 findings: List[Finding]):
+        self.src = src
+        self.scope = scope
+        self.tainted = set(params)
+        self.findings = findings
+
+    # -- taint of an expression ---------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_STRIP_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] is static; arr[i] of a traced arr is traced.
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            name = attr_path(node.func)
+            if name and name.split(".")[-1] in _STATIC_CALLS:
+                return False
+            if name and name.split(".")[0] in ("jnp", "jax", "lax"):
+                return any(self.is_tainted(a) for a in node.args) or any(
+                    self.is_tainted(k.value) for k in node.keywords
+                )
+            # Method call on a traced receiver stays traced
+            # (x.astype(...), x.sum()).
+            if isinstance(node.func, ast.Attribute):
+                return self.is_tainted(node.func.value)
+            return False
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        return False
+
+    # -- statement walk ------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        tainted = self.is_tainted(node.value)
+        for tgt in node.targets:
+            for name in ast.walk(tgt):
+                if isinstance(name, ast.Name):
+                    if tainted:
+                        self.tainted.add(name.id)
+                    else:
+                        self.tainted.discard(name.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.target, ast.Name) and self.is_tainted(node.value):
+            self.tainted.add(node.target.id)
+        self.generic_visit(node)
+
+    def _flag_branch(self, node, kind: str) -> None:
+        if self.is_tainted(node.test) and not self.src.suppressed(
+            node.lineno, PASS
+        ):
+            self.findings.append(
+                Finding(
+                    PASS, "trace-branch", self.src.rel, node.lineno,
+                    self.scope, f"{kind}@{self.scope}",
+                    f"Python `{kind}` on a traced value — use jnp.where/"
+                    "lax.cond or hoist the branch out of the jit",
+                )
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._flag_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._flag_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self.is_tainted(node.test) and not self.src.suppressed(
+            node.lineno, PASS
+        ):
+            self.findings.append(
+                Finding(
+                    PASS, "trace-branch", self.src.rel, node.lineno,
+                    self.scope, f"assert@{self.scope}",
+                    "Python `assert` on a traced value inside jit",
+                )
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = attr_path(node.func)
+        if name:
+            if name in _TRANSFER_CALLS and any(
+                self.is_tainted(a) for a in node.args
+            ):
+                if not self.src.suppressed(node.lineno, PASS):
+                    self.findings.append(
+                        Finding(
+                            PASS, "trace-transfer", self.src.rel,
+                            node.lineno, self.scope,
+                            f"{name}@{self.scope}",
+                            f"host transfer `{name}()` of a traced value "
+                            "inside jit-reachable code",
+                        )
+                    )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TRANSFER_METHODS
+                and self.is_tainted(node.func.value)
+            ):
+                if not self.src.suppressed(node.lineno, PASS):
+                    self.findings.append(
+                        Finding(
+                            PASS, "trace-transfer", self.src.rel,
+                            node.lineno, self.scope,
+                            f".{node.func.attr}@{self.scope}",
+                            f"host transfer `.{node.func.attr}()` of a "
+                            "traced value inside jit-reachable code",
+                        )
+                    )
+        self.generic_visit(node)
+
+
+def _params_of(func: ast.AST, call: Optional[ast.Call]) -> Set[str]:
+    args = func.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    names += [a.arg for a in args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    static: Set[str] = set()
+    if call is not None:
+        static |= _static_args_of(call)
+        positional = [a.arg for a in args.posonlyargs + args.args]
+        for i in _static_argnums_of(call):
+            if 0 <= i < len(positional):
+                static.add(positional[i])
+    return {n for n in names if n not in static and n != "self"}
+
+
+def check_file(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if src.tree is None:
+        return findings
+    roots, funcs = _collect_roots(src)
+
+    # trace-shapes: every jit definition site carries a shapes contract.
+    for root in roots:
+        if src.shapes_annotation(*root.site_lines):
+            continue
+        if any(src.suppressed(ln, PASS) for ln in root.site_lines):
+            continue
+        name = getattr(root.func, "name", "<lambda>")
+        findings.append(
+            Finding(
+                PASS, "trace-shapes", src.rel, min(root.site_lines),
+                name, f"shapes:{name}",
+                "jit definition without a `# guberlint: shapes <contract>` "
+                "annotation pinning its argument shapes/dtypes",
+            )
+        )
+
+    # trace-branch / trace-transfer over jit roots + reachable helpers.
+    reachable = _reachable(roots, funcs)
+    checked: Set[int] = set()
+    for root in roots:
+        fn = root.func
+        if id(fn) in checked or isinstance(fn, ast.Lambda):
+            continue
+        checked.add(id(fn))
+        scope = getattr(fn, "name", "<lambda>")
+        checker = _TaintChecker(src, scope, _params_of(fn, root.call), findings)
+        for stmt in fn.body:
+            checker.visit(stmt)
+    for name in sorted(reachable):
+        fn = funcs[name]
+        if id(fn) in checked:
+            continue
+        checked.add(id(fn))
+        checker = _TaintChecker(src, name, _params_of(fn, None), findings)
+        for stmt in fn.body:
+            checker.visit(stmt)
+    return findings
