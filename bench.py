@@ -204,6 +204,12 @@ def main() -> int:
             result = _run_global(np, platform)
         elif MODE == "herd":
             result = _run_herd(np, platform)
+        elif MODE == "herdnative":
+            # 32 concurrent SINGLE-ITEM RPCs against the h2 fast front:
+            # the native decision plane's per-RPC floor as its own
+            # artifact (herdfast is the same front at the window path;
+            # GUBER_NATIVE_LEDGER=0 gives the same-session A/B pair).
+            result = _run_herd(np, platform, force_fast=True)
         else:
             result = _run_engine(np, platform)
         if backend_error:
@@ -404,6 +410,7 @@ def _run_wire(np, platform: str, *, sketch: bool = False) -> dict:
         device_count=1,
         sweep_interval=0.0,
         ledger=_ledger_enabled(),
+        native_ledger=_native_ledger_enabled(),
         h2_fast_address="127.0.0.1:0" if fast else "",
         h2_fast_window=float(
             os.environ.get("BENCH_LOCAL_BATCH_WAIT", "0.002")
@@ -690,7 +697,7 @@ def _herd_result_valid(pb, res) -> bool:
     return len(resp.responses) == 1 and not resp.responses[0].error
 
 
-def _run_herd(np, platform: str) -> dict:
+def _run_herd(np, platform: str, *, force_fast: bool = False) -> dict:
     """Thundering herd: many concurrent single-item requests for the
     SAME hot key (reference: benchmark_test.go BenchmarkServer's
     thundering-herd subtest) — measures per-request wire overhead plus
@@ -701,7 +708,12 @@ def _run_herd(np, platform: str) -> dict:
     capacity — the role the reference's Go clients play in its own
     benchmark (README.md:97-104).  On this one-core host a grpc-python
     closed loop burns ~250µs/RPC of *client* Python on the server's
-    core.  BENCH_HERD_NATIVE=0 forces the Python-client loop."""
+    core.  BENCH_HERD_NATIVE=0 forces the Python-client loop.
+
+    force_fast (the herdnative config): always serve through the h2
+    fast front, where the native decision plane answers hot-key RPCs
+    inside the C connection threads (GUBER_NATIVE_LEDGER=0 for the
+    same-session A/B: identical front, window path only)."""
     from gubernator_tpu.config import DaemonConfig
     from gubernator_tpu.daemon import spawn_daemon
     from gubernator_tpu.net.grpc_service import V1_SERVICE
@@ -713,7 +725,7 @@ def _run_herd(np, platform: str) -> dict:
     # BENCH_HERD_FAST=1: serve through the native h2 fast front
     # (net/h2_fast.py) — zero per-RPC Python; the C side owns framing
     # and the group-commit window.
-    fast = os.environ.get("BENCH_HERD_FAST", "0") != "0"
+    fast = force_fast or os.environ.get("BENCH_HERD_FAST", "0") != "0"
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
         http_listen_address="127.0.0.1:0",
@@ -722,6 +734,7 @@ def _run_herd(np, platform: str) -> dict:
         device_count=1,
         sweep_interval=0.0,
         ledger=_ledger_enabled(),
+        native_ledger=_native_ledger_enabled(),
         # The herd is what the group-commit window exists for: the
         # concurrent single-item RPCs share one engine dispatch per
         # window (net/wire_window.py).  2ms groups ~arrival_rate×2ms
@@ -760,11 +773,23 @@ def _run_herd(np, platform: str) -> dict:
             if res is not None and _herd_result_valid(pb, res):
                 rpcs, errors, lats, _frame, connected = res
                 rate = rpcs / MEASURE_SECONDS
-                front = (
-                    "native h2 fast front" if fast else "grpc listener"
+                front_stats = (
+                    daemon.h2_fast.stats()
+                    if fast and getattr(daemon, "h2_fast", None)
+                    else None
                 )
+                if fast:
+                    front = "native h2 fast front"
+                    if front_stats and front_stats.get("native_rpcs"):
+                        front = (
+                            "native h2 fast front + decision plane "
+                            f"({front_stats['lanes']} lanes)"
+                        )
+                else:
+                    front = "grpc listener"
                 return {
                     "ledger": _ledger_stats_inproc(daemon),
+                    "front": front_stats,
                     "metric": "rate-limit decisions/sec, thundering herd "
                     f"({connected} concurrent native h2 clients via "
                     f"{front}, 1 hot key, single-item RPCs)",
@@ -848,6 +873,15 @@ def _ledger_enabled() -> bool:
         "0", "false", "no", "off"
     )
 
+def _native_ledger_enabled() -> bool:
+    """GUBER_NATIVE_LEDGER must govern the in-process daemons too —
+    these build DaemonConfig directly, and the config field is
+    authoritative over the front (the A/B pairs depend on it)."""
+    return os.environ.get(
+        "GUBER_NATIVE_LEDGER", "1"
+    ).strip().lower() not in ("0", "false", "no", "off")
+
+
 def _ledger_stats_inproc(daemon) -> Optional[dict]:
     """Ledger counters + the dispatches-per-decision gauge from an
     in-process daemon (wire/herd modes) — every artifact claiming a
@@ -858,7 +892,12 @@ def _ledger_stats_inproc(daemon) -> Optional[dict]:
         return None
     out = led.stats()
     eng = inst.engine
-    decisions = eng.requests_total + out["answered"]
+    # Decisions = engine rows + ledger answers (Python AND native) —
+    # the native plane's answers never touch the engine counters.
+    decisions = (
+        eng.requests_total + out["answered"]
+        + out.get("native_answered", 0)
+    )
     out["dispatches_per_decision"] = (
         round(eng.rounds_total / decisions, 4) if decisions else 0.0
     )
@@ -867,6 +906,7 @@ def _ledger_stats_inproc(daemon) -> Optional[dict]:
 
 _LEDGER_SCRAPE_KEYS = (
     "gubernator_ledger_answered",
+    "gubernator_ledger_native_answered",
     "gubernator_ledger_fallthrough",
     "gubernator_ledger_settles",
     "gubernator_check_counter",
@@ -881,7 +921,8 @@ def _scrape_ledger_raw(http_addrs: list) -> dict:
 
     out: dict = {}
     pat = re.compile(
-        r"^(gubernator_ledger_answered|gubernator_ledger_fallthrough|"
+        r"^(gubernator_ledger_answered|gubernator_ledger_native_answered|"
+        r"gubernator_ledger_fallthrough|"
         r"gubernator_ledger_settles|gubernator_check_counter|"
         r"gubernator_engine_rounds)(?:_total)?\s+([0-9.e+-]+)",
         re.M,
@@ -906,11 +947,13 @@ def _ledger_diff(before: dict, after: dict) -> dict:
         for k in set(before) | set(after)
     }
     answered = d.get("gubernator_ledger_answered", 0)
+    native = d.get("gubernator_ledger_native_answered", 0)
     rounds = d.get("gubernator_engine_rounds", 0)
     engine_rows = d.get("gubernator_check_counter", 0)
-    decisions = engine_rows + answered
+    decisions = engine_rows + answered + native
     return {
         "answered": answered,
+        "native_answered": native,
         "fallthrough": d.get("gubernator_ledger_fallthrough", 0),
         "settles": d.get("gubernator_ledger_settles", 0),
         "dispatches_per_decision": (

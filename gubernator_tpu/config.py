@@ -302,6 +302,17 @@ class DaemonConfig:
     # "127.0.0.1:0" binds an ephemeral port.
     h2_fast_address: str = ""
     h2_fast_window: float = 0.002
+    # SO_REUSEPORT listener lanes for the fast front (GUBER_H2_LANES);
+    # 0 = one lane per CPU.  Accept/framing/decide run on per-lane /
+    # per-connection C threads, so lanes are what lets the front scale
+    # across cores instead of serializing on one listener.
+    h2_lanes: int = 0
+    # Native decision plane (GUBER_NATIVE_LEDGER, default on): delegate
+    # the ledger's exact fast path (sticky over-limit + lease drains)
+    # into the C front so hot-key RPCs never enter Python.  Only
+    # engaged when the decision ledger itself is on and the engine runs
+    # the live system clock.
+    native_ledger: bool = True
 
     metric_flags: List[str] = field(default_factory=list)
 
@@ -445,6 +456,9 @@ def setup_daemon_config(
         ),
         h2_fast_address=_env(d, "GUBER_H2_FAST_ADDRESS", ""),
         h2_fast_window=_env_float_seconds(d, "GUBER_H2_FAST_WINDOW", 0.002),
+        h2_lanes=_env_int(d, "GUBER_H2_LANES", 0),
+        native_ledger=_env(d, "GUBER_NATIVE_LEDGER", "1").strip().lower()
+        not in ("0", "false", "no", "off"),
         metric_flags=[
             f.strip()
             for f in _env(d, "GUBER_METRIC_FLAGS", "").split(",")

@@ -84,6 +84,13 @@ _BREAKERS = int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.RESET_REMAINING)
 _K_COUNTER = 0
 _K_OVER = 1
 _K_LEASE = 2
+# Lease delegated to the native decision plane (core/native_plane.py):
+# the C table is the ONLY drain point until a Python-path touch pulls
+# it back — the fields on the Python entry are the grant-time shadow
+# (limit/duration/reset/expiry stay authoritative; consumed is stale
+# until the pull refreshes it).  Exactly one tier can drain at a time,
+# so delegation can never over-admit.
+_K_NATIVE = 3
 
 # Settle/return record: (key, hits, limit, duration, fnv1a, t_mono,
 # reset).  hits < 0 returns unused lease credit; the `reset` bound
@@ -193,7 +200,7 @@ class LedgerPlan:
     __slots__ = (
         "ledger", "dec", "now_ms", "idx", "n_considered",
         "answered_rows", "ans_st", "ans_rem", "ans_rst",
-        "fall", "fall_elig", "settles", "acquires", "gens",
+        "fall", "fall_elig", "fall_dur_ok", "settles", "acquires", "gens",
         "_batch_hits", "_acq_candidates", "_consumed_log", "_done",
     )
 
@@ -208,6 +215,15 @@ class LedgerPlan:
         self.ans_rst: List[int] = []
         self.fall: List[int] = []
         self.fall_elig: List[bool] = []
+        # Per fall row: the row's duration matched the entry's last
+        # engine-observed duration.  Sticky-OVER inserts require it —
+        # a duration change can RENEW an expired bucket, where the
+        # engine's (OVER, remaining=0) response is a pre-renewal
+        # snapshot while the stored remaining silently became `limit`
+        # (models/spec.py:173-185, reference algorithms.go:131-162);
+        # an insert from that response would answer OVER until the new
+        # reset on a bucket that is actually full.
+        self.fall_dur_ok: List[bool] = []
         # Return/settle records (see module constant note).
         self.settles: List[tuple] = []
         # Acquisition records (key, hits>0, limit, duration, fnv1a).
@@ -362,6 +378,14 @@ class LedgerPlan:
         with led._lock:
             for h, delta in self._consumed_log:
                 e = led._items.get(h)
+                if (
+                    e is not None
+                    and e.kind == _K_NATIVE
+                    and led._native is not None
+                ):
+                    # Re-delegated during this plan: pull back before
+                    # undoing the local drain.
+                    led._undelegate_locked(e)
                 if e is not None and e.kind == _K_LEASE:
                     e.consumed -= delta
             for s in self.settles:
@@ -427,6 +451,11 @@ class DecisionLedger:
 
         self.settle_lag = DurationStat()
         self._readonly = None  # optional _GlobalStatusCache (stats only)
+        # Optional native decision plane (NativeDecisionPlane).  All
+        # bridge calls happen under _lock, so the lock order is always
+        # ledger lock → plane mutex (guberlint's cycle pass sees only
+        # the Python side; the C mutex never calls back out).
+        self._native = None  # guberlint: guarded-by _lock
         self._stop = threading.Event()
         self._flusher = None
         if settle_interval > 0:
@@ -444,6 +473,41 @@ class DecisionLedger:
         """Link the owner-broadcast status cache as the ledger's
         read-only tier (non-owner GLOBAL entries) — unified stats."""
         self._readonly = cache
+
+    def attach_native(self, plane) -> None:
+        """Attach a native decision plane: future lease grants and
+        sticky-OVER inserts are pushed down so hot-key RPCs answer
+        inside the C connection threads; Python-path touches pull the
+        drained counts back (see _K_NATIVE).  The plane's clock is
+        anchored to this engine's clock domain here and on every
+        grant."""
+        with self._lock:
+            plane.set_clock_offset(self.engine.clock.now_ms())
+            self._native = plane
+
+    def detach_native(self) -> None:
+        """Pull every delegated lease back to the Python tier and drop
+        the plane (front shutdown / GUBER_NATIVE_LEDGER flush).  The
+        table is cleared, so stale OVER copies die with it."""
+        with self._lock:
+            plane = self._native
+            if plane is None:
+                return
+            for e in self._items.values():
+                if e.kind == _K_NATIVE:
+                    self._undelegate_locked(e)
+            self._native = None
+            plane.clear()
+
+    def _undelegate_locked(self, e: _Entry) -> None:
+        """Pull a delegated lease back: the plane atomically stops
+        answering the key and returns the drained count, so every
+        native answer is linearized before whatever the caller does
+        next (engine lane, revoke, settle)."""
+        res = self._native.pull(e.key)
+        if res is not None and res[0] == 2:
+            e.consumed = res[1]
+        e.kind = _K_LEASE
 
     def plan(self, dec, now_ms: int, idx=None) -> LedgerPlan:
         """Partition one decoded batch: which rows the ledger answers,
@@ -476,6 +540,11 @@ class DecisionLedger:
         now = now_ms
         answered_rows = plan.answered_rows
         ans_st, ans_rem, ans_rst = plan.ans_st, plan.ans_rem, plan.ans_rst
+        # Lease keys this plan answered locally: still-live ones are
+        # pushed back down to the native plane at the end (a delegated
+        # key pulled up by one mixed RPC must not stay Python-only
+        # while hot native traffic keeps arriving for it).
+        redelegate: List[int] = []
         with self._lock:
             items = self._items
             for k, row in enumerate(rows):
@@ -514,6 +583,13 @@ class DecisionLedger:
                 if key != e.key:
                     self._fall_locked(plan, row, elig, h, None, 0, now)
                     continue
+                if e.kind == _K_NATIVE:
+                    # Python-path touch of a delegated key (a mixed or
+                    # declined RPC, the grpc listener, the GLOBAL
+                    # route): pull the drained count back and continue
+                    # as a live Python lease; if it stays answerable it
+                    # re-delegates below.
+                    self._undelegate_locked(e)
                 lapsed = now > e.reset
                 mismatch = (
                     not elig
@@ -565,6 +641,7 @@ class DecisionLedger:
                     ans_rem.append(e.rem - e.consumed)
                     ans_rst.append(e.reset)
                     self.answered += 1
+                    redelegate.append(h)
                     continue
                 # Drain: same closed form as the collapsed kernel's
                 # extras (admitted = clip(avail // h, 0, 1) for one
@@ -579,6 +656,7 @@ class DecisionLedger:
                     ans_rem.append(e.rem - e.consumed)
                     ans_rst.append(e.reset)
                     self.answered += 1
+                    redelegate.append(h)
                 else:
                     # Exhausted (or an over-ask): return what we still
                     # hold and let the engine make this call.
@@ -614,6 +692,24 @@ class DecisionLedger:
                 plan.acquires.append(
                     (e.key, acq, e.limit, e.duration, h)
                 )
+            if self._native is not None:
+                for h in redelegate:
+                    e = items.get(h)
+                    # Only still-live leases go back down; anything a
+                    # later row of this batch revoked/demoted stays up
+                    # (its engine lane must run first), and duplicates
+                    # no-op on the kind check.
+                    if (
+                        e is not None
+                        and e.kind == _K_LEASE
+                        and now <= e.reset
+                        and now <= e.expiry
+                        and self._native.install_lease(
+                            e.key, e.limit, e.duration, e.reset,
+                            e.rem, e.credit, e.consumed, e.expiry,
+                        )
+                    ):
+                        e.kind = _K_NATIVE
         return plan
 
     # -- locked helpers ------------------------------------------------
@@ -621,6 +717,14 @@ class DecisionLedger:
     def _fall_locked(self, plan, row, elig, h, e, hi, now, lim=0, dur=0) -> None:
         plan.fall.append(row)
         plan.fall_elig.append(elig)
+        # Entries reaching a fall are always _K_COUNTER (OVER/LEASE
+        # callers demote/revoke first), so e.duration is the last
+        # duration an engine row stored for this key; a differing (or
+        # never-observed) duration can trigger the renewal corner —
+        # see the fall_dur_ok note above.
+        plan.fall_dur_ok.append(
+            e is not None and elig and e.duration == dur
+        )
         self.fallthrough += 1
         if e is not None:
             e.gen += 1
@@ -661,6 +765,12 @@ class DecisionLedger:
             e.want = True
 
     def _demote_locked(self, e: _Entry, h: int) -> None:
+        if self._native is not None and e.kind in (_K_OVER, _K_NATIVE):
+            # Drop the plane's copy so it cannot keep answering a
+            # demoted record.  Lease callers pull (undelegate) BEFORE
+            # demoting — reaching here as _K_NATIVE is the defensive
+            # path and forfeits only unused credit (under-admission).
+            self._native.pull(e.key)
         self._key_index.pop(e.key, None)
         e.kind = _K_COUNTER
 
@@ -681,6 +791,13 @@ class DecisionLedger:
 
     def _evict_locked(self) -> None:
         h, e = self._items.popitem(last=False)
+        if self._native is not None and e.kind == _K_NATIVE:
+            # Delegated keys are answered in C, so they never
+            # move_to_end and age toward this LRU edge even while hot:
+            # pull the exact drained count before settling.
+            self._undelegate_locked(e)
+        elif self._native is not None and e.kind == _K_OVER:
+            self._native.pull(e.key)
         if e.kind == _K_LEASE:
             unused = e.credit - e.consumed
             if unused > 0:
@@ -761,6 +878,12 @@ class DecisionLedger:
                         # change): our OVER observation may describe a
                         # replaced bucket — insert nothing.
                         continue
+                    if not plan.fall_dur_ok[j]:
+                        # Duration changed (or first observation): the
+                        # (OVER, 0) response may be the pre-renewal
+                        # snapshot of a bucket whose stored remaining
+                        # just became `limit` — not a sticky state.
+                        continue
                     # Stored status is OVER with remaining 0 (see the
                     # module docstring's case analysis): exact until
                     # the reset passes.
@@ -771,6 +894,13 @@ class DecisionLedger:
                     e.duration = int(dur_a[row])
                     e.reset = rst_l[ns + j]
                     self._key_index[key] = h
+                    if self._native is not None:
+                        # Sticky OVER is read-only until the reset, so
+                        # the plane may hold a COPY (both tiers answer
+                        # it); the demote pulls it.
+                        self._native.install_over(
+                            key, e.limit, e.duration, e.reset
+                        )
                 elif e.kind != _K_COUNTER:
                     # The last row's response fits no fast path (e.g.
                     # OVER with remaining>0 after a limit raise):
@@ -813,6 +943,17 @@ class DecisionLedger:
                 e.rem_hint = rem_l[j]
                 self._key_index[e.key] = h
                 self.leases_granted += 1
+                if self._native is not None:
+                    # Delegate the fresh lease: the plane becomes the
+                    # sole drain point until a Python-path touch pulls
+                    # it back.  Re-anchor the clock at every grant so
+                    # offset drift stays bounded by one lease TTL.
+                    self._native.set_clock_offset(now)
+                    if self._native.install_lease(
+                        e.key, e.limit, e.duration, e.reset,
+                        e.rem, e.credit, 0, e.expiry,
+                    ):
+                        e.kind = _K_NATIVE
 
     # -- dataclass-path coherence --------------------------------------
 
@@ -832,6 +973,11 @@ class DecisionLedger:
                 e = self._items.get(h)
                 if e is None or e.key != k:
                     continue
+                if self._native is not None and e.kind == _K_NATIVE:
+                    # The engine is about to run this key outside the
+                    # ledger: stop the native drains first, then settle
+                    # off the exact pulled count.
+                    self._undelegate_locked(e)
                 if e.kind == _K_LEASE:
                     unused = e.credit - e.consumed
                     if unused > 0 and now <= e.reset:
@@ -861,8 +1007,15 @@ class DecisionLedger:
                 if h is None:
                     continue
                 e = self._items.get(h)
-                if e is not None and e.kind == _K_LEASE and e.key == k:
+                if e is None or e.key != k:
+                    continue
+                if e.kind == _K_LEASE:
                     rem[i] = int(rem[i]) + (e.credit - e.consumed)
+                elif e.kind == _K_NATIVE and self._native is not None:
+                    # Read-only peek: the drained count lives in C.
+                    res = self._native.peek(k)
+                    if res is not None and res[0] == 2:
+                        rem[i] = int(rem[i]) + (res[2] - res[1])
 
     # -- background settle ---------------------------------------------
 
@@ -884,9 +1037,16 @@ class DecisionLedger:
         returns: List[tuple] = []
         with self._lock:
             for h in [
-                h for h, e in self._items.items() if e.kind == _K_LEASE
+                h for h, e in self._items.items()
+                if e.kind in (_K_LEASE, _K_NATIVE)
             ]:
                 e = self._items[h]
+                if now <= e.reset and now <= e.expiry:
+                    continue  # live (possibly delegated): leave it
+                if self._native is not None and e.kind == _K_NATIVE:
+                    # Expired while delegated: pull the exact drained
+                    # count before settling the remainder.
+                    self._undelegate_locked(e)
                 if now > e.reset:
                     # Window over: the held credit died with it.
                     self._demote_locked(e, h)
@@ -960,12 +1120,30 @@ class DecisionLedger:
                     self.settle_lag.mean() * 1e3, 3
                 ),
             }
+        with self._lock:
+            # Under the lock: detach_native (which precedes the plane's
+            # free) also takes it, so the handle stays live across the
+            # dp_stats call.
+            if self._native is not None:
+                # native_answered rides every stats surface (metrics,
+                # bench artifacts): decisions the C plane served with
+                # zero GIL.
+                out.update(self._native.stats())
         if self._readonly is not None:
             out["readonly_entries"] = len(self._readonly)
         return out
+
+    def native_answered(self) -> int:
+        """Decisions answered by the native plane (0 when detached) —
+        the dispatches-per-decision denominator must count them."""
+        with self._lock:
+            if self._native is None:
+                return 0
+            return self._native.stats()["native_answered"]
 
     def close(self) -> None:
         self._stop.set()
         if self._flusher is not None:
             self._flusher.join(timeout=2.0)
+        self.detach_native()
         self.flush_settles()

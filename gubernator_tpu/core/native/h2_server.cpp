@@ -46,6 +46,13 @@
 #include <thread>
 #include <vector>
 
+// Native decision plane (decision_plane.cpp, same .so): whole-RPC
+// hot-key serve inside the connection thread — zero GIL, zero Python.
+extern "C" int64_t dp_try_serve(void* handle, const uint8_t* body,
+                                int64_t len, int64_t max_items,
+                                int64_t now_ms, uint8_t* out,
+                                int64_t out_cap);
+
 namespace {
 
 constexpr uint8_t kData = 0x0, kHeaders = 0x1, kRst = 0x3, kSettings = 0x4,
@@ -162,7 +169,12 @@ struct PendingRpc {
 };
 
 struct Server {
-  int listen_fd = -1;
+  // SO_REUSEPORT listener lanes: one listen fd + accept thread per
+  // lane, all bound to the same port, so the kernel spreads incoming
+  // connections (and therefore framing/decide work, which runs on the
+  // per-connection threads) across cores instead of serializing on
+  // one accept queue.
+  std::vector<int> listen_fds;
   int port = 0;
   WindowCallback callback = nullptr;
   int64_t window_us = 2000;
@@ -173,12 +185,18 @@ struct Server {
   int64_t flush_items = 4096;
   int64_t queued_items = 0;  // guarded by q_mu
   std::atomic<bool> closing{false};
-  std::thread accept_thread, dispatch_thread;
+  std::vector<std::thread> accept_threads;
+  std::thread dispatch_thread;
   std::mutex q_mu;
   std::condition_variable q_cv;
   std::deque<PendingRpc> queue;
+  // Optional native decision plane (decision_plane.cpp).  The Python
+  // side attaches/detaches it; conn threads load it per RPC, so a
+  // detach takes effect at the next request.
+  std::atomic<void*> plane{nullptr};
   // Stats.
   std::atomic<int64_t> rpcs{0}, windows{0}, errors{0};
+  std::atomic<int64_t> native_rpcs{0}, native_items{0};
   // Connection threads are DETACHED (a long-lived daemon must not
   // accumulate unjoined thread handles across connection churn);
   // shutdown coordinates through the live-conn registry + an active
@@ -420,11 +438,11 @@ std::string build_data_payload(const int64_t* cols, int64_t offset,
   return data;
 }
 
-// One RPC's full response: HEADERS immediately, then DATA under the
-// peer's send-side flow-control windows, trailers after the DATA.
-void send_rpc_response(const std::shared_ptr<Conn>& conn, uint32_t stream,
-                       const int64_t* cols, int64_t offset, int64_t k,
-                       int64_t total, int grpc_status) {
+// One RPC's full response from a pre-built grpc-framed DATA payload:
+// HEADERS immediately, then DATA under the peer's send-side
+// flow-control windows, trailers after the DATA.
+void send_rpc_payload(const std::shared_ptr<Conn>& conn, uint32_t stream,
+                      std::string data, int grpc_status) {
   static const std::string kHdr = resp_headers_block();
   std::string hdr;
   frame_header(hdr, static_cast<uint32_t>(kHdr.size()), kHeaders,
@@ -436,13 +454,22 @@ void send_rpc_response(const std::shared_ptr<Conn>& conn, uint32_t stream,
                kFlagEndHeaders | kFlagEndStream, stream);
   tr += tr_block;
   if (grpc_status == 0) {
-    conn->send_response(stream, hdr,
-                        build_data_payload(cols, offset, k, total), tr);
+    conn->send_response(stream, hdr, std::move(data), tr);
   } else {
     // Error replies carry no DATA — headers-only frames are exempt
     // from flow control.
     conn->send_all(hdr + tr);
   }
+}
+
+void send_rpc_response(const std::shared_ptr<Conn>& conn, uint32_t stream,
+                       const int64_t* cols, int64_t offset, int64_t k,
+                       int64_t total, int grpc_status) {
+  send_rpc_payload(conn, stream,
+                   grpc_status == 0
+                       ? build_data_payload(cols, offset, k, total)
+                       : std::string(),
+                   grpc_status);
 }
 
 struct StreamState {
@@ -605,11 +632,44 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                 if (items < 0 || items > 1000) {
                   send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
                 } else {
-                  std::lock_guard<std::mutex> lock(srv->q_mu);
-                  srv->queue.push_back(PendingRpc{
-                      conn, stream, std::move(body), items});
-                  srv->queued_items += items;
-                  srv->q_cv.notify_one();
+                  // Native decision plane: hot-key RPCs answer right
+                  // here, in this connection thread — no queue, no
+                  // window wait, no GIL, no Python frames.  Any
+                  // decline (cold key, fall-through row, out-of-scope
+                  // behavior) takes the window path unchanged.
+                  bool served_native = false;
+                  void* plane = srv->plane.load();
+                  if (plane != nullptr && items > 0) {
+                    std::string resp;
+                    resp.resize(static_cast<size_t>(items) * 48 + 16);
+                    const int64_t m = dp_try_serve(
+                        plane,
+                        reinterpret_cast<const uint8_t*>(body.data()),
+                        static_cast<int64_t>(body.size()), items, -1,
+                        reinterpret_cast<uint8_t*>(&resp[0]),
+                        static_cast<int64_t>(resp.size()));
+                    if (m >= 0) {
+                      resp.resize(static_cast<size_t>(m));
+                      std::string data;
+                      data.push_back(0);  // uncompressed grpc frame
+                      uint8_t len4[4];
+                      put_u32(len4, static_cast<uint32_t>(resp.size()));
+                      data.append(reinterpret_cast<char*>(len4), 4);
+                      data += resp;
+                      send_rpc_payload(conn, stream, std::move(data), 0);
+                      srv->rpcs.fetch_add(1);
+                      srv->native_rpcs.fetch_add(1);
+                      srv->native_items.fetch_add(items);
+                      served_native = true;
+                    }
+                  }
+                  if (!served_native) {
+                    std::lock_guard<std::mutex> lock(srv->q_mu);
+                    srv->queue.push_back(PendingRpc{
+                        conn, stream, std::move(body), items});
+                    srv->queued_items += items;
+                    srv->q_cv.notify_one();
+                  }
                 }
               }
             }
@@ -744,11 +804,11 @@ void dispatch_loop(Server* srv) {
   }
 }
 
-void accept_loop(Server* srv) {
+void accept_loop(Server* srv, int listen_fd) {
   while (!srv->closing.load()) {
     sockaddr_in peer{};
     socklen_t plen = sizeof(peer);
-    int fd = ::accept(srv->listen_fd, reinterpret_cast<sockaddr*>(&peer),
+    int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer),
                       &plen);
     if (fd < 0) {
       if (srv->closing.load()) return;
@@ -782,62 +842,122 @@ void accept_loop(Server* srv) {
 
 extern "C" {
 
-// Start the front on 127.0.0.1:port (0 = ephemeral).  Returns an
-// opaque handle, or nullptr on bind failure.
+// Start the front on 127.0.0.1:port (0 = ephemeral) with `lanes`
+// SO_REUSEPORT listener lanes (degrades to fewer if a lane fails to
+// bind; at least one always exists).  Returns an opaque handle, or
+// nullptr on bind failure.
 void* h2s_start(int32_t port, int64_t window_us, int64_t max_batch,
-                int64_t flush_items, WindowCallback callback) {
+                int64_t flush_items, int32_t lanes,
+                WindowCallback callback) {
   auto* srv = new Server();
   srv->callback = callback;
   srv->window_us = window_us;
   srv->max_batch = max_batch;
   if (flush_items > 0) srv->flush_items = flush_items;
-  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (srv->listen_fd < 0) {
+  if (lanes < 1) lanes = 1;
+  int bind_port = port;
+  if (lanes > 1 && port != 0) {
+    // SO_REUSEPORT lets ANOTHER daemon of the same uid silently share
+    // a fixed port (the kernel would split traffic across two
+    // independent engines — over-admission with no error anywhere).
+    // Probe-bind without it first so a foreign listener still fails
+    // loudly with EADDRINUSE; ephemeral binds can't collide.
+    int probe = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (probe < 0) {
+      delete srv;
+      return nullptr;
+    }
+    int one = 1;
+    setsockopt(probe, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+    const bool free_port =
+        ::bind(probe, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0;
+    ::close(probe);
+    if (!free_port) {
+      delete srv;
+      return nullptr;
+    }
+  }
+  for (int32_t lane = 0; lane < lanes; ++lane) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (lanes > 1)
+      setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(bind_port));
+    inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(fd, 128) != 0) {
+      ::close(fd);
+      break;
+    }
+    if (lane == 0) {
+      // Ephemeral binds learn the port from lane 0; the remaining
+      // lanes bind it explicitly.
+      socklen_t alen = sizeof(addr);
+      getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+      srv->port = ntohs(addr.sin_port);
+      bind_port = srv->port;
+    }
+    srv->listen_fds.push_back(fd);
+  }
+  if (srv->listen_fds.empty()) {
     delete srv;
     return nullptr;
   }
-  int one = 1;
-  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
-  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
-             sizeof(addr)) != 0 ||
-      ::listen(srv->listen_fd, 128) != 0) {
-    ::close(srv->listen_fd);
-    delete srv;
-    return nullptr;
-  }
-  socklen_t alen = sizeof(addr);
-  getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
-  srv->port = ntohs(addr.sin_port);
-  srv->accept_thread = std::thread(accept_loop, srv);
+  for (int fd : srv->listen_fds)
+    srv->accept_threads.emplace_back(accept_loop, srv, fd);
   srv->dispatch_thread = std::thread(dispatch_loop, srv);
   return srv;
+}
+
+// Attach (or detach with nullptr) a decision plane created by
+// dp_create.  The plane must outlive the server's connection threads;
+// the Python side detaches before h2s_stop and frees after it.
+void h2s_attach_plane(void* handle, void* plane) {
+  static_cast<Server*>(handle)->plane.store(plane);
+}
+
+int32_t h2s_lanes(void* handle) {
+  return static_cast<int32_t>(
+      static_cast<Server*>(handle)->listen_fds.size());
 }
 
 int32_t h2s_port(void* handle) {
   return static_cast<Server*>(handle)->port;
 }
 
-void h2s_stats(void* handle, int64_t* out3) {
+// out5: rpcs, windows, errors, native_rpcs, native_items (callers may
+// pass a larger zeroed buffer; only the first five slots are written).
+void h2s_stats(void* handle, int64_t* out5) {
   auto* srv = static_cast<Server*>(handle);
-  out3[0] = srv->rpcs.load();
-  out3[1] = srv->windows.load();
-  out3[2] = srv->errors.load();
+  out5[0] = srv->rpcs.load();
+  out5[1] = srv->windows.load();
+  out5[2] = srv->errors.load();
+  out5[3] = srv->native_rpcs.load();
+  out5[4] = srv->native_items.load();
 }
 
 void h2s_stop(void* handle) {
   auto* srv = static_cast<Server*>(handle);
   srv->closing.store(true);
-  ::shutdown(srv->listen_fd, SHUT_RDWR);
-  ::close(srv->listen_fd);
+  srv->plane.store(nullptr);
+  for (int fd : srv->listen_fds) {
+    ::shutdown(fd, SHUT_RDWR);
+    ::close(fd);
+  }
   {
     std::lock_guard<std::mutex> lock(srv->q_mu);
     srv->q_cv.notify_all();
   }
-  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  for (auto& t : srv->accept_threads)
+    if (t.joinable()) t.join();
   if (srv->dispatch_thread.joinable()) srv->dispatch_thread.join();
   {
     // Conn threads block in recv(); shut their sockets down, then

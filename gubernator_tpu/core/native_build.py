@@ -59,14 +59,29 @@ def sanitizer_preload(mode: Optional[str] = None) -> Optional[str]:
     return out if out and os.path.sep in out and Path(out).exists() else None
 
 
+# Stems built from more than one translation unit.  The h2 front links
+# the decision plane (GIL-free hot-key serve inside the connection
+# threads) and the wire codec (its body decode / response encode) into
+# one .so, so dp_try_serve is an ordinary in-image call for the server.
+_EXTRA_SOURCES = {
+    "h2_server": ["decision_plane.cpp", "wire_codec.cpp"],
+}
+
+
 def ensure_built(stem: str = "intern_table") -> Optional[Path]:
-    """Compile `native/<stem>.cpp` if needed; returns the .so path or
-    None on failure."""
+    """Compile `native/<stem>.cpp` (plus any _EXTRA_SOURCES companions)
+    if needed; returns the .so path or None on failure."""
     if os.environ.get("GUBERNATOR_TPU_NATIVE", "1") == "0":
         return None
     san = san_mode()
     src = _NATIVE_DIR / f"{stem}.cpp"
-    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    sources = [src] + [
+        _NATIVE_DIR / extra for extra in _EXTRA_SOURCES.get(stem, [])
+    ]
+    digest = hashlib.sha256()
+    for s in sources:
+        digest.update(s.read_bytes())
+    tag = digest.hexdigest()[:16]
     if san:
         tag = f"{tag}-{san[0]}san"
     so = _BUILD_DIR / f"{stem}-{tag}.so"
@@ -88,11 +103,7 @@ def ensure_built(stem: str = "intern_table") -> Optional[Path]:
     ]
     if san:
         cmd += [f"-fsanitize={san}", "-g", "-fno-omit-frame-pointer"]
-    cmd += [
-        "-o",
-        str(tmp),
-        str(src),
-    ]
+    cmd += ["-o", str(tmp)] + [str(s) for s in sources]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:

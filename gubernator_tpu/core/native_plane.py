@@ -1,0 +1,218 @@
+"""ctypes wrapper for the native decision plane (decision_plane.cpp).
+
+The plane is the C-resident twin of the ledger's exact fast path:
+sticky over-limit records and delegated credit leases, probed inside
+the h2 server's connection threads with zero GIL acquisitions.  This
+wrapper is the *bridge* side: core/ledger.py pushes grants down
+(`install_over` / `install_lease`), pulls drained counts back
+(`pull`), and peeks for read-only overlays — all of it under the
+ledger's own lock, so the lock order is always ledger lock → plane
+mutex and a lease lives in exactly one tier at a time.
+
+The .so is the combined h2_server build (native_build._EXTRA_SOURCES):
+the server calls dp_try_serve in-image; Python talks to the same table
+through these entry points.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.core.native_build import ensure_built
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+_lib = None
+
+# Same breaker set as core/ledger._BREAKERS — the two tiers must agree
+# on what falls through, or a native answer could cover a row the
+# Python ledger would have revoked on.
+_BREAKERS = int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.RESET_REMAINING)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the combined h2_server/decision-plane
+    .so and register the dp_* signatures."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = ensure_built("h2_server")
+    if so is None:
+        return None
+    lib = ctypes.CDLL(str(so))
+    i64, i32, vp = ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p
+    lib.dp_create.restype = vp
+    lib.dp_create.argtypes = [i64, i64, i64, i64, i32, i32]
+    lib.dp_free.argtypes = [vp]
+    lib.dp_set_clock_offset.argtypes = [vp, i64]
+    lib.dp_install_over.restype = i64
+    lib.dp_install_over.argtypes = [vp, ctypes.c_char_p, i64, i64, i64, i64]
+    lib.dp_install_lease.restype = i64
+    lib.dp_install_lease.argtypes = [
+        vp, ctypes.c_char_p, i64, i64, i64, i64, i64, i64, i64, i64,
+    ]
+    lib.dp_pull.restype = i64
+    lib.dp_pull.argtypes = [vp, ctypes.c_char_p, i64, vp]
+    lib.dp_peek.restype = i64
+    lib.dp_peek.argtypes = [vp, ctypes.c_char_p, i64, vp]
+    lib.dp_clear.argtypes = [vp]
+    lib.dp_probe.restype = i64
+    lib.dp_probe.argtypes = [
+        vp, ctypes.c_char_p, i64, i32, i32, i64, i64, i64, i64, vp,
+    ]
+    lib.dp_try_serve.restype = i64
+    lib.dp_try_serve.argtypes = [vp, ctypes.c_char_p, i64, i64, i64, vp, i64]
+    lib.dp_stats.argtypes = [vp, vp]
+    _lib = lib
+    return _lib
+
+
+class NativeDecisionPlane:
+    """One native table, owned by the attaching front / ledger pair."""
+
+    def __init__(self, *, max_keys: int = 65536, disqualify_mask: int = 0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native decision plane unavailable")
+        self._lib = lib
+        self._handle = lib.dp_create(
+            max_keys,
+            int(Algorithm.TOKEN_BUCKET),
+            _BREAKERS,
+            disqualify_mask,
+            int(Status.OVER_LIMIT),
+            int(Status.UNDER_LIMIT),
+        )
+        if not self._handle:
+            raise RuntimeError("dp_create failed")
+
+    # -- grant / pull bridge (called under the ledger lock) ------------
+
+    def set_clock_offset(self, ledger_now_ms: int) -> None:
+        """Anchor the plane's realtime clock to the ledger's domain."""
+        self._lib.dp_set_clock_offset(
+            self._handle, int(ledger_now_ms) - int(time.time() * 1000)
+        )
+
+    def install_over(
+        self, key: bytes, limit: int, duration: int, reset: int
+    ) -> bool:
+        return bool(
+            self._lib.dp_install_over(
+                self._handle, key, len(key), limit, duration, reset
+            )
+        )
+
+    def install_lease(
+        self,
+        key: bytes,
+        limit: int,
+        duration: int,
+        reset: int,
+        rem: int,
+        credit: int,
+        consumed: int,
+        expiry: int,
+    ) -> bool:
+        return bool(
+            self._lib.dp_install_lease(
+                self._handle, key, len(key), limit, duration, reset,
+                rem, credit, consumed, expiry,
+            )
+        )
+
+    def pull(self, key: bytes) -> Optional[Tuple[int, int, int, int, int]]:
+        """Remove the record; returns (kind, consumed, credit, rem,
+        reset) or None when absent.  Linearizes every native answer for
+        the key before the caller's next step."""
+        out = np.zeros(4, dtype=np.int64)
+        kind = self._lib.dp_pull(
+            self._handle, key, len(key),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        if kind == 0:
+            return None
+        return (int(kind), int(out[0]), int(out[1]), int(out[2]),
+                int(out[3]))
+
+    def peek(self, key: bytes) -> Optional[Tuple[int, int, int, int, int]]:
+        out = np.zeros(4, dtype=np.int64)
+        kind = self._lib.dp_peek(
+            self._handle, key, len(key),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        if kind == 0:
+            return None
+        return (int(kind), int(out[0]), int(out[1]), int(out[2]),
+                int(out[3]))
+
+    def clear(self) -> None:
+        self._lib.dp_clear(self._handle)
+
+    # -- serve entries (tests drive these; the h2 server calls the C
+    # -- twin in-image) ------------------------------------------------
+
+    def probe(
+        self,
+        key: bytes,
+        algo: int,
+        behavior: int,
+        hits: int,
+        limit: int,
+        duration: int,
+        now_ms: int,
+    ) -> Optional[Tuple[int, int, int]]:
+        """One item against the table at an explicit clock; commits the
+        drain.  Returns (status, remaining, reset) or None."""
+        out = np.zeros(3, dtype=np.int64)
+        ok = self._lib.dp_probe(
+            self._handle, key, len(key), algo, behavior, hits, limit,
+            duration, now_ms, out.ctypes.data_as(ctypes.c_void_p),
+        )
+        if not ok:
+            return None
+        return int(out[0]), int(out[1]), int(out[2])
+
+    def try_serve(
+        self, body: bytes, max_items: int = 1000, now_ms: int = -1
+    ) -> Optional[bytes]:
+        """Whole-RPC serve of a GetRateLimitsReq payload: the exact
+        code path the h2 connection threads run.  Returns the
+        GetRateLimitsResp bytes, or None on decline."""
+        cap = 48 * max(1, max_items) + 16
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.dp_try_serve(
+            self._handle, body, len(body), max_items, now_ms, out, cap
+        )
+        if n < 0:
+            return None
+        return out.raw[:n]
+
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = np.zeros(8, dtype=np.int64)
+        self._lib.dp_stats(
+            self._handle, out.ctypes.data_as(ctypes.c_void_p)
+        )
+        return {
+            "native_answered": int(out[0]),
+            "native_rpcs": int(out[1]),
+            "native_declined": int(out[2]),
+            "native_entries": int(out[3]),
+            "native_installs": int(out[4]),
+            "native_pulls": int(out[5]),
+        }
+
+    @property
+    def handle(self) -> int:
+        """Raw dp handle for h2s_attach_plane."""
+        return self._handle
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dp_free(self._handle)
+            self._handle = None

@@ -261,7 +261,14 @@ class Daemon:
 
             port = int(conf.h2_fast_address.rpartition(":")[2] or 0)
             self.h2_fast = H2FastFront(
-                self.instance, port=port, window_s=conf.h2_fast_window
+                self.instance,
+                port=port,
+                window_s=conf.h2_fast_window,
+                lanes=conf.h2_lanes or None,
+                # The config field is authoritative (setup_daemon_config
+                # parsed GUBER_NATIVE_LEDGER once); the front still
+                # applies its live-clock gate.
+                native_ledger=conf.native_ledger,
             )
             self.h2_fast_address = self.h2_fast.address
 

@@ -21,12 +21,24 @@ UNIMPLEMENTED(12); point such traffic at the full gRPC listener
 Enable with GUBER_H2_FAST_ADDRESS=127.0.0.1:<port> (0 = ephemeral);
 GUBER_H2_FAST_WINDOW tunes the C-side group-commit window (default
 2 ms, the §13 knee).
+
+Native decision plane (GUBER_NATIVE_LEDGER, default on when the
+decision ledger runs): the ledger's exact fast path — sticky
+over-limit answers and credit-lease drains — delegated into a C table
+(core/native/decision_plane.cpp) probed inside the connection threads,
+so hot-key RPCs complete with zero GIL acquisitions and zero Python
+frames; only cold/fall-through traffic enters the per-window Python
+path.  GUBER_H2_LANES (default: CPU count) shards the listener across
+SO_REUSEPORT accept lanes.  The plane anchors to CLOCK_REALTIME, so it
+only attaches when the engine runs on the live SYSTEM_CLOCK (frozen
+test clocks keep the Python-only ledger).
 """
 
 from __future__ import annotations
 
 import ctypes
 import logging
+import os
 from typing import Optional
 
 import numpy as np
@@ -61,14 +73,41 @@ def load() -> Optional[ctypes.CDLL]:
     lib.h2s_start.restype = ctypes.c_void_p
     lib.h2s_start.argtypes = [
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-        _CALLBACK,
+        ctypes.c_int32, _CALLBACK,
     ]
     lib.h2s_port.restype = ctypes.c_int32
     lib.h2s_port.argtypes = [ctypes.c_void_p]
+    lib.h2s_lanes.restype = ctypes.c_int32
+    lib.h2s_lanes.argtypes = [ctypes.c_void_p]
     lib.h2s_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.h2s_attach_plane.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_stop.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
+
+
+def default_lanes() -> int:
+    """GUBER_H2_LANES, defaulting to the CPU count — the SO_REUSEPORT
+    sharding only helps while there are cores to spread accept/framing/
+    decide across.  0 (config.py's documented auto value) and
+    malformed values mean auto, not one lane."""
+    v = os.environ.get("GUBER_H2_LANES", "").strip()
+    try:
+        n = int(v) if v else 0
+    except ValueError:
+        log.warning("GUBER_H2_LANES=%r not an integer; using CPU count", v)
+        n = 0
+    if n > 0:
+        return n
+    return max(1, os.cpu_count() or 1)
+
+
+def native_ledger_enabled() -> bool:
+    """GUBER_NATIVE_LEDGER (default on): delegate the ledger fast path
+    to the C decision plane."""
+    return os.environ.get("GUBER_NATIVE_LEDGER", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
 
 
 class H2FastFront:
@@ -82,6 +121,8 @@ class H2FastFront:
         window_s: float = 0.002,
         max_batch: int = 16384,
         flush_items: int = 4096,  # early-flush: an engine-batch-worth
+        lanes: Optional[int] = None,
+        native_ledger: Optional[bool] = None,
     ):
         lib = load()
         if lib is None:
@@ -91,12 +132,56 @@ class H2FastFront:
         # The ctypes callback object must outlive the server.
         self._cb = _CALLBACK(self._window)
         self._handle = lib.h2s_start(
-            port, int(window_s * 1e6), max_batch, flush_items, self._cb
+            port, int(window_s * 1e6), max_batch, flush_items,
+            default_lanes() if lanes is None else max(1, int(lanes)),
+            self._cb,
         )
         if not self._handle:
             raise RuntimeError("h2 fast front failed to bind")
         self.port = int(lib.h2s_port(self._handle))
         self.address = f"127.0.0.1:{self.port}"
+        self.lanes = int(lib.h2s_lanes(self._handle))
+        self.plane = None
+        self._attach_plane(native_ledger)
+
+    def _attach_plane(self, native_ledger: Optional[bool]) -> None:
+        """Create and attach the native decision plane when the ledger
+        runs on a live clock.  `native_ledger` False = off, True = on,
+        None = GUBER_NATIVE_LEDGER (the direct-construction default);
+        either way frozen/managed clocks refuse the plane — it
+        compares entry deadlines against CLOCK_REALTIME, and a clock
+        racing ahead of realtime would let stale leases answer (tests
+        that manage the clock themselves attach via
+        ledger.attach_native directly)."""
+        ledger = getattr(self.instance, "ledger", None)
+        if ledger is None:
+            return
+        if native_ledger is None:
+            native_ledger = native_ledger_enabled()
+        if not native_ledger:
+            return
+        from gubernator_tpu.clock import SYSTEM_CLOCK
+
+        clock = self.instance.engine.clock
+        if clock is not SYSTEM_CLOCK or clock.frozen:
+            log.info(
+                "native decision plane disabled: engine clock is "
+                "not the live system clock"
+            )
+            return
+        try:
+            import gubernator_tpu.service as svc
+            from gubernator_tpu.core.native_plane import NativeDecisionPlane
+
+            self.plane = NativeDecisionPlane(
+                max_keys=getattr(ledger, "max_keys", 65536),
+                disqualify_mask=svc.COLUMNAR_DISQUALIFIERS,
+            )
+        except (RuntimeError, OSError) as e:
+            log.warning("native decision plane unavailable: %s", e)
+            return
+        ledger.attach_native(self.plane)
+        self._lib.h2s_attach_plane(self._handle, self.plane.handle)
 
     # -- the per-window entry ------------------------------------------
 
@@ -261,17 +346,35 @@ class H2FastFront:
     # -- lifecycle ------------------------------------------------------
 
     def stats(self) -> dict:
-        out = np.zeros(3, dtype=np.int64)
+        out = np.zeros(8, dtype=np.int64)
         self._lib.h2s_stats(
             self._handle, out.ctypes.data_as(ctypes.c_void_p)
         )
-        return {
+        stats = {
             "rpcs": int(out[0]),
             "windows": int(out[1]),
             "errors": int(out[2]),
+            "native_rpcs": int(out[3]),
+            "native_items": int(out[4]),
+            "lanes": self.lanes,
         }
+        if self.plane is not None:
+            stats.update(self.plane.stats())
+        return stats
 
     def close(self) -> None:
         if self._handle:
+            if self.plane is not None:
+                # Detach before stop: conn threads re-read the plane
+                # pointer per RPC, so no new native serves start; stop
+                # then joins/drains them before the ledger pulls its
+                # credit back and the table is freed.
+                self._lib.h2s_attach_plane(self._handle, None)
             self._lib.h2s_stop(self._handle)
             self._handle = None
+            if self.plane is not None:
+                ledger = getattr(self.instance, "ledger", None)
+                if ledger is not None:
+                    ledger.detach_native()
+                self.plane.close()
+                self.plane = None

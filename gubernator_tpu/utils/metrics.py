@@ -321,10 +321,25 @@ class InstanceCollector(Collector):
                 sum_value=led.settle_lag.total,
             )
             yield s
+        # One dp_stats round trip per scrape — the value feeds both the
+        # counter and the dispatches-per-decision denominator.
+        native_answered = led.native_answered() if led else 0
+        if led is not None:
+            c = CounterMetricFamily(
+                "gubernator_ledger_native_answered",
+                "Decisions answered by the native decision plane "
+                "(C-resident ledger fast path: zero GIL, zero Python "
+                "frames, zero device work).",
+            )
+            c.add_metric([], native_answered)
+            yield c
         # Device dispatches per decision: the number the ledger exists
         # to push below 1 on hot-key traffic.  Decisions = engine rows
-        # + ledger answers; dispatches = engine kernel rounds.
-        decisions = eng.requests_total + (led.answered if led else 0)
+        # + ledger answers (Python AND native); dispatches = engine
+        # kernel rounds.
+        decisions = eng.requests_total + (
+            led.answered + native_answered if led else 0
+        )
         g = GaugeMetricFamily(
             "gubernator_dispatches_per_decision",
             "Engine kernel rounds per rate-limit decision "

@@ -117,6 +117,15 @@ CONFIGS: dict[str, dict] = {
         "BENCH_CAPACITY": str(1 << 17),
         "BENCH_HERD_FAST": "1",
     },
+    # The herd through the fast front's NATIVE DECISION PLANE: hot-key
+    # single-item RPCs answered inside the C connection threads — zero
+    # GIL, zero Python frames (core/native/decision_plane.cpp).  The
+    # same-session A/B is GUBER_NATIVE_LEDGER=0 over this config.
+    "herdnative": {
+        "BENCH_MODE": "herdnative",
+        "BENCH_KEYS": "1",
+        "BENCH_CAPACITY": str(1 << 17),
+    },
     # Throughput-optimal operating point: batch 32768 amortizes the
     # tunneled backend's per-RPC fixed costs 4x deeper than the
     # default-config batch 8192 (PERF.md §9 transport arithmetic).

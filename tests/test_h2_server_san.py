@@ -59,7 +59,7 @@ def window(buf, length, counts_ptr, lens_ptr, n_rpcs, total, out_ptr,
     return 0
 
 cb = h2_fast._CALLBACK(window)
-handle = lib.h2s_start(0, 500, 16384, 4096, cb)
+handle = lib.h2s_start(0, 500, 16384, 4096, 2, cb)  # 2 listener lanes
 assert handle, "h2 server failed to bind"
 print("PORT", int(lib.h2s_port(handle)), flush=True)
 sys.stdin.read()  # parent closes stdin when the hammer is done
@@ -198,3 +198,124 @@ def test_h2_server_threaded_stress_under_tsan():
         f"stdout: {out[-2000:]}\nstderr: {err[-4000:]}"
     )
     assert "san stress ok" in out
+
+
+# Decision-plane stress, PRELOADED: concurrent dp_try_serve lanes race
+# install/pull/probe churn on a shared hot key — the coherence
+# protocol's exact concurrency shape (conn threads drain while the
+# Python tier pulls/re-delegates).  Admissions are conserved: every
+# pulled `consumed` count plus the post-pull admissions must equal the
+# lanes' observed total.
+_PLANE_SRC = r"""
+import ctypes, sys, threading
+import numpy as np
+
+from gubernator_tpu.core import native_plane
+
+plane = native_plane.NativeDecisionPlane(disqualify_mask=0)
+key = b"san_hot"
+NOW = 1_000_000
+N_LANES = 6
+ITERS = 2000
+
+# A tiny hand-rolled GetRateLimitsReq: name="san", unique_key="hot",
+# hits=1, limit=1<<40, duration=60000 (avoids importing protobuf into
+# the TSan'd process).
+def enc_field(tag, wt, payload):
+    return bytes([(tag << 3) | wt]) + payload
+def varint(v):
+    out = b""
+    while v >= 0x80:
+        out += bytes([(v & 0x7F) | 0x80]); v >>= 7
+    return out + bytes([v])
+item = (enc_field(1, 2, varint(3) + b"san") + enc_field(2, 2, varint(3) + b"hot")
+        + enc_field(3, 0, varint(1)) + enc_field(4, 0, varint(1 << 40))
+        + enc_field(5, 0, varint(60000)))
+body = enc_field(1, 2, varint(len(item)) + item)
+
+admitted = [0] * N_LANES
+def lane(t):
+    for _ in range(ITERS):
+        if plane.try_serve(body, max_items=1, now_ms=NOW) is not None:
+            admitted[t] += 1
+
+def churn():
+    # The Python tier's pull/re-install cycle racing the lanes.
+    consumed_total = 0
+    for i in range(400):
+        res = plane.pull(key)
+        if res is not None:
+            consumed_total += res[1]
+        plane.install_lease(key, 1 << 40, 60000, NOW + 60000,
+                            1 << 40, 1 << 30, 0, NOW + 60000)
+    return consumed_total
+
+plane.install_lease(key, 1 << 40, 60000, NOW + 60000, 1 << 40, 1 << 30, 0, NOW + 60000)
+threads = [threading.Thread(target=lane, args=(t,)) for t in range(N_LANES)]
+for t in threads: t.start()
+pulled = churn()
+for t in threads: t.join()
+res = plane.pull(key)
+final = res[1] if res is not None else 0
+total = sum(admitted)
+assert total == pulled + final, (total, pulled, final)
+plane.close()
+print("plane san stress ok admitted=%d" % total, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_decision_plane_threaded_stress_under_tsan():
+    """TSan over the decision plane's install/probe/pull protocol —
+    the exact lock shape the h2 connection threads and the ledger
+    bridge exercise concurrently (round-8 harness, extended per the
+    native-plane PR)."""
+    if os.environ.get("GUBER_NATIVE_SAN", "") in ("", "0"):
+        pytest.skip("set GUBER_NATIVE_SAN=1 to run the TSan stress")
+    preload = sanitizer_preload("thread")
+    if preload is None:
+        pytest.skip("libtsan not available from this toolchain")
+    orig_san = os.environ.get("GUBER_NATIVE_SAN")
+    os.environ["GUBER_NATIVE_SAN"] = "thread"
+    try:
+        so = ensure_built("h2_server")
+    finally:
+        if orig_san is None:
+            os.environ.pop("GUBER_NATIVE_SAN", None)
+        else:
+            os.environ["GUBER_NATIVE_SAN"] = orig_san
+    if so is None:
+        pytest.skip("sanitized h2_server build failed (no g++?)")
+    supp = REPO / "tests" / "tsan_suppressions.txt"
+    proc = subprocess.run(
+        [sys.executable, "-c", _PLANE_SRC],
+        cwd=REPO,
+        env=dict(
+            os.environ,
+            GUBER_NATIVE_SAN="thread",
+            LD_PRELOAD=preload,
+            TSAN_OPTIONS=(
+                "halt_on_error=1 exitcode=66 report_thread_leaks=0 "
+                f"report_mutex_bugs=0 detect_deadlocks=0 suppressions={supp}"
+            ),
+            # pymalloc recycles the ctypes output buffers through its
+            # own pools, invisible to TSan — a stale encode write then
+            # pairs with a fresh buffer's memset in another thread as
+            # a phantom race.  Raw malloc keeps the free/malloc
+            # happens-before visible.
+            PYTHONMALLOC="malloc",
+            GUBERNATOR_TPU_X64="0",
+            GUBERNATOR_TPU_COMPILE_CACHE="0",
+        ),
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert "ThreadSanitizer" not in proc.stderr, (
+        "TSan report from decision plane:\n" + proc.stderr[-4000:]
+    )
+    assert proc.returncode == 0, (
+        f"plane san stress failed rc={proc.returncode}\n"
+        f"stdout: {proc.stdout[-1000:]}\nstderr: {proc.stderr[-3000:]}"
+    )
+    assert "plane san stress ok" in proc.stdout

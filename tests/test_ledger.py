@@ -239,6 +239,35 @@ def test_sticky_over_limit_boundary_at_reset():
     h.ledger.close()
 
 
+def test_duration_change_renewal_is_not_sticky():
+    """Regression (found by the native-plane RPC fuzz, seed 23): a
+    duration change that renews an expired bucket makes the engine
+    respond (OVER, remaining=0) — a PRE-renewal snapshot — while the
+    stored remaining silently becomes `limit` (models/spec.py:173-185).
+    Learning that response as a sticky-OVER record then answers OVER
+    until the new reset on a bucket that is actually full.  The insert
+    must be suppressed whenever the row's duration differs from the
+    entry's last engine-observed duration."""
+    clock = Clock().freeze()
+    h = Harness(clock, lease_size=4, hot_threshold=100)
+    oracle = SpecOracle(clock)
+    key = b"svc_renew"
+    rows = [(key, 0, 0, 3, 3, 1000, 0)]
+    _check_batch(h, oracle, rows)            # consumes to 0
+    _check_batch(h, oracle, rows)            # OVER; sticky record learned
+    assert h.ledger.stats()["over_entries"] == 1
+    # Advance so that created + NEW duration has already passed, while
+    # the OLD reset has not: the duration-change row renews the bucket.
+    clock.advance(ms=500)
+    renew = [(key, 0, 0, 1, 3, 400, 0)]
+    _check_batch(h, oracle, renew, tag="renewing row")
+    # The renewed bucket is FULL; a sticky re-insert from the renewing
+    # row's (OVER, 0) response would answer OVER here instead.
+    _check_batch(h, oracle, [(key, 0, 0, 0, 3, 400, 0)], tag="post-renewal")
+    _check_batch(h, oracle, [(key, 0, 0, 1, 3, 400, 0)], tag="drains again")
+    h.ledger.close()
+
+
 def test_reset_remaining_bypasses_and_revokes():
     clock = Clock().freeze()
     h = Harness(clock, lease_size=16, hot_threshold=1)

@@ -1,0 +1,474 @@
+"""Native decision plane ←→ Python ledger parity.
+
+The plane (core/native/decision_plane.cpp) must be bit-equal to the
+Python ledger (core/ledger.py) — and transitively to models/spec.py —
+across grant→drain→revoke cycles, TTL expiry mid-stream, the sticky
+boundary exactly at reset, and every precondition break the ledger
+declines on (leaky rows, Gregorian, RESET_REMAINING, config changes,
+negative hits).  The harness serves each RPC exactly the way the h2
+connection threads do: dp_try_serve first (explicit clock), the Python
+plan/learn path on decline; the oracle applies the identical rows
+sequentially through the scalar spec.
+
+The coherence protocol's concurrency contract is pinned separately:
+racing native drains against the Python pull can only ever
+UNDER-admit, and credit is conserved exactly once the dust settles.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core import native_plane
+from gubernator_tpu.service import COLUMNAR_DISQUALIFIERS
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+from test_ledger import Harness, SpecOracle, make_dec
+
+if native_plane.load() is None:
+    pytest.skip(
+        "native decision plane unavailable (no g++?)",
+        allow_module_level=True,
+    )
+
+from gubernator_tpu.net.pb import gubernator_pb2 as pb  # noqa: E402
+
+
+def _encode(rows) -> bytes:
+    """rows [(key, algo, behavior, hits, limit, duration, burst)] →
+    GetRateLimitsReq bytes.  Keys are b"<name>_<unique>"."""
+    reqs = []
+    for key, algo, behavior, hits, limit, duration, burst in rows:
+        name, _, uk = key.decode().partition("_")
+        reqs.append(
+            pb.RateLimitReq(
+                name=name, unique_key=uk, hits=hits, limit=limit,
+                duration=duration, algorithm=algo, behavior=behavior,
+                burst=burst,
+            )
+        )
+    return pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+
+
+class NativeHarness(Harness):
+    """Engine + ledger + attached native plane, served RPC-shaped the
+    way the h2 connection threads do it."""
+
+    def __init__(self, clock, **kw):
+        super().__init__(clock, **kw)
+        self.plane = native_plane.NativeDecisionPlane(
+            disqualify_mask=COLUMNAR_DISQUALIFIERS
+        )
+        self.ledger.attach_native(self.plane)
+        self.native_answers = 0
+
+    def serve_rpc(self, rows):
+        """Native-first: the C table answers whole hot RPCs; declines
+        fall to the ledger's plan/learn path (the window callback)."""
+        now = self.clock.now_ms()
+        out = self.plane.try_serve(
+            _encode(rows), max_items=len(rows), now_ms=now
+        )
+        if out is not None:
+            self.native_answers += len(rows)
+            resp = pb.GetRateLimitsResp.FromString(out)
+            assert len(resp.responses) == len(rows)
+            return [
+                (int(r.status), int(r.limit), int(r.remaining),
+                 int(r.reset_time))
+                for r in resp.responses
+            ]
+        st, lim, rem, rst = self.serve(make_dec(rows))
+        return [
+            (int(st[i]), int(lim[i]), int(rem[i]), int(rst[i]))
+            for i in range(len(rows))
+        ]
+
+    def close(self):
+        self.ledger.close()
+        self.plane.close()
+
+
+def _check_rpc(h, oracle, rows, tag=""):
+    got = h.serve_rpc(rows)
+    expect = oracle.serve(rows)
+    for i, (e, g) in enumerate(zip(expect, got)):
+        assert g == e, (
+            f"{tag} row {i} key={rows[i][0]!r} hits={rows[i][3]}: "
+            f"native/ledger={g} spec={e}"
+        )
+
+
+def _hot(key, hits=1, limit=1000, duration=60000, behavior=0, algo=0):
+    return (key, algo, behavior, hits, limit, duration, 0)
+
+
+def _fuzz_native(seed, n_rpcs, n_keys, lease_ttl=0.05, limit_hi=12):
+    rng = np.random.default_rng(seed)
+    clock = Clock().freeze()
+    h = NativeHarness(
+        clock, lease_size=8, lease_ttl=lease_ttl, hot_threshold=2
+    )
+    oracle = SpecOracle(clock)
+    keys = [b"n_k%d" % i for i in range(n_keys)]
+    limits = rng.integers(1, limit_hi, n_keys)
+    durations = rng.integers(1, 4, n_keys) * 40
+    try:
+        for b in range(n_rpcs):
+            clock.advance(ms=int(rng.integers(0, 12)))
+            if rng.random() < 0.06:
+                # Jump past resets / lease TTLs.
+                clock.advance(ms=int(rng.integers(40, 200)))
+            if rng.random() < 0.1:
+                # Config churn: limit or duration changes mid-lease.
+                j = int(rng.integers(0, n_keys))
+                if rng.random() < 0.5:
+                    limits[j] = int(rng.integers(1, limit_hi))
+                else:
+                    durations[j] = int(rng.integers(1, 4)) * 40
+            rows = []
+            # RPC-shaped: mostly single-item (the herd shape the
+            # native path exists for), sometimes multi-item so the
+            # all-or-nothing decline and the mixed pull/re-delegate
+            # paths run.
+            for _ in range(1 if rng.random() < 0.7 else int(rng.integers(2, 5))):
+                j = int(rng.integers(0, n_keys))
+                algo = (
+                    int(Algorithm.LEAKY_BUCKET)
+                    if rng.random() < 0.08
+                    else int(Algorithm.TOKEN_BUCKET)
+                )
+                # Gregorian stays out: COLUMNAR_DISQUALIFIERS keeps it
+                # off every columnar front (and off the plane — pinned
+                # in test_native_declines_out_of_scope_rows), so the
+                # oracle comparison would be vacuous here.
+                behavior = 0
+                if rng.random() < 0.04:
+                    behavior = int(Behavior.RESET_REMAINING)
+                hits = int(rng.integers(0, 4))
+                if rng.random() < 0.05:
+                    hits = int(rng.integers(4, 20))  # over-asks
+                if rng.random() < 0.03:
+                    hits = -int(rng.integers(1, 3))
+                rows.append(
+                    (keys[j], algo, behavior, hits, int(limits[j]),
+                     int(durations[j]), int(rng.integers(0, 3)) * 7)
+                )
+            _check_rpc(h, oracle, rows, tag=f"rpc {b}")
+    finally:
+        h.close()
+    # The fuzz must actually exercise the native tier.
+    assert h.native_answers > 0
+    assert h.ledger.stats()["leases_granted"] > 0
+
+
+def test_native_parity_fuzz_vs_spec():
+    _fuzz_native(seed=13, n_rpcs=300, n_keys=5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_native_parity_fuzz_soak(seed):
+    _fuzz_native(seed=seed, n_rpcs=1500, n_keys=8)
+
+
+def test_native_drains_are_sequential_and_exact():
+    """Steady-state herd shape: grant → delegate → every subsequent
+    single-item RPC answers in C with the exact sequential remaining."""
+    clock = Clock().freeze()
+    h = NativeHarness(clock, lease_size=64, lease_ttl=10.0, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"n_hot"
+    for i in range(80):
+        _check_rpc(h, oracle, [_hot(key, limit=10_000)], tag=f"hit {i}")
+    assert h.native_answers >= 60  # all post-grant traffic native
+    assert h.plane.stats()["native_answered"] == h.native_answers
+    h.close()
+
+
+def test_native_sticky_over_boundary_at_reset():
+    """Sticky OVER must answer natively until EXACTLY the reset (now ==
+    reset still answers, matching ledger.plan's `now > reset` lapse),
+    and decline one past it so the engine serves the fresh window."""
+    clock = Clock().freeze()
+    h = NativeHarness(clock, lease_size=4, hot_threshold=100)
+    oracle = SpecOracle(clock)
+    key = b"n_sticky"
+    rows = [_hot(key, hits=3, limit=3, duration=1000)]
+    _check_rpc(h, oracle, rows)              # consumes to 0
+    _check_rpc(h, oracle, rows)              # OVER via engine; learned
+    native_before = h.native_answers
+    _check_rpc(h, oracle, rows)              # native sticky answer
+    assert h.native_answers == native_before + 1
+    got = h.serve_rpc([_hot(key, hits=0, limit=3, duration=1000)])
+    assert got[0][0] == int(Status.OVER_LIMIT)
+    oracle.serve([_hot(key, hits=0, limit=3, duration=1000)])
+    reset_ms = got[0][3]
+    clock.advance(ms=reset_ms - clock.now_ms())
+    native_before = h.native_answers
+    _check_rpc(h, oracle, rows, tag="at reset")      # still native OVER
+    assert h.native_answers == native_before + 1
+    clock.advance(ms=1)
+    _check_rpc(h, oracle, rows, tag="past reset")    # declined → engine
+    assert h.native_answers == native_before + 1
+    h.close()
+
+
+def test_native_lease_ttl_expiry_mid_stream():
+    """TTL expiry while delegated: the native probe declines, the
+    Python path pulls the exact drained count, settles the remainder,
+    and the post-expiry decisions still match the spec."""
+    clock = Clock().freeze()
+    h = NativeHarness(clock, lease_size=64, lease_ttl=0.02, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"n_ttl"
+    for _ in range(4):
+        _check_rpc(h, oracle, [_hot(key, hits=2, limit=100)])
+    assert h.native_answers > 0
+    clock.advance(ms=25)  # past the lease TTL, inside the bucket window
+    _check_rpc(h, oracle, [_hot(key, hits=2, limit=100)], tag="post-ttl")
+    assert h.ledger.stats()["settles"] >= 1
+    h.close()
+
+
+def test_native_declines_out_of_scope_rows():
+    """Precondition breakers and out-of-scope behaviors must never be
+    answered natively, lease or no lease — they are the rows that keep
+    the Python window path authoritative."""
+    clock = Clock().freeze()
+    h = NativeHarness(clock, lease_size=64, lease_ttl=10.0, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"n_scope"
+    for _ in range(3):
+        _check_rpc(h, oracle, [_hot(key, limit=1000)])
+    assert h.native_answers > 0
+    now = clock.now_ms()
+    for behavior in (
+        int(Behavior.RESET_REMAINING),
+        int(Behavior.DURATION_IS_GREGORIAN),
+        int(Behavior.GLOBAL),
+        int(Behavior.SKETCH),
+    ):
+        body = _encode([_hot(key, behavior=behavior, limit=1000)])
+        assert h.plane.try_serve(body, now_ms=now) is None, behavior
+    # Leaky rows and negative hits decline too.
+    assert h.plane.try_serve(
+        _encode([_hot(key, algo=int(Algorithm.LEAKY_BUCKET), limit=1000)]),
+        now_ms=now,
+    ) is None
+    assert h.plane.try_serve(
+        _encode([_hot(key, hits=-1, limit=1000)]), now_ms=now
+    ) is None
+    # Config mismatch (limit change) declines so the engine re-decides.
+    assert h.plane.try_serve(
+        _encode([_hot(key, limit=999)]), now_ms=now
+    ) is None
+    h.close()
+
+
+def test_native_invalidate_keys_pulls_plane():
+    """The dataclass-path coherence hook must stop native drains and
+    settle off the exact pulled count before the engine runs the key
+    outside the ledger."""
+    clock = Clock().freeze()
+    h = NativeHarness(clock, lease_size=64, lease_ttl=10.0, hot_threshold=1)
+    oracle = SpecOracle(clock)
+    key = b"n_inv"
+    for _ in range(3):
+        _check_rpc(h, oracle, [_hot(key, limit=100)])
+    assert h.plane.peek(key) is not None
+    h.ledger.invalidate_keys([key])
+    assert h.plane.peek(key) is None
+    # The unused credit is back on the device: an engine-only read sees
+    # the sequential remaining.
+    _, dev_rem, _ = h.device_view(key, 100, 60000)
+    assert dev_rem == 100 - 3
+    h.close()
+
+
+def test_native_under_admission_race_bound():
+    """Concurrent lane drains against a mid-flight pull: admissions
+    stop the instant the pull lands, the pulled count equals the
+    admitted count exactly (the mutex linearizes), and the total never
+    exceeds the granted credit — the coherence protocol's
+    under-admission bound."""
+    plane = native_plane.NativeDecisionPlane(disqualify_mask=0)
+    key = b"n_race"
+    credit = 1000
+    now = 1_000_000
+    assert plane.install_lease(
+        key, 10**6, 60000, now + 60000, 10**6, credit, 0, now + 10**6
+    )
+    n_threads = 8
+    admitted = [0] * n_threads
+    pulled = {}
+    start = threading.Barrier(n_threads)
+
+    def lane(t):
+        start.wait()
+        for _ in range(400):
+            if plane.probe(key, 0, 0, 1, 10**6, 60000, now) is not None:
+                admitted[t] += 1
+
+    threads = [
+        threading.Thread(target=lane, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    # Pull mid-race: every answer before the pull is counted in
+    # `consumed`; every probe after it declines.
+    res = None
+    while res is None:
+        res = plane.pull(key)
+    pulled["consumed"] = res[1]
+    for t in threads:
+        t.join()
+    total = sum(admitted)
+    assert total == pulled["consumed"]
+    assert total <= credit
+    plane.close()
+
+
+def test_native_coherence_race_conserves_credit():
+    """Native try_serve lanes racing the Python plan path (pull →
+    local answer → re-delegate churn): after everything settles, the
+    device remaining must account for EVERY admitted hit exactly —
+    no hit lost, none double-counted."""
+    clock = Clock().freeze()
+    h = NativeHarness(clock, lease_size=32, lease_ttl=10.0, hot_threshold=1)
+    key = b"n_cons"
+    limit = 1_000_000
+    row = _hot(key, limit=limit)
+    body = _encode([row])
+    # Prime: two Python serves grant + delegate the lease.
+    h.serve_rpc([row])
+    h.serve_rpc([row])
+    now = clock.now_ms()
+    n_threads, per = 4, 150
+    native_admits = [0] * n_threads
+    stop = threading.Event()
+
+    def lane(t):
+        for _ in range(per):
+            if h.plane.try_serve(body, now_ms=now) is not None:
+                native_admits[t] += 1
+
+    threads = [
+        threading.Thread(target=lane, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    # Python-path churn racing the lanes: each serve pulls the lease
+    # up, answers (or re-leases), and re-delegates.
+    py_admits = 0
+    for _ in range(30):
+        st, _, _, _ = h.serve(make_dec([row]))
+        assert int(st[0]) == int(Status.UNDER_LIMIT)
+        py_admits += 1
+    for t in threads:
+        t.join()
+    stop.set()
+    total = sum(native_admits) + py_admits + 2  # + the priming serves
+    # Settle everything: invalidate pulls the delegated lease and
+    # returns its unused credit synchronously (close alone leaves a
+    # LIVE lease's credit pre-debited, by design).
+    h.ledger.invalidate_keys([key])
+    _, dev_rem, _ = h.device_view(key, limit, 60000)
+    assert dev_rem == limit - total, (dev_rem, total)
+    h.ledger.close()
+    h.plane.close()
+
+
+def test_fast_front_native_plane_end_to_end():
+    """Daemon-level: the h2 front's connection threads answer hot-key
+    RPCs in C, and state stays coherent with the full gRPC listener
+    (cross-front traffic pulls the lease, the sequence stays exact)."""
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.net.grpc_service import V1Stub, dial
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=1 << 12,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        h2_fast_address="127.0.0.1:0",
+        h2_fast_window=0.001,
+        ledger_hot_threshold=2,
+        ledger_lease_ttl=30.0,
+    )
+    d = spawn_daemon(conf)
+    try:
+        assert d.h2_fast.plane is not None
+        assert d.h2_fast.lanes >= 1
+        limit = 10**6
+        req = pb.GetRateLimitsReq(
+            requests=[
+                pb.RateLimitReq(
+                    name="e2e", unique_key="hot", hits=1, limit=limit,
+                    duration=3_600_000,
+                )
+            ]
+        )
+        fast = V1Stub(dial(d.h2_fast_address))
+        rems = [
+            fast.GetRateLimits(req, timeout=10).responses[0].remaining
+            for _ in range(60)
+        ]
+        assert rems == list(range(limit - 1, limit - 61, -1))
+        assert d.h2_fast.stats()["native_rpcs"] > 0
+        # Cross-front: the grpc listener continues the same sequence
+        # (its plan pulls the delegated lease and re-delegates).
+        full = V1Stub(dial(d.grpc_address))
+        got = full.GetRateLimits(req, timeout=10).responses[0].remaining
+        assert got == limit - 61
+        # And back on the fast front.
+        got = fast.GetRateLimits(req, timeout=10).responses[0].remaining
+        assert got == limit - 62
+    finally:
+        d.close()
+
+
+def test_fast_front_native_ledger_off():
+    """GUBER_NATIVE_LEDGER=0 (config native_ledger=False) must run the
+    front without a plane — the window path serves everything."""
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.net.grpc_service import V1Stub, dial
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=1 << 12,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        h2_fast_address="127.0.0.1:0",
+        h2_fast_window=0.001,
+        native_ledger=False,
+    )
+    d = spawn_daemon(conf)
+    try:
+        assert d.h2_fast.plane is None
+        stub = V1Stub(dial(d.h2_fast_address))
+        got = stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="off", unique_key="k", hits=1, limit=5,
+                        duration=60_000,
+                    )
+                ]
+            ),
+            timeout=10,
+        )
+        assert got.responses[0].remaining == 4
+        assert d.h2_fast.stats()["native_rpcs"] == 0
+    finally:
+        d.close()
