@@ -204,6 +204,8 @@ def main() -> int:
             result = _run_global(np, platform)
         elif MODE == "herd":
             result = _run_herd(np, platform)
+        elif MODE == "deadpeer":
+            result = _run_deadpeer(np, platform)
         elif MODE == "herdnative":
             # 32 concurrent SINGLE-ITEM RPCs against the h2 fast front:
             # the native decision plane's per-RPC floor as its own
@@ -1144,6 +1146,144 @@ def _run_global_procs(np, platform: str, n_nodes: int, wire_batch: int) -> dict:
                     os.killpg(p.pid, signal.SIGKILL)
                 except ProcessLookupError:
                     pass
+
+
+def _run_deadpeer(np, platform: str) -> dict:
+    """Dead-peer A/B (ISSUE 5 acceptance): the forward path's latency
+    shape when an owner dies, healthy-cluster control first in the
+    SAME session.
+
+    4 in-process daemons; a grpc client herd drives single-item
+    requests with keys spread across all owners through node 0 (so
+    ~3/4 of items exercise the forward path).  Phase 1 measures the
+    healthy cluster; phase 2 kills one non-entry daemon and measures
+    again.  GUBER_DEGRADED_LOCAL governs the dead phase's semantics:
+    on (default) broken circuits answer from node 0's engine (p99
+    must NOT collapse into connect-timeout storms — the health
+    plane's whole point); off restores reference fail-closed errors.
+    The artifact embeds degraded/health counters so bench_trend.py
+    can fold them."""
+    from gubernator_tpu.cluster.harness import ClusterHarness, cluster_behaviors
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+    import grpc
+    from dataclasses import replace as dc_replace
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    n_threads = int(os.environ.get("BENCH_DEADPEER_THREADS", 8))
+    degraded = os.environ.get("GUBER_DEGRADED_LOCAL", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+    behaviors = dc_replace(cluster_behaviors(), degraded_local=degraded)
+    h = ClusterHarness().start(
+        n_nodes, behaviors=behaviors, cache_size=CAPACITY
+    )
+    try:
+        entry = h.daemons[0]
+        # Payloads: distinct keys, round-robin — every owner gets a
+        # share, so killing one daemon breaks ~1/n of the traffic.
+        # Keys vary a LEADING byte: FNV-1 does not avalanche
+        # trailing-byte differences (see harness._verify_membership),
+        # so "dp_{i}"-style names would collapse into one ring gap
+        # and skew per-owner shares wildly between runs.
+        payloads = [
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="deadpeer", unique_key=f"{i}_dp", hits=1,
+                        limit=10**9, duration=3_600_000,
+                    )
+                ]
+            ).SerializeToString()
+            for i in range(256)
+        ]
+
+        def measure(seconds: float):
+            stop = threading.Event()
+            barrier = threading.Barrier(n_threads + 1)
+            counts = [0] * n_threads
+            errors = [0] * n_threads
+            lats: list = [None] * n_threads
+
+            def worker(tid: int) -> None:
+                mylat = []
+                ch = grpc.insecure_channel(entry.grpc_address)
+                call = ch.unary_unary(
+                    f"/{V1_SERVICE}/GetRateLimits",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                try:
+                    call(payloads[0])
+                finally:
+                    barrier.wait()
+                i = tid
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        raw = call(payloads[i % len(payloads)])
+                        resp = pb.GetRateLimitsResp()
+                        resp.ParseFromString(raw)
+                        if any(r.error for r in resp.responses):
+                            errors[tid] += 1
+                    except grpc.RpcError:
+                        errors[tid] += 1
+                    mylat.append(time.perf_counter() - t0)
+                    counts[tid] += 1
+                    i += n_threads
+                lats[tid] = mylat
+                ch.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(t,), daemon=True)
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            start = time.perf_counter()
+            time.sleep(seconds)
+            stop.set()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - start
+            all_lat = np.asarray([x for ml in lats if ml for x in ml])
+            return {
+                "value": round(sum(counts) / elapsed, 1),
+                "p50_ms": round(float(np.percentile(all_lat, 50)) * 1e3, 3)
+                if all_lat.size else None,
+                "p99_ms": round(float(np.percentile(all_lat, 99)) * 1e3, 3)
+                if all_lat.size else None,
+                "requests": int(sum(counts)),
+                "errors": int(sum(errors)),
+            }
+
+        healthy = measure(MEASURE_SECONDS)
+        victim = n_nodes - 1  # never the entry node
+        h.kill(victim)
+        dead = measure(MEASURE_SECONDS)
+        inst = entry.instance
+        dead["degraded_answers"] = inst.counters["degraded_answers"]
+        dead["backoff_retries"] = inst.counters["backoff_retries"]
+        dead["async_retries"] = inst.counters["async_retries"]
+        dead["peer_health"] = entry.peer_health()
+        return {
+            "metric": "rate-limit decisions/sec, forward path with 1 of "
+            f"{n_nodes} owners dead ({n_threads} client threads, "
+            f"single-item RPCs via node 0, degraded_local={'on' if degraded else 'off'})",
+            "value": dead["value"],
+            "unit": "decisions/sec",
+            "vs_baseline": round(dead["value"] / BASELINE_DECISIONS_PER_SEC, 2),
+            "p50_ms": dead["p50_ms"],
+            "p99_ms": dead["p99_ms"],
+            "degraded_local": degraded,
+            "healthy": healthy,
+            "dead": dead,
+            "platform": platform,
+        }
+    finally:
+        h.stop()
 
 
 def _run_global(np, platform: str) -> dict:

@@ -278,6 +278,43 @@ class IntervalBatcher(Generic[K, V]):
                 items[key] = combine(items.get(key), item)
             self._cv.notify()
 
+    def requeue_many(self, pairs, oldest_ts: float | None = None) -> int:
+        """Re-enqueue failed-flush items WITHOUT blocking: flush
+        threads must never wait on producer admission (a blocked flush
+        worker is exactly the stall the health plane exists to
+        prevent).  Items that don't fit under max_pending are dropped
+        and counted; returns the number admitted.  `oldest_ts` is the
+        items' ORIGINAL first-enqueue time: re-queued items already
+        waited at least one window, and re-anchoring backlog age at
+        now() would hide exactly the failure-episode backlog the gauge
+        exists to expose."""
+        pairs = list(pairs)
+        admitted = 0
+        with self._lock:
+            if self._closing:
+                return 0
+            if not self._items and not self._chunks:
+                self._oldest_ts = (
+                    oldest_ts if oldest_ts else time.monotonic()
+                )
+            elif oldest_ts and oldest_ts < self._oldest_ts:
+                self._oldest_ts = oldest_ts
+            items = self._items
+            combine = self._combine
+            for key, item in pairs:
+                if (
+                    self._max_pending is not None
+                    and len(items) + self._chunk_count >= self._max_pending
+                    and key not in items
+                ):
+                    self.dropped += 1
+                    continue
+                items[key] = combine(items.get(key), item)
+                admitted += 1
+            if admitted:
+                self._cv.notify()
+        return admitted
+
     def add_chunk(self, chunk, count: int) -> None:
         """Queue one columnar chunk (O(1): stores references only).
         Requires chunked=True."""

@@ -68,6 +68,32 @@ class GlobalManager:
         self._counter_lock = threading.Lock()
         self.async_sends = 0  # guberlint: guarded-by _counter_lock
         self.broadcasts = 0  # guberlint: guarded-by _counter_lock
+        # Health-plane accounting (RESILIENCE.md): broadcast pushes
+        # skipped because the peer's circuit is open, hit windows
+        # re-queued for a later retry, and re-queued hits dropped at
+        # the age cap.
+        self.broadcasts_skipped = 0  # guberlint: guarded-by _counter_lock
+        # Skips because the peer's PREVIOUS push outlived the fan-out
+        # deadline (slow-but-healthy peer) — distinct from circuit
+        # skips so an operator can tell the two episodes apart.
+        self.broadcasts_skipped_inflight = 0  # guberlint: guarded-by _counter_lock
+        self.hits_requeued = 0  # guberlint: guarded-by _counter_lock
+        self.hits_requeue_dropped = 0  # guberlint: guarded-by _counter_lock
+        # First-queued timestamp per re-queued hit key: the age cap
+        # that stops a long-dead owner's hits from replaying forever
+        # (conf.hit_requeue_age; bounded at _REQUEUE_KEY_CAP keys).
+        self._requeue_lock = threading.Lock()
+        self._requeue_first: Dict[str, float] = {}  # guberlint: guarded-by _requeue_lock
+        # Per-peer in-flight broadcast push (addr -> Future): the
+        # bounded _await_all barrier can stop WAITING on a slow push,
+        # but per-peer delivery ORDER must survive it — a flush-N
+        # payload landing after flush N+1's would regress the peer's
+        # cache.  A peer with an unfinished older push is skipped this
+        # window (supersedable traffic; it catches up next window),
+        # so pushes to any one peer stay serialized in flush order.
+        # Only the turn-ordered broadcast flush thread touches this —
+        # no lock needed.
+        self._bcast_inflight: Dict[str, object] = {}
         # Apply-order sequence for serve-time update chunks
         # (next_update_seq; itertools.count.__next__ is atomic).
         import itertools
@@ -194,6 +220,120 @@ class GlobalManager:
             (dec, idx, status, limit, remaining, reset, seq), len(idx)
         )
 
+    # -- hit re-queue (owners that come back; RESILIENCE.md) -----------
+
+    # Outstanding re-queued keys are bounded at this many windows'
+    # worth of batch_limit — past it, new failures drop (counted)
+    # instead of growing an unbounded retry backlog for a dead owner.
+    _REQUEUE_KEY_CAP_WINDOWS = 4
+    # Minimum spacing between requeue cycles.  Without it the loop
+    # [flush → circuit-open fail (no dial, ~µs) → requeue → notify →
+    # adaptive ~0 window → flush ...] spins a flush worker at
+    # microsecond cadence against an open circuit, inflating
+    # hits_requeued by orders of magnitude and burning a core for the
+    # whole open period.  50ms bounds the spin at 20 retry windows/s —
+    # far above any circuit probe cadence that could heal it.
+    _REQUEUE_DAMP = 0.05
+
+    def _requeue_hits(self, reqs) -> None:
+        """Give hits that failed to reach their owner another window,
+        bounded and age-capped.  Hits are precious (dropping
+        under-counts the owner) but not immortal: past
+        `conf.hit_requeue_age` the owner's buckets have moved on and
+        replaying the backlog would double-count against fresh
+        windows, so old hits drop (counted).  Re-enqueue is
+        non-blocking (IntervalBatcher.requeue_many) — this runs on
+        flush threads, which must never wait on producer admission."""
+        import time
+
+        age_cap = self.conf.hit_requeue_age
+        if age_cap <= 0 or not reqs:
+            return
+        # Damp the retry cadence BEFORE re-admitting (we run on a
+        # flush worker; the hits pool has a second worker for healthy
+        # owners, and hits are async by contract).
+        time.sleep(self._REQUEUE_DAMP)
+        key_cap = self._REQUEUE_KEY_CAP_WINDOWS * self.conf.global_batch_limit
+        now = time.monotonic()
+        keep = []
+        dropped = 0
+        oldest = now
+        with self._requeue_lock:
+            first_map = self._requeue_first
+            if len(first_map) >= key_cap // 2:
+                # Sweep ORPHAN entries past the age cap: an item
+                # dropped at the batcher's max_pending bound never
+                # flows through the age check or the delivery clear
+                # again, and without the sweep such orphans would
+                # accumulate across outage episodes until the cap
+                # permanently disabled re-queueing.  Keys in THIS
+                # batch are excluded — deleting theirs would hand the
+                # per-item loop a fresh timestamp and let expired hits
+                # replay forever, the exact harm the age cap exists to
+                # prevent.  O(map ≤ key_cap), behind the damped retry
+                # cadence.
+                # Only the unambiguous orphan band (> 2×cap) may be
+                # swept: entries in (cap, 2×cap] can belong to ANOTHER
+                # owner's requeue task running concurrently on the
+                # pool — deleting one would hand that task a fresh
+                # timestamp and replay its expired hits.  A live
+                # episode touches its entry every ~damp interval, so
+                # nothing live ever reaches 2×cap.
+                batch_keys = {r.hash_key() for r in reqs}
+                for k in [
+                    k for k, t in first_map.items()
+                    if now - t > 2 * age_cap and k not in batch_keys
+                ]:
+                    del first_map[k]
+            for r in reqs:
+                k = r.hash_key()
+                first = first_map.get(k)
+                if first is None:
+                    if len(first_map) >= key_cap:
+                        dropped += 1
+                        continue
+                    first_map[k] = first = now
+                if now - first > age_cap:
+                    if now - first > 2 * age_cap:
+                        # Far past the cap = a stale ORPHAN from a
+                        # previous episode (its requeue was refused at
+                        # the batcher bound, so delivery never cleared
+                        # it) — a LIVE episode retries every ~damp
+                        # interval and would have hit the (cap, 2cap]
+                        # band first.  Treat this failure as the new
+                        # episode's first.
+                        first_map[k] = first = now
+                    else:
+                        del first_map[k]
+                        dropped += 1
+                        continue
+                if first < oldest:
+                    oldest = first
+                keep.append((k, r))
+        # oldest = the survivors' original first-enqueue time, so the
+        # backlog-age gauge keeps exposing the failure episode instead
+        # of re-anchoring at now() every retry window.
+        admitted = (
+            self._hits.requeue_many(keep, oldest_ts=oldest) if keep else 0
+        )
+        with self._counter_lock:
+            self.hits_requeued += admitted
+            self.hits_requeue_dropped += dropped + (len(keep) - admitted)
+
+    def _requeue_enabled(self) -> bool:
+        """Cheap gate the columnar failure path checks BEFORE
+        materializing request objects for _requeue_hits."""
+        return self.conf.hit_requeue_age > 0
+
+    def _clear_requeued(self, keys) -> None:
+        """Delivered hits leave the re-queue age table (stale entries
+        would age-drop a key's NEXT failure episode prematurely).
+        Callers guard on the table being non-empty, so the healthy
+        path never pays per-key work here."""
+        with self._requeue_lock:
+            for k in keys:
+                self._requeue_first.pop(k, None)
+
     # -- chunk aggregation (flush threads, window-amortized) -----------
 
     @staticmethod
@@ -304,6 +444,7 @@ class GlobalManager:
 
                 peer = clients[addr]
                 idx = np.asarray(idx_list, dtype=np.int64)
+                sent = 0
                 try:
                     if peer.info.is_owner:
                         # Ownership moved to us between queue and
@@ -319,40 +460,71 @@ class GlobalManager:
                                 for i in idx_list
                             ]
                         )
-                        return
-                    for lo in range(0, len(idx), MAX_BATCH_SIZE):
-                        sub = idx[lo:lo + MAX_BATCH_SIZE]
-                        sub_buf, sub_off = wire_codec.gather_key_slices(
-                            key_buf, starts[sub], lens[sub]
-                        )
-                        payload = wire_codec.encode_peer_reqs(
-                            sub_buf, sub_off, name_len[sub],
-                            algo[sub], behavior[sub], hits_col[sub],
-                            limit[sub], duration[sub], burst[sub],
-                        )
-                        t_rpc = _time.monotonic()
-                        peer.send_peer_hits_raw(
-                            payload, timeout=self.conf.global_timeout
-                        )
-                        self.owner_rpc_duration.observe(
-                            _time.monotonic() - t_rpc
-                        )
+                        sent = len(idx_list)
+                    else:
+                        for lo in range(0, len(idx), MAX_BATCH_SIZE):
+                            sub = idx[lo:lo + MAX_BATCH_SIZE]
+                            sub_buf, sub_off = wire_codec.gather_key_slices(
+                                key_buf, starts[sub], lens[sub]
+                            )
+                            payload = wire_codec.encode_peer_reqs(
+                                sub_buf, sub_off, name_len[sub],
+                                algo[sub], behavior[sub], hits_col[sub],
+                                limit[sub], duration[sub], burst[sub],
+                            )
+                            t_rpc = _time.monotonic()
+                            peer.send_peer_hits_raw(
+                                payload, timeout=self.conf.global_timeout
+                            )
+                            self.owner_rpc_duration.observe(
+                                _time.monotonic() - t_rpc
+                            )
+                            sent = lo + len(sub)
                 except PeerError as e:
                     log.error(
                         "error sending global hits to '%s': %s", addr, e
                     )
+                    if e.not_ready and self._requeue_enabled():
+                        # Unreachable owner: the UNSENT hits get
+                        # another window (bounded, age-capped) so an
+                        # owner that comes back converges instead of
+                        # permanently under-counting.  (The enabled
+                        # gate runs first — materializing a window of
+                        # request objects just to discard them would
+                        # tax the flush threads for nothing.)
+                        self._requeue_hits(
+                            [
+                                self._req_from_columns(
+                                    key_buf, starts, lens, name_len,
+                                    algo, behavior, hits_col, limit,
+                                    duration, burst, int(i),
+                                )
+                                for i in idx_list[sent:]
+                            ]
+                        )
+                # The DELIVERED prefix leaves the age table even when
+                # a later chunk failed (stale first-ts would age-drop
+                # the key's next failure episode prematurely).
+                # guberlint: ok lock — non-empty peek only; a stale
+                # read worst-case runs one redundant clear pass
+                if sent and self._requeue_first:
+                    self._clear_requeued(
+                        key_buf[
+                            int(starts[i]):int(starts[i]) + int(lens[i])
+                        ].tobytes().decode()
+                        for i in idx_list[:sent]
+                    )
 
             # One task per owner: the window's wall time is the
-            # slowest owner, not the sum over owners.
-            if len(by_addr) == 1:
-                addr, idx_list = next(iter(by_addr.items()))
-                _send_one_owner(addr, idx_list)
-            else:
-                futs = [
-                    self._rpc_pool.submit(_send_one_owner, addr, idx_list)
-                    for addr, idx_list in by_addr.items()
-                ]
-                self._await_all(futs)
+            # slowest owner, not the sum over owners — and even a
+            # single owner rides the pool so the fan-out deadline
+            # bounds the flush (a sync send would stall the whole
+            # cycle for the per-RPC timeout when that owner is dead).
+            futs = [
+                self._rpc_pool.submit(_send_one_owner, addr, idx_list)
+                for addr, idx_list in by_addr.items()
+            ]
+            self._await_all(futs)
         with self._counter_lock:
             self.async_sends += 1
         return True
@@ -483,12 +655,14 @@ class GlobalManager:
             import time as _time
 
             peer = clients[addr]
+            sent = 0
             try:
                 if peer.info.is_owner:
                     # Ownership may have moved to us between the queue
                     # and the flush; apply locally instead of dialing
                     # ourselves.
                     self.instance.apply_local_batch(reqs)
+                    sent = len(reqs)
                 else:
                     # Under burst load the window can aggregate more
                     # distinct keys than one RPC may carry; chunk to
@@ -502,18 +676,26 @@ class GlobalManager:
                         self.owner_rpc_duration.observe(
                             _time.monotonic() - t_rpc
                         )
+                        sent = min(lo + MAX_BATCH_SIZE, len(reqs))
             except PeerError as e:
                 log.error("error sending global hits to '%s': %s", addr, e)
+                if e.not_ready:
+                    self._requeue_hits(reqs[sent:])
+            # The DELIVERED prefix leaves the age table even when a
+            # later chunk failed — a stale first-ts would age-drop the
+            # key's next failure episode prematurely.
+            # guberlint: ok lock — non-empty peek only; a stale read
+            # worst-case runs one redundant clear pass
+            if sent and self._requeue_first:
+                self._clear_requeued(r.hash_key() for r in reqs[:sent])
 
-        if len(by_peer) == 1:
-            addr, reqs = next(iter(by_peer.items()))
-            _send_one(addr, reqs)
-        else:
-            futs = [
-                self._rpc_pool.submit(_send_one, addr, reqs)
-                for addr, reqs in by_peer.items()
-            ]
-            self._await_all(futs)
+        # Single owners ride the pool too — the fan-out deadline must
+        # bound the flush cycle whatever the per-RPC timeout is.
+        futs = [
+            self._rpc_pool.submit(_send_one, addr, reqs)
+            for addr, reqs in by_peer.items()
+        ]
+        self._await_all(futs)
         with self._counter_lock:
             self.async_sends += 1
 
@@ -678,29 +860,102 @@ class GlobalManager:
         slowest peer, not the sum over peers.  Per-peer delivery order
         is preserved because broadcast flushes themselves stay
         turn-ordered (each flush completes all its pushes before the
-        next flush starts)."""
-        peers = [
-            p for p in self.instance.get_peer_list()
-            if not p.info.is_owner  # exclude ourselves
-        ]
+        next flush starts).
+
+        Circuit-open peers are skipped up front (counted): broadcasts
+        are supersedable, so a broken peer simply misses windows until
+        its circuit half-opens — at which point the next fan-out IS
+        the probe.  `would_allow` is the non-consuming peek; the
+        consuming gate runs inside the peer's own send.
+
+        Peers whose PREVIOUS push is still in flight (it outlived the
+        fan-out deadline) are skipped too: starting a second push to
+        the same peer while an older one runs could deliver a stale
+        status LAST — per-peer delivery order is the invariant the
+        no-flush-pool design of `_updates` exists for."""
+        skipped_circuit = 0
+        skipped_inflight = 0
+        peers = []
+        inflight = self._bcast_inflight
+        current = set()
+        for p in self.instance.get_peer_list():
+            if p.info.is_owner:  # exclude ourselves
+                continue
+            addr = p.info.grpc_address
+            current.add(addr)
+            prev = inflight.get(addr)
+            if prev is not None and not prev.done():
+                skipped_inflight += 1
+                continue
+            if not p.health.would_allow():
+                skipped_circuit += 1
+                continue
+            peers.append(p)
+        # Prune departed peers (membership churn would otherwise grow
+        # the map one dead Future per replaced pod, forever).
+        for addr in [a for a in inflight if a not in current]:
+            if inflight[addr].done():
+                del inflight[addr]
+        if skipped_circuit or skipped_inflight:
+            with self._counter_lock:
+                self.broadcasts_skipped += skipped_circuit
+                self.broadcasts_skipped_inflight += skipped_inflight
         if not peers:
             return
-        if len(peers) == 1:
-            push(peers[0])
-            return
-        self._await_all([self._rpc_pool.submit(push, p) for p in peers])
+        # Even a single peer rides the pool + bounded barrier: running
+        # the push synchronously on the flush thread would make the
+        # fan-out deadline inert in exactly the 2-node case (a dead
+        # peer would stall every flush for the full per-RPC timeout
+        # until its circuit opens).
+        futs = []
+        for p in peers:
+            f = self._rpc_pool.submit(push, p)
+            inflight[p.info.grpc_address] = f
+            futs.append(f)
+        # Broadcast pushes are supersedable → queued tasks may be
+        # cancelled at the deadline (hit sends must never be).
+        self._await_all(futs, cancel_on_deadline=True)
 
-    @staticmethod
-    def _await_all(futs) -> None:
-        """Wait for EVERY fan-out task, logging failures per task — a
-        sequential f.result() loop would abandon (and silently
-        swallow) the remaining tasks on the first non-PeerError."""
+    def _await_all(self, futs, cancel_on_deadline: bool = False) -> None:
+        """Wait for every fan-out task, logging failures per task — a
+        sequential bare f.result() loop would abandon (and silently
+        swallow) the remaining tasks on the first non-PeerError — but
+        never past ONE total budget for the whole barrier
+        (conf.global_fanout_deadline, GUBER_GLOBAL_FANOUT_DEADLINE):
+        one dead/slow peer must not stall the flush cycle for a full
+        gRPC timeout per peer.  A task that outlives the budget keeps
+        running on the pool (its own RPC timeout bounds it) and its
+        eventual transport error feeds the peer's circuit breaker; the
+        timed-out wait itself is counted via record_swallowed.
+
+        `cancel_on_deadline` pulls back queued-but-not-started tasks —
+        ONLY safe for supersedable traffic (broadcast pushes, where a
+        skipped window is corrected by the next one).  Hit-send tasks
+        must NEVER be cancelled: a cancelled task's body never runs,
+        so neither the send nor its PeerError→requeue recovery would
+        — the hits would be silently lost and the owner would
+        under-count."""
+        import time
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        from gubernator_tpu.utils.metrics import record_swallowed
+
+        deadline = time.monotonic() + max(
+            0.05, self.conf.global_fanout_deadline
+        )
         for f in futs:
             try:
-                f.result()
+                f.result(timeout=max(0.0, deadline - time.monotonic()))
+            except FutTimeout:
+                if cancel_on_deadline:
+                    f.cancel()
+                record_swallowed("global.fanout_deadline")
+                log.warning(
+                    "global fan-out task exceeded the barrier budget; "
+                    "not waiting (the send's own timeout + circuit "
+                    "breaker bound it)"
+                )
             except Exception:  # noqa: BLE001 — peers must not sink peers
-                from gubernator_tpu.utils.metrics import record_swallowed
-
                 record_swallowed("global.fanout")
                 log.exception("global fan-out task failed")
 

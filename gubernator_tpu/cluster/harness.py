@@ -28,6 +28,15 @@ def cluster_behaviors() -> BehaviorConfig:
         batch_wait=0.005,
         multi_region_sync_wait=0.05,
         multi_region_timeout=1.0,
+        # Health plane on a test timescale: circuits open after the
+        # same 3 failures but re-probe quickly, and the fan-out
+        # barrier / requeue age shrink to keep chaos cases fast.
+        circuit_backoff=0.1,
+        circuit_backoff_cap=1.0,
+        forward_backoff=0.005,
+        forward_backoff_cap=0.05,
+        global_fanout_deadline=1.0,
+        hit_requeue_age=2.0,
     )
 
 
@@ -40,6 +49,7 @@ class ClusterHarness:
         self._clock: Clock = SYSTEM_CLOCK
         self._behaviors = cluster_behaviors()
         self._cache_size = 5_000
+        self._injector = None
 
     # -- startup -------------------------------------------------------
 
@@ -199,6 +209,65 @@ class ClusterHarness:
                 return d
         raise AssertionError("cluster too small for a non-owner")
 
+    # -- fault injection (cluster/faults.py; chaos tests) --------------
+
+    def install_faults(self, seed: int = 0, **rates) -> "object":
+        """Create + install a process-global seeded FaultInjector (the
+        in-process cluster shares one interpreter, so one injector
+        covers every node's sends).  `rates` forwards drop_rate /
+        reset_rate / latency_rate / latency_s.  stop() uninstalls."""
+        from gubernator_tpu.cluster import faults
+
+        self._injector = faults.install(faults.FaultInjector(seed, **rates))
+        return self._injector
+
+    def uninstall_faults(self) -> None:
+        from gubernator_tpu.cluster import faults
+
+        faults.uninstall()
+        self._injector = None
+
+    def partition(self, src_idx: int, dst_idx: int) -> None:
+        """Block daemon src→dst sends only (asymmetric partition).
+        Requires install_faults() first."""
+        self._injector.partition(
+            self.daemons[src_idx].peer_info().grpc_address,
+            self.daemons[dst_idx].peer_info().grpc_address,
+        )
+
+    def partition_both(self, a_idx: int, b_idx: int) -> None:
+        self._injector.partition_both(
+            self.daemons[a_idx].peer_info().grpc_address,
+            self.daemons[b_idx].peer_info().grpc_address,
+        )
+
+    def isolate(self, idx: int) -> None:
+        """Partition one daemon from everyone, both directions."""
+        self._injector.isolate(
+            self.daemons[idx].peer_info().grpc_address
+        )
+
+    def heal(self) -> None:
+        """Remove every partition rule (the injector stays installed —
+        rate-based faults keep flowing if configured)."""
+        self._injector.heal()
+
+    # -- health introspection ------------------------------------------
+
+    def health_states(self) -> dict:
+        """{observer_addr: {peer_addr: circuit state}} across the
+        cluster — the chaos suite's convergence oracle."""
+        out = {}
+        for d in self.daemons:
+            if d.instance is None:
+                continue
+            out[d.peer_info().grpc_address] = {
+                p.info.grpc_address: p.health.state()
+                for p in d.instance.get_peer_list()
+                if not p.info.is_owner
+            }
+        return out
+
     # -- lifecycle -----------------------------------------------------
 
     def kill(self, idx: int) -> None:
@@ -223,6 +292,8 @@ class ClusterHarness:
 
     def stop(self) -> None:
         """reference: cluster/cluster.go:139-145 (Stop)."""
+        if self._injector is not None:
+            self.uninstall_faults()
         for d in self.daemons:
             d.close()
         self.daemons = []

@@ -94,10 +94,21 @@ class MultiRegionManager:
                             timeout=self.conf.multi_region_timeout,
                         )
                     self.region_sends += 1
+                # guberlint: ok net — per-peer fan-out, not a retry
+                # loop; circuit_open only selects the log level
                 except PeerError as e:
-                    log.error(
-                        "error sending multi-region hits to '%s': %s", addr, e
-                    )
+                    # Circuit-open refusals are the health plane doing
+                    # its job (no dial happened) — debug, not error;
+                    # real transport failures stay loud.
+                    if e.circuit_open:
+                        log.debug(
+                            "multi-region hits to '%s' skipped: %s", addr, e
+                        )
+                    else:
+                        log.error(
+                            "error sending multi-region hits to '%s': %s",
+                            addr, e,
+                        )
                     continue
             self.windows += 1
 

@@ -16,6 +16,13 @@ reference: peer_client.go.  Semantics preserved:
 - `PeerError.not_ready` distinguishes retryable connection states; the
   router's forward path retries on it (:556-580).
 
+Beyond the reference: every send passes the peer health plane
+(cluster/health.py) — a per-peer circuit breaker gates the RPC
+*before* any dial, transport-shaped outcomes (UNAVAILABLE, deadline)
+feed the state machine, and the seeded fault injector
+(cluster/faults.py) taps the same choke point so chaos tests exercise
+the identical failure paths production would.
+
 Flushes run on a small per-client executor so a slow RPC doesn't stall
 the next 500µs window (the reference fires a goroutine per flush).
 """
@@ -29,6 +36,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
+from gubernator_tpu.cluster import faults
+from gubernator_tpu.cluster.health import PeerHealth
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.net import serde
 from gubernator_tpu.net.grpc_service import PeersV1Stub, dial
@@ -45,17 +54,50 @@ from gubernator_tpu.types import (
 _LAST_ERRS_TTL = 300.0  # reference: peer_client.go:64 (5-minute TTL LRU)
 _LAST_ERRS_CAP = 100
 
+# gRPC codes that mean "the transport failed", not "the peer answered
+# with an application error" — only these feed the circuit breaker as
+# failures.
+_TRANSPORT_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+# Codes that PROVE the peer processed the request and answered with an
+# application-level status — these close/clear the circuit.  Anything
+# in neither set (INTERNAL from an RST_STREAM, CANCELLED from a local
+# channel teardown, UNKNOWN, ...) is ambiguous and must move the
+# circuit in NEITHER direction: treating an LB that resets every
+# stream as "healthy" would keep the circuit closed through the exact
+# storm the health plane exists to prevent.
+_ANSWERED_CODES = (
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.OUT_OF_RANGE,
+    grpc.StatusCode.FAILED_PRECONDITION,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.PERMISSION_DENIED,
+    grpc.StatusCode.UNAUTHENTICATED,
+    grpc.StatusCode.NOT_FOUND,
+    grpc.StatusCode.ALREADY_EXISTS,
+    grpc.StatusCode.UNIMPLEMENTED,
+)
+
 
 class PeerError(RuntimeError):
     """Error talking to a peer; `not_ready` means the peer was not
-    connected and the caller may retry against a re-picked owner.
+    connected and the caller may retry against a re-picked owner;
+    `circuit_open` means the health plane refused the send without
+    dialing (the peer is BROKEN and no probe is due) — retrying the
+    same peer is pointless until its circuit half-opens.
 
     reference: peer_client.go:556-580 (PeerErr / NotReady).
     """
 
-    def __init__(self, message: str, *, not_ready: bool = False):
+    def __init__(
+        self, message: str, *, not_ready: bool = False,
+        circuit_open: bool = False,
+    ):
         super().__init__(message)
         self.not_ready = not_ready
+        self.circuit_open = circuit_open
 
 
 class _Pending:
@@ -81,6 +123,16 @@ class PeerClient:
         self.behaviors = behaviors or BehaviorConfig()
         self._credentials = credentials
         self._flush_stat = flush_stat
+        # Who is sending through this client (stamped by set_peers);
+        # the fault injector keys asymmetric partitions on (src, dst).
+        self.src_addr = ""
+        b = self.behaviors
+        self.health = PeerHealth(
+            info.grpc_address,
+            failure_threshold=b.circuit_failures,
+            backoff=b.circuit_backoff,
+            backoff_cap=b.circuit_backoff_cap,
+        )
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._raw_get_peer = None
@@ -133,6 +185,40 @@ class PeerClient:
                 self._batcher.start()
             return self._stub
 
+    def _gate(self) -> None:
+        """The pre-dial health gate every send passes: refuse instantly
+        (no dial, no connect timeout) when the circuit is open, then
+        run the send through the fault injector when one is installed.
+        Injected faults are recorded as real transport failures — the
+        chaos tests exercise the same bookkeeping production does."""
+        if not self.health.allow():
+            raise PeerError(
+                f"circuit open to {self.info.grpc_address} "
+                f"(probe in {self.health.retry_after():.2f}s)",
+                not_ready=True,
+                circuit_open=True,
+            )
+        inj = faults.active()
+        if inj is not None:
+            try:
+                inj.check(self.src_addr, self.info.grpc_address)
+            except faults.FaultError as e:
+                self.health.record_failure()
+                self._set_last_err(str(e))
+                raise PeerError(str(e), not_ready=True) from e
+
+    def _observe_rpc_error(self, e: grpc.RpcError) -> None:
+        """Feed the circuit breaker from a real RPC failure: transport
+        codes are failures, application-status codes prove the peer
+        answered (success), and ambiguous codes move the circuit in
+        neither direction (a held half-open probe slot is reclaimed by
+        PeerHealth.probe_timeout)."""
+        code = e.code()
+        if code in _TRANSPORT_CODES:
+            self.health.record_failure()
+        elif code in _ANSWERED_CODES:
+            self.health.record_success()
+
     def _set_last_err(self, err: str) -> None:
         now = time.monotonic()
         with self._lock:
@@ -180,6 +266,7 @@ class PeerClient:
     def _get_peer_rate_limits_traced(
         self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
     ) -> List[RateLimitResp]:
+        self._gate()
         stub = self._connect()
         msg = peers_pb.GetPeerRateLimitsReq(
             requests=[serde.rate_limit_req_to_pb(r) for r in reqs]
@@ -192,9 +279,11 @@ class PeerClient:
             resp = stub.GetPeerRateLimits(
                 msg, timeout=timeout or self.behaviors.batch_timeout
             )
+            self.health.record_success()
         except grpc.RpcError as e:
             err = f"GetPeerRateLimits to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
+            self._observe_rpc_error(e)
             raise PeerError(
                 err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
             ) from e
@@ -227,6 +316,7 @@ class PeerClient:
     ) -> None:
         """Pre-encoded GetPeerRateLimitsReq bytes (the columnar hit
         windows C-encode straight from their aggregation columns)."""
+        self._gate()
         self._connect()
         with self._lock:
             if self._closing:
@@ -238,9 +328,11 @@ class PeerClient:
                 payload,
                 timeout=timeout or self.behaviors.global_timeout,
             )
+            self.health.record_success()
         except grpc.RpcError as e:
             err = f"GetPeerRateLimits(hits) to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
+            self._observe_rpc_error(e)
             raise PeerError(
                 err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
             ) from e
@@ -256,6 +348,7 @@ class PeerClient:
 
         reference: peer_client.go:248-275.
         """
+        self._gate()
         stub = self._connect()
         msg = peers_pb.UpdatePeerGlobalsReq(
             globals=[serde.update_peer_global_to_pb(g) for g in globals_]
@@ -268,9 +361,11 @@ class PeerClient:
             stub.UpdatePeerGlobals(
                 msg, timeout=timeout or self.behaviors.global_timeout
             )
+            self.health.record_success()
         except grpc.RpcError as e:
             err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
+            self._observe_rpc_error(e)
             raise PeerError(
                 err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
             ) from e
@@ -285,6 +380,7 @@ class PeerClient:
         """Push one pre-encoded UpdatePeerGlobalsReq (native broadcast
         plane — the payload is C-encoded once per window and shared by
         every peer push)."""
+        self._gate()
         self._connect()
         with self._lock:
             if self._closing:
@@ -293,9 +389,11 @@ class PeerClient:
             self._inflight += 1
         try:
             raw(payload, timeout=timeout or self.behaviors.global_timeout)
+            self.health.record_success()
         except grpc.RpcError as e:
             err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
+            self._observe_rpc_error(e)
             raise PeerError(
                 err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
             ) from e
@@ -310,6 +408,17 @@ class PeerClient:
         self, req: RateLimitReq, timeout: Optional[float]
     ) -> RateLimitResp:
         """Enqueue and wait. reference: peer_client.go:308-376."""
+        # Fail fast BEFORE enqueueing: a circuit-open peer must cost
+        # the caller one dict probe, not a full batch_timeout wait on
+        # a future that can only fail.  Non-consuming peek — the
+        # batcher's flush runs the real (probe-slot-taking) gate.
+        if not self.health.would_allow():
+            raise PeerError(
+                f"circuit open to {self.info.grpc_address} "
+                f"(probe in {self.health.retry_after():.2f}s)",
+                not_ready=True,
+                circuit_open=True,
+            )
         self._connect()
         pending = _Pending(req)
         with self._lock:
@@ -391,6 +500,7 @@ class PeerClient:
 
     def _send_queue_traced(self, batch: List[_Pending]) -> None:
         try:
+            self._gate()
             msg = peers_pb.GetPeerRateLimitsReq(
                 requests=[serde.rate_limit_req_to_pb(p.req) for p in batch]
             )
@@ -398,6 +508,7 @@ class PeerClient:
             resp = self._stub.GetPeerRateLimits(
                 msg, timeout=self.behaviors.batch_timeout
             )
+            self.health.record_success()
             if len(resp.rate_limits) != len(batch):
                 raise PeerError(
                     "number of rate limits in peer response does not match request"
@@ -406,6 +517,7 @@ class PeerClient:
                 p.future.set_result(serde.rate_limit_resp_from_pb(r))
         except Exception as e:  # noqa: BLE001 — every caller gets the error
             if isinstance(e, grpc.RpcError):
+                self._observe_rpc_error(e)
                 err_text = f"GetPeerRateLimits batch to {self.info.grpc_address}: {e.code().name}"
                 e = PeerError(
                     err_text, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE

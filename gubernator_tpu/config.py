@@ -52,6 +52,38 @@ class BehaviorConfig:
     # cadence).
     adaptive_windows: bool = True
 
+    # ---- peer health plane (cluster/health.py; RESILIENCE.md) -------
+    # Consecutive transport failures before a peer's circuit opens
+    # (GUBER_CIRCUIT_FAILURES).
+    circuit_failures: int = 3
+    # Initial circuit open period, seconds; doubles per consecutive
+    # re-open up to the cap (GUBER_CIRCUIT_BACKOFF / _CAP).
+    circuit_backoff: float = 0.5
+    circuit_backoff_cap: float = 30.0
+    # Forward retry-loop backoff between owner re-pick attempts —
+    # capped exponential with full jitter (GUBER_FORWARD_BACKOFF /
+    # _CAP).  The reference's loop re-picked with zero delay.
+    forward_backoff: float = 0.01
+    forward_backoff_cap: float = 0.25
+    # Degraded-mode local answering (GUBER_DEGRADED_LOCAL, default
+    # on): when every owner candidate is circuit-open/unreachable,
+    # answer from this node's own engine (flagged in response
+    # metadata) instead of returning an error string.  Off restores
+    # the reference's fail-closed semantics.  Availability costs
+    # bounded over-admission: ≤ N_partitions × limit per key
+    # (RESILIENCE.md derives the bound).
+    degraded_local: bool = True
+    # Total wall budget for one GLOBAL fan-out barrier, seconds
+    # (GUBER_GLOBAL_FANOUT_DEADLINE): one dead peer must not stall a
+    # flush cycle past this, whatever the per-RPC timeout is.
+    global_fanout_deadline: float = 2.0
+    # GLOBAL hits that failed to reach their owner are re-queued for
+    # the next window until this old, seconds; older hits are dropped
+    # (counted) — the owner's state has moved on and replaying stale
+    # hits would double-count against fresh windows
+    # (GUBER_HIT_REQUEUE_AGE; 0 disables re-queueing).
+    hit_requeue_age: float = 5.0
+
 
 @dataclass
 class Config:
@@ -344,6 +376,23 @@ def setup_daemon_config(
         multi_region_batch_limit=_env_int(d, "GUBER_MULTI_REGION_BATCH_LIMIT", 1000),
         adaptive_windows=_env(d, "GUBER_ADAPTIVE_WINDOWS", "1").strip().lower()
         not in ("0", "false", "no", "off"),
+        circuit_failures=_env_int(d, "GUBER_CIRCUIT_FAILURES", 3),
+        circuit_backoff=_env_float_seconds(d, "GUBER_CIRCUIT_BACKOFF", 0.5),
+        circuit_backoff_cap=_env_float_seconds(
+            d, "GUBER_CIRCUIT_BACKOFF_CAP", 30.0
+        ),
+        forward_backoff=_env_float_seconds(
+            d, "GUBER_FORWARD_BACKOFF", 0.01
+        ),
+        forward_backoff_cap=_env_float_seconds(
+            d, "GUBER_FORWARD_BACKOFF_CAP", 0.25
+        ),
+        degraded_local=_env(d, "GUBER_DEGRADED_LOCAL", "1").strip().lower()
+        not in ("0", "false", "no", "off"),
+        global_fanout_deadline=_env_float_seconds(
+            d, "GUBER_GLOBAL_FANOUT_DEADLINE", 2.0
+        ),
+        hit_requeue_age=_env_float_seconds(d, "GUBER_HIT_REQUEUE_AGE", 5.0),
     )
 
     peer_picker = _env(d, "GUBER_PEER_PICKER", "replicated-hash")

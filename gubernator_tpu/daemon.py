@@ -422,6 +422,22 @@ class Daemon:
                 time.sleep(0.05)
         raise TimeoutError(f"daemon at {addr} never became ready: {last_err}")
 
+    def peer_health(self) -> dict:
+        """This node's view of every peer's circuit state + transition
+        counts (cluster/health.py) — the operator/bench entry for the
+        same numbers /metrics exports as gubernator_peer_state and
+        gubernator_circuit_transitions (bench artifacts embed it)."""
+        assert self.instance is not None
+        out = {}
+        for p in self.instance.get_peer_list():
+            if p.info.is_owner:
+                continue
+            out[p.info.grpc_address] = {
+                "state": p.health.state(),
+                "transitions": p.health.transition_counts(),
+            }
+        return out
+
     def stage_budget(self) -> dict:
         """The measured GLOBAL-path p50 budget on this node: per-stage
         {count, mean_ms, max_ms} for the five pipeline stages (client

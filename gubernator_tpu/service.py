@@ -35,6 +35,7 @@ from gubernator_tpu.cluster.hash_ring import (
     RegionPicker,
     ReplicatedConsistentHash,
 )
+from gubernator_tpu.cluster.health import backoff_delay
 from gubernator_tpu.cluster.multiregion import MultiRegionManager
 from gubernator_tpu.cluster.peer_client import PeerClient, PeerError
 from gubernator_tpu.config import BehaviorConfig, Config
@@ -332,6 +333,14 @@ class V1Instance:
             "global_miss_local": 0,
             "check_errors": 0,
             "async_retries": 0,
+            # Forward retries that waited out a backoff window first
+            # (the reference's loop re-picked with zero delay).
+            "backoff_retries": 0,
+            # Requests answered by OUR engine because every owner
+            # candidate was circuit-open/unreachable (degraded mode,
+            # GUBER_DEGRADED_LOCAL).  Each one is availability bought
+            # with bounded over-admission — RESILIENCE.md.
+            "degraded_answers": 0,
         }
         from gubernator_tpu.utils.metrics import DurationStat
 
@@ -562,6 +571,30 @@ class V1Instance:
 
         return responses  # type: ignore[return-value]
 
+    def _degraded_answer(
+        self,
+        ids: List[int],
+        requests: Sequence[RateLimitReq],
+        responses: List[Optional[RateLimitResp]],
+        owner_addr: str,
+    ) -> None:
+        """Serve forward items from OUR engine because their owner is
+        unreachable (circuit open / retries exhausted).  The response
+        is flagged (`metadata.degraded`) so callers can tell an
+        authoritative answer from a partition-local one.  Availability
+        over accuracy, exactly like the reference's design creed
+        (architecture.md:5-11): worst case each partition side admits
+        up to `limit` independently — N_partitions × limit total, the
+        same shape as the GLOBAL broadcast-lag bound (RESILIENCE.md)."""
+        resps = self.apply_local_batch([requests[i] for i in ids])
+        self.counters["degraded_answers"] += len(ids)
+        for i, resp in zip(ids, resps):
+            md = dict(resp.metadata) if resp.metadata else {}
+            md["degraded"] = "true"
+            md["owner"] = owner_addr
+            resp.metadata = md
+            responses[i] = resp
+
     def _forward_group(
         self,
         peer: PeerClient,
@@ -573,7 +606,20 @@ class V1Instance:
 
         reference: gubernator.go:333-422 (asyncRequests) — ≤5 retries on
         NotReady, re-picking the owner each time; if ownership migrated
-        to us mid-flight, apply locally.
+        to us mid-flight, apply locally.  Beyond the reference (the
+        health plane, RESILIENCE.md):
+
+        - re-pick rounds after a REAL dial failure sleep a capped
+          exponential backoff with full jitter (the reference's loop
+          re-picked with zero delay — the tail-amplifying spin "When
+          Two is Worse Than One" warns about);
+        - a circuit-open owner fails in one dict probe (no dial); with
+          degraded mode on the items are answered locally right away
+          instead of burning retries that can only land on the same
+          broken peer;
+        - exhausted retries answer degraded too (the pre-circuit-open
+          window) unless GUBER_DEGRADED_LOCAL=0 restores the
+          reference's fail-closed error strings.
 
         Multi-item groups go as ONE unary GetPeerRateLimits RPC (our
         client batch already coalesced them); singletons ride the
@@ -584,10 +630,17 @@ class V1Instance:
         groups: Dict[str, Tuple[PeerClient, List[int]]] = {
             peer.info.grpc_address: (peer, idxs)
         }
+        behaviors = self.conf.behaviors
+        degraded_on = behaviors.degraded_local
         attempts = 0
         while groups:
             if attempts > 5:
                 for _, (p, ids) in groups.items():
+                    if degraded_on:
+                        self._degraded_answer(
+                            ids, requests, responses, p.info.grpc_address
+                        )
+                        continue
                     for i in ids:
                         self.counters["check_errors"] += 1
                         responses[i] = RateLimitResp(
@@ -598,6 +651,7 @@ class V1Instance:
                         )
                 return
             retry: List[int] = []
+            dialed_and_failed = False
             for _, (p, ids) in groups.items():
                 if attempts != 0 and p.info.is_owner:
                     # Ownership moved to us (reference: gubernator.go:368-383).
@@ -607,15 +661,33 @@ class V1Instance:
                     continue
                 try:
                     if len(ids) == 1:
-                        resps = [p.get_peer_rate_limit(requests[ids[0]])]
+                        resps = [
+                            p.get_peer_rate_limit(
+                                requests[ids[0]],
+                                timeout=behaviors.batch_timeout,
+                            )
+                        ]
                     else:
                         resps = p.get_peer_rate_limits(
-                            [requests[i] for i in ids]
+                            [requests[i] for i in ids],
+                            timeout=behaviors.batch_timeout,
                         )
                 except PeerError as e:
+                    if e.circuit_open and degraded_on:
+                        # Broken owner, no probe due: a re-pick hands
+                        # back the same peer, so answer locally NOW —
+                        # this is the no-connect-timeout-storm path.
+                        self._degraded_answer(
+                            ids, requests, responses, p.info.grpc_address
+                        )
+                        continue
                     if e.not_ready:
                         self.counters["async_retries"] += len(ids)
                         retry.extend(ids)
+                        if not e.circuit_open:
+                            # A real dial burned a timeout — the next
+                            # round must wait, not spin.
+                            dialed_and_failed = True
                         continue
                     for i in ids:
                         responses[i] = RateLimitResp(
@@ -630,9 +702,21 @@ class V1Instance:
                     responses[i] = resp
             if not retry:
                 return
+            attempts += 1
+            if dialed_and_failed:
+                # Capped exponential + FULL jitter between re-pick
+                # rounds (cluster/health.backoff_delay): decorrelates
+                # the herd that all picked the same dead owner.
+                delay = backoff_delay(
+                    attempts - 1,
+                    behaviors.forward_backoff,
+                    behaviors.forward_backoff_cap,
+                )
+                if delay > 0:
+                    self.counters["backoff_retries"] += len(retry)
+                    time.sleep(delay)
             # Re-pick owners for the retried items; they may now map to
             # different peers or to us.
-            attempts += 1
             groups = {}
             for i in retry:
                 try:
@@ -1204,6 +1288,11 @@ class V1Instance:
             local_picker = self.local_picker.new()
             region_picker = self.region_picker.new()
             creds = self.conf.peer_credentials
+            # Our own advertise address (the is_owner entry): stamped
+            # on every client as the fault injector's src key.
+            me_addr = next(
+                (p.grpc_address for p in peer_infos if p.is_owner), ""
+            )
             local_members: List[PeerClient] = []
             for info in peer_infos:
                 # Strict DC match, like the reference — a node with
@@ -1218,6 +1307,7 @@ class V1Instance:
                         flush_stat=self.flush_duration,
                     )
                     peer.info = info
+                    peer.src_addr = me_addr
                     region_picker.add(peer)
                 else:
                     existing = self.local_picker.get_by_peer_info(info)
@@ -1228,6 +1318,7 @@ class V1Instance:
                         flush_stat=self.flush_duration,
                     )
                     peer.info = info
+                    peer.src_addr = me_addr
                     local_members.append(peer)
             local_picker.add_all(local_members)  # one ring rebuild
 

@@ -139,6 +139,79 @@ class InstanceCollector(Collector):
         c.add_metric([], inst.counters["async_retries"])
         yield c
 
+        # ---- peer health plane (cluster/health.py; RESILIENCE.md) ----
+        c = CounterMetricFamily(
+            "gubernator_degraded_answers",
+            "Requests answered by THIS node's engine because every "
+            "owner candidate was circuit-open/unreachable "
+            "(GUBER_DEGRADED_LOCAL).  Availability bought with bounded "
+            "over-admission: <= N_partitions * limit per key.",
+        )
+        c.add_metric([], inst.counters.get("degraded_answers", 0))
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_backoff_retries",
+            "Forward retries that waited out a capped-exponential "
+            "backoff window before re-picking an owner.",
+        )
+        c.add_metric([], inst.counters.get("backoff_retries", 0))
+        yield c
+
+        g = GaugeMetricFamily(
+            "gubernator_peer_state",
+            "Per-peer circuit state (1 on the current state's series): "
+            "healthy | suspect | broken | half-open.",
+            labels=["peer", "state"],
+        )
+        transitions = CounterMetricFamily(
+            "gubernator_circuit_transitions",
+            "Circuit state transitions per peer, by to-state.",
+            labels=["peer", "to"],
+        )
+        for peer in inst.get_peer_list():
+            try:
+                if peer.info.is_owner:
+                    # The self-peer is never dialed; every other
+                    # health surface (Daemon.peer_health, harness
+                    # health_states) filters it, and the scrape must
+                    # agree with them.
+                    continue
+                addr = peer.info.grpc_address
+                g.add_metric([addr, peer.health.state()], 1)
+                for to, n in sorted(peer.health.transition_counts().items()):
+                    transitions.add_metric([addr, to], n)
+            except Exception:  # noqa: BLE001 — peer mid-shutdown
+                record_swallowed("metrics.peer_health_scrape")
+                continue
+        yield g
+        yield transitions
+
+        c = CounterMetricFamily(
+            "gubernator_hits_requeue",
+            "GLOBAL hit-window re-queue traffic toward unreachable "
+            "owners, by event (requeued | dropped at the age cap).",
+            labels=["event"],
+        )
+        c.add_metric(["requeued"], inst.global_mgr.hits_requeued)
+        c.add_metric(["dropped"], inst.global_mgr.hits_requeue_dropped)
+        yield c
+
+        c = CounterMetricFamily(
+            "gubernator_broadcasts_skipped",
+            "Per-peer broadcast pushes skipped, by reason: "
+            "circuit_open (the peer is broken) or inflight (its "
+            "previous push outlived the fan-out deadline — slow but "
+            "healthy).  Supersedable traffic; the peer catches up "
+            "from later windows.",
+            labels=["reason"],
+        )
+        c.add_metric(["circuit_open"], inst.global_mgr.broadcasts_skipped)
+        c.add_metric(
+            ["inflight"], inst.global_mgr.broadcasts_skipped_inflight
+        )
+        yield c
+
         g = GaugeMetricFamily(
             "gubernator_cache_size",
             "The number of bucket slots currently interned.",

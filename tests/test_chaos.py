@@ -1,0 +1,501 @@
+"""Chaos suite: the health plane under kill / partition / heal.
+
+Drives real in-process clusters through failure and pins the
+invariants RESILIENCE.md promises (ISSUE 5 acceptance):
+
+- with 1 of 4 peers dead and degraded mode ON, ≥99% of requests still
+  receive non-error answers, flagged via response metadata;
+- broken peers are SKIPPED, not re-dialed — the forward path keeps a
+  bounded latency once circuits open (no connect-timeout storms);
+- over-admission under partition stays within N_alive × limit;
+- GLOBAL flush cycles are bounded by the fan-out deadline even when a
+  peer swallows sends whole;
+- hits re-queue (bounded, age-capped) and land once the owner heals;
+- health states converge back to `healthy` after heal/restart;
+- GUBER_DEGRADED_LOCAL=0 restores the reference's fail-closed errors.
+
+Failure is injected two ways: daemon kill (real dead TCP port) and
+the SEEDED fault injector (cluster/faults.py) for asymmetric
+partitions and latency — deterministic where the assertion needs it.
+Fast cases run tier-1; the multi-cycle soak is @slow.
+"""
+
+import time
+from dataclasses import replace as dc_replace
+
+import pytest
+
+from gubernator_tpu.client import V1Client, random_string
+from gubernator_tpu.cluster import faults
+from gubernator_tpu.cluster.harness import ClusterHarness, cluster_behaviors
+from gubernator_tpu.cluster.health import BROKEN, HEALTHY
+from gubernator_tpu.types import Behavior, RateLimitReq, Status
+
+
+def _until(pred, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _req(name, key, limit=1_000_000, hits=1, behavior=0):
+    return RateLimitReq(
+        name=name,
+        unique_key=key,
+        hits=hits,
+        limit=limit,
+        duration=60_000,
+        behavior=behavior,
+    )
+
+
+def _keys_owned_by(h, daemon_idx, name, n, prefix):
+    """Find `n` keys whose owner is daemons[daemon_idx]."""
+    want = h.daemons[daemon_idx].peer_info().grpc_address
+    out = []
+    i = 0
+    while len(out) < n:
+        key = f"{prefix}{i}_{random_string()}"
+        if (
+            h.daemons[0].instance.get_peer(f"{name}_{key}").info.grpc_address
+            == want
+        ):
+            out.append(key)
+        i += 1
+        assert i < 20_000, "ring never mapped enough keys to the target"
+    return out
+
+
+def _all_healthy(h, skip=()):
+    states = h.health_states()
+    return all(
+        st == HEALTHY
+        for src, peers in states.items()
+        for dst, st in peers.items()
+        if dst not in skip and src not in skip
+    )
+
+
+# ----------------------------------------------------------------------
+# Kill / restart arc (one 4-node cluster, ordered tests).
+
+
+@pytest.fixture(scope="module")
+def kill_cluster():
+    h = ClusterHarness().start(4)
+    yield h
+    h.stop()
+
+
+@pytest.fixture(scope="module")
+def killed(kill_cluster):
+    """Kill daemon 3 once for the whole arc; expose its address."""
+    h = kill_cluster
+    addr = h.daemons[3].peer_info().grpc_address
+    dead_keys = _keys_owned_by(h, 3, "chaos_kill", 8, "dk")
+    h.kill(3)
+    return {"addr": addr, "dead_keys": dead_keys}
+
+
+def test_owner_killed_degraded_availability(kill_cluster, killed):
+    """ISSUE 5 acceptance: 1 of 4 peers dead → ≥99% non-error answers
+    (here: 100%), dead-owner items flagged degraded."""
+    h = kill_cluster
+    n_err = 0
+    n_degraded = 0
+    n_total = 0
+    with V1Client(h.peer_at(0).grpc_address) as c:
+        for round_ in range(12):
+            for key in killed["dead_keys"]:
+                r = c.get_rate_limits(
+                    [_req("chaos_kill", key)], timeout=15
+                )[0]
+                n_total += 1
+                if r.error:
+                    n_err += 1
+                elif r.metadata.get("degraded") == "true":
+                    n_degraded += 1
+            # Live-owner traffic keeps flowing untouched.
+            r = c.get_rate_limits(
+                [_req("chaos_live", f"live{round_}")], timeout=15
+            )[0]
+            n_total += 1
+            if r.error:
+                n_err += 1
+    assert n_err / n_total <= 0.01, f"{n_err}/{n_total} errors"
+    assert n_degraded > 0  # the dead owner's items were served locally
+    inst = h.daemons[0].instance
+    assert inst.counters["degraded_answers"] > 0
+
+
+def test_circuit_opens_and_forwarding_stays_fast(kill_cluster, killed):
+    """Broken peers are skipped, not re-dialed: once the circuit is
+    open, a dead-owner request costs a dict probe + a local engine
+    apply — far under one gRPC timeout, with no 5-retry spin."""
+    h = kill_cluster
+    assert _until(
+        lambda: h.health_states()[
+            h.daemons[0].peer_info().grpc_address
+        ].get(killed["addr"]) == BROKEN,
+        timeout=5.0,
+    ), h.health_states()
+    with V1Client(h.peer_at(0).grpc_address) as c:
+        t0 = time.monotonic()
+        n = 20
+        for i in range(n):
+            r = c.get_rate_limits(
+                [_req("chaos_kill", killed["dead_keys"][i % 8])],
+                timeout=15,
+            )[0]
+            assert r.error == ""
+        per_req = (time.monotonic() - t0) / n
+    # One gRPC timeout is 1s in cluster_behaviors; circuit-open
+    # serving must be orders faster (generous CI bound).
+    assert per_req < 0.25, f"{per_req * 1e3:.0f}ms per request"
+
+
+def test_restart_heals_and_states_converge(kill_cluster, killed):
+    """Restart the killed daemon; with light traffic driving probes,
+    every node's circuit to it must return to `healthy`."""
+    h = kill_cluster
+    h.restart(3)
+
+    def _probe_and_check():
+        # Traffic is what half-opens circuits (probes ride real RPCs).
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for key in killed["dead_keys"]:
+                c.get_rate_limits([_req("chaos_kill", key)], timeout=15)
+        return _all_healthy(h)
+
+    assert _until(_probe_and_check, timeout=20.0, interval=0.2), (
+        h.health_states()
+    )
+    # And the answers are authoritative again (no degraded flag).
+    with V1Client(h.peer_at(0).grpc_address) as c:
+        r = c.get_rate_limits(
+            [_req("chaos_kill", killed["dead_keys"][0])], timeout=15
+        )[0]
+    assert r.error == ""
+    assert r.metadata.get("degraded") is None
+
+
+# ----------------------------------------------------------------------
+# Fail-closed mode (GUBER_DEGRADED_LOCAL=0 semantics).
+
+
+def test_degraded_off_restores_fail_closed_errors():
+    b = dc_replace(cluster_behaviors(), degraded_local=False)
+    h = ClusterHarness().start(3, behaviors=b)
+    try:
+        keys = _keys_owned_by(h, 2, "chaos_fc", 2, "fc")
+        h.kill(2)
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            # First requests may burn the retry loop; once the circuit
+            # opens the error is immediate — but ALWAYS an error.
+            for _ in range(6):
+                r = c.get_rate_limits(
+                    [_req("chaos_fc", keys[0])], timeout=15
+                )[0]
+                assert r.error != ""
+                assert r.metadata.get("degraded") is None
+        assert h.daemons[0].instance.counters["degraded_answers"] == 0
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Asymmetric partition via the seeded injector.
+
+
+def test_asymmetric_partition_degrades_only_blocked_direction():
+    h = ClusterHarness().start(3)
+    try:
+        keys = _keys_owned_by(h, 1, "chaos_part", 4, "ap")
+        h.install_faults(seed=42)
+        h.partition(0, 1)  # node0 cannot reach node1; node2 can
+        with V1Client(h.peer_at(0).grpc_address) as c0, V1Client(
+            h.peer_at(2).grpc_address
+        ) as c2:
+            # node0's circuit to node1 opens, then answers degrade.
+            def _degraded():
+                r = c0.get_rate_limits(
+                    [_req("chaos_part", keys[0])], timeout=15
+                )[0]
+                return r.metadata.get("degraded") == "true"
+
+            assert _until(_degraded, timeout=10.0, interval=0.1)
+            # The unblocked direction keeps authoritative answers.
+            r2 = c2.get_rate_limits(
+                [_req("chaos_part", keys[1])], timeout=15
+            )[0]
+            assert r2.error == ""
+            assert r2.metadata.get("degraded") is None
+
+            # Heal: probes ride the traffic; states converge, answers
+            # turn authoritative again.
+            h.heal()
+
+            def _healed():
+                r = c0.get_rate_limits(
+                    [_req("chaos_part", keys[2])], timeout=15
+                )[0]
+                return (
+                    r.error == ""
+                    and r.metadata.get("degraded") is None
+                    and _all_healthy(h)
+                )
+
+            assert _until(_healed, timeout=15.0, interval=0.2), (
+                h.health_states()
+            )
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Over-admission bound under partition (RESILIENCE.md).
+
+
+def test_partition_over_admission_within_bound():
+    """Dead owner, limit=10: every surviving node admits at most
+    `limit` from its OWN engine, so total admission stays within
+    N_alive × limit — the documented degraded-mode bound."""
+    h = ClusterHarness().start(3)
+    try:
+        limit = 10
+        key = _keys_owned_by(h, 2, "chaos_bound", 1, "ob")[0]
+        h.kill(2)
+        admitted = 0
+        for idx in (0, 1):
+            with V1Client(h.peer_at(idx).grpc_address) as c:
+                for _ in range(3 * limit):
+                    r = c.get_rate_limits(
+                        [_req("chaos_bound", key, limit=limit)],
+                        timeout=15,
+                    )[0]
+                    assert r.error == ""
+                    if r.status == Status.UNDER_LIMIT:
+                        admitted += 1
+        alive = 2
+        assert limit <= admitted <= alive * limit, admitted
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# GLOBAL plane: bounded fan-out barrier + hit re-queue.
+
+
+def test_global_fanout_deadline_bounds_flush():
+    """A peer whose sends hang (injected 2.5s latency) must not stall
+    a broadcast flush past the fan-out budget (1s in the harness
+    behaviors): the barrier stops waiting, counts the timeout, and the
+    cycle completes."""
+    h = ClusterHarness().start(3)
+    try:
+        inj = h.install_faults(seed=7)
+        inj.latency_rate = 1.0
+        inj.latency_s = 2.5
+        d0 = h.daemons[0]
+        d0.instance.global_mgr.queue_update(
+            _req("chaos_dl", "k", behavior=int(Behavior.GLOBAL))
+        )
+        t0 = time.monotonic()
+        d0.instance.global_mgr._updates.flush_now()
+        elapsed = time.monotonic() - t0
+        # 2 peers × 2.5s serial would be 5s; the pool + 1s barrier
+        # budget must cut the cycle to ~1s (slack for CI).
+        assert elapsed < 2.2, f"flush took {elapsed:.2f}s"
+        from gubernator_tpu.utils.metrics import swallowed_counts
+
+        assert swallowed_counts().get("global.fanout_deadline", 0) > 0
+    finally:
+        h.stop()
+
+
+def test_hits_requeue_until_owner_heals():
+    """GLOBAL hits toward a partitioned owner are re-queued (bounded,
+    age-capped) and delivered once the partition heals — the owner
+    converges instead of permanently under-counting."""
+    h = ClusterHarness().start(3)
+    try:
+        key = _keys_owned_by(h, 1, "chaos_rq", 1, "rq")[0]
+        owner = h.daemons[1]
+        src = h.daemons[0]
+        h.install_faults(seed=9)
+        h.partition(0, 1)
+        gm = src.instance.global_mgr
+        gm.queue_hit(
+            _req("chaos_rq", key, hits=5, behavior=int(Behavior.GLOBAL))
+        )
+        gm._hits.flush_now()  # fails against the partition → re-queue
+        assert _until(lambda: gm.hits_requeued > 0, timeout=5.0), (
+            gm.hits_requeued,
+            gm.hits_requeue_dropped,
+        )
+        before = owner.instance.engine.requests_total
+        h.heal()
+        # The re-queued hits ride a later window; poke flushes until
+        # the owner's engine has seen them.
+        assert _until(
+            lambda: (gm._hits.flush_now(), None)[1]
+            or owner.instance.engine.requests_total > before,
+            timeout=8.0,
+            interval=0.2,
+        )
+    finally:
+        h.stop()
+
+
+def test_hits_requeue_age_cap_drops_stale():
+    """Past hit_requeue_age the backlog is dropped (counted), not
+    replayed — a long-dead owner must not absorb an unbounded replay
+    the moment it returns."""
+    b = dc_replace(cluster_behaviors(), hit_requeue_age=0.3)
+    h = ClusterHarness().start(3, behaviors=b)
+    try:
+        key = _keys_owned_by(h, 1, "chaos_age", 1, "ag")[0]
+        src = h.daemons[0]
+        h.install_faults(seed=11)
+        h.partition(0, 1)
+        gm = src.instance.global_mgr
+        r = _req("chaos_age", key, hits=1, behavior=int(Behavior.GLOBAL))
+        gm.queue_hit(r)
+        gm._hits.flush_now()
+        assert _until(lambda: gm.hits_requeued > 0, timeout=5.0)
+        time.sleep(0.4)  # outlive the age cap
+        # Next failing flush evaluates the age cap and drops.
+        gm._hits.flush_now()
+        assert _until(lambda: gm.hits_requeue_dropped > 0, timeout=5.0), (
+            gm.hits_requeued,
+            gm.hits_requeue_dropped,
+        )
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Metrics surface.
+
+
+def test_health_metrics_exported():
+    import urllib.request
+
+    h = ClusterHarness().start(2)
+    try:
+        keys = _keys_owned_by(h, 1, "chaos_m", 2, "mx")
+        h.kill(1)
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for _ in range(6):
+                c.get_rate_limits([_req("chaos_m", keys[0])], timeout=15)
+        body = urllib.request.urlopen(
+            f"http://{h.daemons[0].http_address}/metrics", timeout=5
+        ).read().decode()
+        assert 'gubernator_peer_state{' in body
+        assert 'state="broken"' in body
+        assert "gubernator_degraded_answers" in body
+        assert 'gubernator_circuit_transitions_total{' in body
+        assert 'to="broken"' in body
+        # The operator entry mirrors the scrape.
+        ph = h.daemons[0].peer_health()
+        (peer_view,) = ph.values()
+        assert peer_view["state"] == BROKEN
+        assert peer_view["transitions"].get(BROKEN, 0) >= 1
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Soak: kill / partition / heal cycles under sustained traffic.
+
+
+@pytest.mark.slow
+def test_chaos_soak_cycles():
+    """Three full failure cycles (kill+restart, asymmetric partition,
+    isolate+heal) with traffic throughout: availability ≥99%, health
+    states converge after every heal, the GLOBAL queues never wedge
+    (backlog age stays bounded), and over-admission of a limited key
+    stays within the partition bound."""
+    h = ClusterHarness().start(4)
+    try:
+        h.install_faults(seed=1234)
+        limit = 50
+        bound_key = _keys_owned_by(h, 1, "soak_bound", 1, "sb")[0]
+        n_err = 0
+        n_total = 0
+        admitted = 0
+        # One counter across ALL drive calls: the convergence loops
+        # call drive(5) repeatedly, and restarting at k0/g0 each time
+        # would leave whole owners without traffic — circuits only
+        # probe on real sends, so an unlucky ring layout would never
+        # converge (observed ~25% flake before the rotation).
+        import itertools
+
+        tick = itertools.count()
+
+        def drive(rounds, client_idx=0):
+            nonlocal n_err, n_total, admitted
+            with V1Client(h.peer_at(client_idx).grpc_address) as c:
+                for _ in range(rounds):
+                    i = next(tick)
+                    rs = c.get_rate_limits(
+                        [
+                            _req("soak", f"k{i % 37}"),
+                            _req(
+                                "soak_g",
+                                f"g{i % 11}",
+                                behavior=int(Behavior.GLOBAL),
+                            ),
+                            _req("soak_bound", bound_key, limit=limit),
+                        ],
+                        timeout=15,
+                    )
+                    for r in rs:
+                        n_total += 1
+                        if r.error:
+                            n_err += 1
+                    if rs[2].status == Status.UNDER_LIMIT and not rs[2].error:
+                        admitted += 1
+
+        # Cycle 1: kill + restart.
+        drive(30)
+        h.kill(3)
+        drive(60)
+        h.restart(3)
+        drive(30)
+        assert _until(
+            lambda: (drive(5), None)[1] or _all_healthy(h), timeout=30.0,
+            interval=0.3,
+        ), h.health_states()
+
+        # Cycle 2: asymmetric partition + heal.
+        h.partition(0, 2)
+        drive(60)
+        h.heal()
+        assert _until(
+            lambda: (drive(5), None)[1] or _all_healthy(h), timeout=30.0,
+            interval=0.3,
+        ), h.health_states()
+
+        # Cycle 3: full isolation of one node + heal.
+        h.isolate(2)
+        drive(60)
+        h.heal()
+        assert _until(
+            lambda: (drive(5), None)[1] or _all_healthy(h), timeout=30.0,
+            interval=0.3,
+        ), h.health_states()
+
+        assert n_err / n_total <= 0.01, f"{n_err}/{n_total}"
+        # The limited key never over-admits past N_nodes × limit.
+        assert admitted <= 4 * limit, admitted
+        # GLOBAL queues drained — nothing wedged behind a dead flush.
+        for d in h.daemons:
+            gm = d.instance.global_mgr
+            assert gm._hits.backlog_age() < 10.0
+            assert gm._updates.backlog_age() < 10.0
+    finally:
+        h.stop()

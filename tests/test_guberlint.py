@@ -506,3 +506,112 @@ def test_fix_annotations_skips_mixed_lock_attrs(tmp_path, monkeypatch):
     assert main_mod.fix_annotations([p]) == 0, (
         "an attr with unlocked accesses must not get a stub"
     )
+
+
+# ----------------------------------------------------------------- net
+
+
+NET_RETRY_BAD = """
+    from gubernator_tpu.cluster.peer_client import PeerError
+
+    def forward(groups, pick):
+        while groups:
+            retry = []
+            for p, ids in groups:
+                try:
+                    p.rpc(ids)
+                except PeerError as e:
+                    if e.not_ready:
+                        retry.extend(ids)
+                        continue
+            groups = pick(retry)
+"""
+
+
+def test_net_pass_catches_retry_without_backoff(tmp_path):
+    from tools.guberlint import netcheck
+
+    findings = netcheck.check_file(_src(tmp_path, NET_RETRY_BAD))
+    assert any(f.rule == "net-retry-no-backoff" for f in findings)
+
+
+def test_net_pass_backoff_in_enclosing_loop_ok(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = NET_RETRY_BAD.replace(
+        "            groups = pick(retry)",
+        "            time.sleep(backoff_delay(1, 0.01, 0.25))\n"
+        "            groups = pick(retry)",
+    )
+    findings = netcheck.check_file(_src(tmp_path, code))
+    assert not [f for f in findings if f.rule == "net-retry-no-backoff"]
+
+
+def test_net_pass_log_and_continue_is_not_a_retry_loop(tmp_path):
+    """multiregion-style per-peer iteration: catching PeerError to
+    skip a peer (no not_ready decision, no retry collection) is not a
+    retry loop and must not demand backoff."""
+    from tools.guberlint import netcheck
+
+    code = """
+        from gubernator_tpu.cluster.peer_client import PeerError
+
+        def send_all(by_peer, log):
+            for addr, reqs in by_peer.items():
+                try:
+                    addr.rpc(reqs)
+                except PeerError as e:
+                    log.error("send to %s failed: %s", addr, e)
+                    continue
+    """
+    findings = netcheck.check_file(_src(tmp_path, code))
+    assert not [f for f in findings if f.rule == "net-retry-no-backoff"]
+
+
+def test_net_pass_catches_rpc_without_timeout(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = """
+        def flush(peer, reqs):
+            peer.send_peer_hits(reqs)
+    """
+    findings = netcheck.check_file(_src(tmp_path, code))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "net-rpc-no-timeout"
+    assert "send_peer_hits" in f.message
+
+
+def test_net_pass_rpc_with_timeout_ok(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = """
+        def flush(peer, reqs, conf):
+            peer.send_peer_hits(reqs, timeout=conf.global_timeout)
+    """
+    assert netcheck.check_file(_src(tmp_path, code)) == []
+
+
+def test_net_pass_server_side_receivers_exempt(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = """
+        class Adapter:
+            def handle(self, reqs):
+                return self.instance.get_peer_rate_limits(reqs)
+
+        class Client:
+            def one(self, req):
+                return self.get_peer_rate_limits([req], timeout=1.0)
+    """
+    assert netcheck.check_file(_src(tmp_path, code)) == []
+
+
+def test_net_pass_suppression_escape_hatch(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = """
+        def flush(peer, reqs):
+            peer.send_peer_hits(reqs)  # guberlint: ok net — probe uses channel default
+    """
+    assert netcheck.check_file(_src(tmp_path, code)) == []

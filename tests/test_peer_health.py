@@ -1,0 +1,275 @@
+"""Unit suite for the peer health plane (cluster/health.py).
+
+Pins the circuit-breaker transition table, the half-open probe-slot
+semantics, the exponential open-period growth, and the backoff_delay
+jitter envelope — plus the fault injector's determinism contract
+(cluster/faults.py): equal seeds replay equal fates.
+"""
+
+import random
+
+import pytest
+
+from gubernator_tpu.cluster import faults
+from gubernator_tpu.cluster.health import (
+    BROKEN,
+    HALF_OPEN,
+    HEALTHY,
+    SUSPECT,
+    PeerHealth,
+    backoff_delay,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _health(threshold=3, backoff=1.0, cap=8.0):
+    clock = FakeClock()
+    h = PeerHealth(
+        "peer:1",
+        failure_threshold=threshold,
+        backoff=backoff,
+        backoff_cap=cap,
+        now=clock,
+    )
+    return h, clock
+
+
+# -- transition table --------------------------------------------------
+
+
+def test_starts_healthy_and_allows():
+    h, _ = _health()
+    assert h.state() == HEALTHY
+    assert h.allow()
+    assert h.would_allow()
+
+
+def test_first_failure_moves_to_suspect():
+    h, _ = _health()
+    h.record_failure()
+    assert h.state() == SUSPECT
+    assert h.allow()  # suspect still sends
+
+
+def test_suspect_success_returns_to_healthy():
+    h, _ = _health()
+    h.record_failure()
+    h.record_success()
+    assert h.state() == HEALTHY
+
+
+def test_threshold_failures_open_the_circuit():
+    h, _ = _health(threshold=3)
+    for _ in range(3):
+        h.record_failure()
+    assert h.state() == BROKEN
+    assert not h.allow()
+    assert not h.would_allow()
+    assert h.retry_after() > 0
+
+
+def test_broken_until_open_period_expires_then_one_probe():
+    h, clock = _health(threshold=1, backoff=2.0)
+    h.record_failure()
+    assert h.state() == BROKEN
+    assert not h.allow()
+    clock.advance(2.01)
+    assert h.would_allow()
+    # First caller wins the probe slot...
+    assert h.allow()
+    assert h.state() == HALF_OPEN
+    # ...everyone else is refused while the probe is in flight.
+    assert not h.allow()
+    assert not h.would_allow()
+
+
+def test_half_open_success_closes_circuit():
+    h, clock = _health(threshold=1, backoff=1.0)
+    h.record_failure()
+    clock.advance(1.01)
+    assert h.allow()
+    h.record_success()
+    assert h.state() == HEALTHY
+    assert h.allow()
+
+
+def test_half_open_failure_reopens_with_doubled_period():
+    h, clock = _health(threshold=1, backoff=1.0, cap=8.0)
+    h.record_failure()  # open @ 1.0
+    clock.advance(1.01)
+    assert h.allow()  # half-open probe
+    h.record_failure()  # probe failed → open @ 2.0
+    assert h.state() == BROKEN
+    assert h.retry_after() == pytest.approx(2.0, abs=0.01)
+    clock.advance(2.01)
+    assert h.allow()
+    h.record_failure()  # → 4.0
+    assert h.retry_after() == pytest.approx(4.0, abs=0.01)
+    clock.advance(4.01)
+    assert h.allow()
+    h.record_failure()  # → 8.0 (cap)
+    assert h.retry_after() == pytest.approx(8.0, abs=0.01)
+    clock.advance(8.01)
+    assert h.allow()
+    h.record_failure()  # capped: stays 8.0
+    assert h.retry_after() == pytest.approx(8.0, abs=0.01)
+
+
+def test_recovery_resets_open_period():
+    h, clock = _health(threshold=1, backoff=1.0, cap=8.0)
+    for _ in range(3):  # grow the period to 4.0
+        h.record_failure()
+        clock.advance(h.retry_after() + 0.01)
+        assert h.allow()
+    h.record_success()
+    assert h.state() == HEALTHY
+    # Next break starts back at the base period.
+    h.record_failure()
+    assert h.retry_after() == pytest.approx(1.0, abs=0.01)
+
+
+def test_failure_while_broken_is_absorbed():
+    """A racing in-flight RPC failing after the circuit opened must
+    not grow the period or disturb the probe schedule."""
+    h, _ = _health(threshold=1, backoff=2.0)
+    h.record_failure()
+    before = h.retry_after()
+    h.record_failure()
+    assert h.state() == BROKEN
+    assert h.retry_after() == pytest.approx(before, abs=0.01)
+
+
+def test_stale_probe_slot_is_reclaimed():
+    """A probe whose sender dies between winning the slot and the RPC
+    (no outcome ever recorded) must not blacklist the peer forever:
+    past probe_timeout the next caller reclaims the slot."""
+    clock = FakeClock()
+    h = PeerHealth(
+        "peer:1", failure_threshold=1, backoff=1.0, backoff_cap=8.0,
+        probe_timeout=5.0, now=clock,
+    )
+    h.record_failure()
+    clock.advance(1.01)
+    assert h.allow()  # probe slot taken... and the prober vanishes
+    assert not h.allow()
+    assert not h.would_allow()
+    clock.advance(5.01)  # probe_timeout elapsed with no outcome
+    assert h.would_allow()
+    assert h.allow()  # reclaimed
+    h.record_success()
+    assert h.state() == HEALTHY
+
+
+def test_transition_counters():
+    h, clock = _health(threshold=1, backoff=1.0)
+    h.record_failure()  # healthy→suspect→broken
+    clock.advance(1.01)
+    h.allow()  # → half-open
+    h.record_success()  # → healthy
+    t = h.transition_counts()
+    assert t[SUSPECT] == 1
+    assert t[BROKEN] == 1
+    assert t[HALF_OPEN] == 1
+    assert t[HEALTHY] == 1
+
+
+# -- backoff_delay -----------------------------------------------------
+
+
+def test_backoff_delay_full_jitter_envelope():
+    rng = random.Random(7)
+    for attempt in range(6):
+        ceiling = min(0.25, 0.01 * 2**attempt)
+        for _ in range(50):
+            d = backoff_delay(attempt, 0.01, 0.25, rng)
+            assert 0.0 <= d <= ceiling
+
+
+def test_backoff_delay_zero_base_disables():
+    assert backoff_delay(3, 0.0, 1.0) == 0.0
+
+
+def test_backoff_delay_deterministic_with_seed():
+    a = [backoff_delay(i, 0.01, 0.25, random.Random(42)) for i in range(5)]
+    b = [backoff_delay(i, 0.01, 0.25, random.Random(42)) for i in range(5)]
+    assert a == b
+
+
+# -- fault injector ----------------------------------------------------
+
+
+def test_injector_same_seed_same_fates():
+    def fates(seed):
+        inj = faults.FaultInjector(seed, drop_rate=0.3, reset_rate=0.2)
+        out = []
+        for _ in range(200):
+            try:
+                inj.check("a", "b")
+                out.append("ok")
+            except faults.FaultError as e:
+                out.append(e.kind)
+        return out
+
+    assert fates(123) == fates(123)
+    assert fates(123) != fates(124)  # and the seed actually matters
+
+
+def test_injector_asymmetric_partition():
+    inj = faults.FaultInjector(0)
+    inj.partition("a", "b")
+    with pytest.raises(faults.FaultError):
+        inj.check("a", "b")
+    inj.check("b", "a")  # reverse direction flows
+    inj.heal()
+    inj.check("a", "b")
+
+
+def test_injector_isolate_and_heal():
+    inj = faults.FaultInjector(0)
+    inj.isolate("n1")
+    with pytest.raises(faults.FaultError):
+        inj.check("n1", "n2")
+    with pytest.raises(faults.FaultError):
+        inj.check("n3", "n1")
+    inj.check("n2", "n3")
+    inj.heal()
+    inj.check("n1", "n2")
+    assert inj.counts().get("partition", 0) == 2
+
+
+def test_injector_targeted_heal_leaves_other_rules():
+    """heal(src, dst) wildcards only on the ARGUMENT side: healing
+    node A's partitions must not tear down node B's isolation."""
+    inj = faults.FaultInjector(0)
+    inj.isolate("B")
+    inj.partition("A", "C")
+    inj.heal("A", None)
+    with pytest.raises(faults.FaultError):
+        inj.check("X", "B")  # B's inbound isolation survives
+    inj.check("A", "C")  # A's rule is gone
+    inj.heal(dst="B")
+    with pytest.raises(faults.FaultError):
+        inj.check("B", "X")  # B's OUTBOUND rule ("B","*") survives
+    inj.heal()
+    inj.check("B", "X")
+    inj.check("X", "B")
+
+
+def test_injector_install_uninstall():
+    assert faults.active() is None
+    inj = faults.install(faults.FaultInjector(1))
+    try:
+        assert faults.active() is inj
+    finally:
+        faults.uninstall()
+    assert faults.active() is None

@@ -25,7 +25,7 @@ from pathlib import Path
 from typing import Dict, List, Set, Tuple
 
 from tools.guberlint import baseline as baseline_mod
-from tools.guberlint import lockcheck, threadcheck, tracecheck
+from tools.guberlint import lockcheck, netcheck, threadcheck, tracecheck
 from tools.guberlint.common import Finding, SourceFile, attr_path, iter_py_files
 from tools.guberlint.config import EXCLUDE, LINT_ROOTS, TRACE_SCOPES
 
@@ -50,6 +50,7 @@ def run(paths: List[Path]) -> List[Finding]:
         if any(src.rel.startswith(s) for s in TRACE_SCOPES):
             findings.extend(tracecheck.check_file(src))
         findings.extend(threadcheck.check_file(src))
+        findings.extend(netcheck.check_file(src))
     findings.extend(lockcheck.order_findings(edges))
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings

@@ -1,0 +1,200 @@
+"""Pass 4 — peer-network discipline (the health-plane contract).
+
+- ``net-retry-no-backoff`` — a retry loop over peer RPCs (a
+  ``while``/``for`` whose body catches ``PeerError`` and makes a
+  retry decision: references ``not_ready``/``circuit_open`` or feeds a
+  ``retry``-named collection) must contain a backoff call somewhere in
+  the loop — ``time.sleep``, ``backoff_delay``, or a ``.wait(...)``.
+  A backoff-free re-pick spin is exactly the tail-latency amplifier
+  the health plane exists to remove ("When Two is Worse Than One",
+  PAPERS.md); the reference's 5-retry loop had this bug.
+
+- ``net-rpc-no-timeout`` — call sites of the PeerClient RPC surface
+  (``get_peer_rate_limit(s)``, ``send_peer_hits(_raw)``,
+  ``update_peer_globals(_raw)``) must pass an explicit ``timeout=``.
+  The methods have defaults, but a call site that doesn't say its
+  deadline is a call site nobody budgeted — the GLOBAL fan-out stall
+  fixed in this round came from exactly such a site.  Server-side
+  receivers (``self`` / ``*.instance``) are exempt: those are the
+  V1Instance methods of the same names, which answer locally.
+
+Suppress with the usual grammar: ``# guberlint: ok net — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.guberlint.common import Finding, SourceFile, attr_path
+
+PASS = "net"
+
+# The PeerClient RPC surface (every one takes timeout=).
+PEER_RPC_METHODS = {
+    "get_peer_rate_limit",
+    "get_peer_rate_limits",
+    "send_peer_hits",
+    "send_peer_hits_raw",
+    "update_peer_globals",
+    "update_peer_globals_raw",
+}
+
+# Backoff-shaped calls that satisfy net-retry-no-backoff.
+_BACKOFF_CALL_NAMES = {"sleep", "backoff_delay", "wait"}
+
+
+def _scope_name(src: SourceFile, node: ast.AST) -> str:
+    """Innermost Class.method / func enclosing `node` (for findings)."""
+    best_cls = best_fn = None
+    if src.tree is None:
+        return "<module>"
+    for n in ast.walk(src.tree):
+        if not isinstance(
+            n, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        if (
+            n.lineno <= node.lineno
+            and getattr(n, "end_lineno", n.lineno) >= node.lineno
+        ):
+            if isinstance(n, ast.ClassDef):
+                if best_cls is None or n.lineno > best_cls.lineno:
+                    best_cls = n
+            elif best_fn is None or n.lineno > best_fn.lineno:
+                best_fn = n
+    if best_cls is not None and best_fn is not None:
+        return f"{best_cls.name}.{best_fn.name}"
+    if best_fn is not None:
+        return best_fn.name
+    return "<module>"
+
+
+def _catches_peer_error(handler: ast.ExceptHandler) -> bool:
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        path = attr_path(t) if t is not None else None
+        if path and path.split(".")[-1] == "PeerError":
+            return True
+    return False
+
+
+def _is_retry_decision(handler: ast.ExceptHandler) -> bool:
+    """The handler decides to RETRY: it inspects not_ready /
+    circuit_open, or feeds a retry collection.  A log-and-continue
+    handler iterating unrelated peers is not a retry loop."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "not_ready",
+            "circuit_open",
+        ):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("append", "extend")
+        ):
+            recv = attr_path(node.func.value) or ""
+            if "retry" in recv.lower():
+                return True
+    return False
+
+
+def _has_backoff(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        path = attr_path(node.func)
+        name = (
+            path.split(".")[-1]
+            if path
+            else getattr(node.func, "attr", getattr(node.func, "id", ""))
+        )
+        if name in _BACKOFF_CALL_NAMES:
+            return True
+    return False
+
+
+def check_file(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if src.tree is None:
+        return findings
+
+    # -- net-retry-no-backoff -----------------------------------------
+    all_loops = [
+        n for n in ast.walk(src.tree) if isinstance(n, (ast.While, ast.For))
+    ]
+    for loop in all_loops:
+        retry_handler: Optional[ast.ExceptHandler] = None
+        for node in ast.walk(loop):
+            if (
+                isinstance(node, ast.ExceptHandler)
+                and _catches_peer_error(node)
+                and _is_retry_decision(node)
+            ):
+                retry_handler = node
+                break
+        if retry_handler is None:
+            continue
+        # Backoff anywhere in this loop OR an enclosing loop counts:
+        # the canonical shape sleeps between ROUNDS (the outer while),
+        # not inside the per-group for.
+        enclosing = [
+            l for l in all_loops
+            if l.lineno <= loop.lineno
+            and getattr(l, "end_lineno", l.lineno)
+            >= getattr(loop, "end_lineno", loop.lineno)
+        ]
+        if any(_has_backoff(l) for l in enclosing):
+            continue
+        if src.suppressed(loop.lineno, PASS) or src.suppressed(
+            retry_handler.lineno, PASS
+        ):
+            continue
+        findings.append(
+            Finding(
+                PASS, "net-retry-no-backoff", src.rel, loop.lineno,
+                _scope_name(src, loop), f"retry-loop@{loop.lineno}",
+                "peer-RPC retry loop without backoff — sleep a capped "
+                "exponential with jitter (cluster/health.backoff_delay) "
+                "between attempts, or suppress with a reasoned "
+                "`# guberlint: ok net — <why>`",
+            )
+        )
+
+    # -- net-rpc-no-timeout -------------------------------------------
+    for node in ast.walk(src.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in PEER_RPC_METHODS
+        ):
+            continue
+        recv = attr_path(node.func.value)
+        # Server-side same-name methods (V1Instance answers locally).
+        if recv is not None and (
+            recv == "self"
+            or recv == "instance"
+            or recv.endswith(".instance")
+        ):
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        if src.suppressed(node.lineno, PASS):
+            continue
+        findings.append(
+            Finding(
+                PASS, "net-rpc-no-timeout", src.rel, node.lineno,
+                _scope_name(src, node),
+                f"{node.func.attr}@{recv or '?'}",
+                f"peer RPC `{node.func.attr}` without an explicit "
+                "timeout= — every peer send must state its deadline "
+                "(the fan-out barrier budgets depend on it), or "
+                "suppress with a reasoned `# guberlint: ok net — <why>`",
+            )
+        )
+    return findings
