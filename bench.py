@@ -206,6 +206,8 @@ def main() -> int:
             result = _run_herd(np, platform)
         elif MODE == "deadpeer":
             result = _run_deadpeer(np, platform)
+        elif MODE == "reshard":
+            result = _run_reshard(np, platform)
         elif MODE == "herdnative":
             # 32 concurrent SINGLE-ITEM RPCs against the h2 fast front:
             # the native decision plane's per-RPC floor as its own
@@ -1148,6 +1150,87 @@ def _run_global_procs(np, platform: str, n_nodes: int, wire_batch: int) -> dict:
                     pass
 
 
+def _drive_herd(np, address: str, payloads, n_threads: int, seconds: float,
+                during=None) -> dict:
+    """Shared client-herd scaffold for the cluster A/B benches
+    (deadpeer, reshard): `n_threads` workers fire single-item raw
+    GetRateLimits RPCs at `address` for `seconds`, measuring
+    per-request latency.  `during` (optional callable) runs on a
+    helper thread once the herd is at full rate and is JOINED before
+    the herd stops — membership/failure events must never be cut
+    short mid-flight.  Returns {value, p50_ms, p99_ms, requests,
+    errors}."""
+    import grpc
+
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+    stop = threading.Event()
+    barrier = threading.Barrier(n_threads + 1)
+    counts = [0] * n_threads
+    errors = [0] * n_threads
+    lats: list = [None] * n_threads
+
+    def worker(tid: int) -> None:
+        mylat = []
+        ch = grpc.insecure_channel(address)
+        call = ch.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=lambda raw: raw,
+            response_deserializer=lambda raw: raw,
+        )
+        try:
+            call(payloads[0])
+        finally:
+            barrier.wait()
+        i = tid
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                raw = call(payloads[i % len(payloads)])
+                resp = pb.GetRateLimitsResp()
+                resp.ParseFromString(raw)
+                if any(r.error for r in resp.responses):
+                    errors[tid] += 1
+            except grpc.RpcError:
+                errors[tid] += 1
+            mylat.append(time.perf_counter() - t0)
+            counts[tid] += 1
+            i += n_threads
+        lats[tid] = mylat
+        ch.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), daemon=True)
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    helper = None
+    if during is not None:
+        helper = threading.Thread(target=during, daemon=True)
+        helper.start()
+    start = time.perf_counter()
+    time.sleep(seconds)
+    if helper is not None:
+        helper.join()
+    stop.set()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    all_lat = np.asarray([x for ml in lats if ml for x in ml])
+    return {
+        "value": round(sum(counts) / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1e3, 3)
+        if all_lat.size else None,
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1e3, 3)
+        if all_lat.size else None,
+        "requests": int(sum(counts)),
+        "errors": int(sum(errors)),
+    }
+
+
 def _run_deadpeer(np, platform: str) -> dict:
     """Dead-peer A/B (ISSUE 5 acceptance): the forward path's latency
     shape when an owner dies, healthy-cluster control first in the
@@ -1164,10 +1247,8 @@ def _run_deadpeer(np, platform: str) -> dict:
     The artifact embeds degraded/health counters so bench_trend.py
     can fold them."""
     from gubernator_tpu.cluster.harness import ClusterHarness, cluster_behaviors
-    from gubernator_tpu.net.grpc_service import V1_SERVICE
     from gubernator_tpu.net.pb import gubernator_pb2 as pb
 
-    import grpc
     from dataclasses import replace as dc_replace
 
     n_nodes = int(os.environ.get("BENCH_NODES", 4))
@@ -1200,64 +1281,9 @@ def _run_deadpeer(np, platform: str) -> dict:
         ]
 
         def measure(seconds: float):
-            stop = threading.Event()
-            barrier = threading.Barrier(n_threads + 1)
-            counts = [0] * n_threads
-            errors = [0] * n_threads
-            lats: list = [None] * n_threads
-
-            def worker(tid: int) -> None:
-                mylat = []
-                ch = grpc.insecure_channel(entry.grpc_address)
-                call = ch.unary_unary(
-                    f"/{V1_SERVICE}/GetRateLimits",
-                    request_serializer=lambda raw: raw,
-                    response_deserializer=lambda raw: raw,
-                )
-                try:
-                    call(payloads[0])
-                finally:
-                    barrier.wait()
-                i = tid
-                while not stop.is_set():
-                    t0 = time.perf_counter()
-                    try:
-                        raw = call(payloads[i % len(payloads)])
-                        resp = pb.GetRateLimitsResp()
-                        resp.ParseFromString(raw)
-                        if any(r.error for r in resp.responses):
-                            errors[tid] += 1
-                    except grpc.RpcError:
-                        errors[tid] += 1
-                    mylat.append(time.perf_counter() - t0)
-                    counts[tid] += 1
-                    i += n_threads
-                lats[tid] = mylat
-                ch.close()
-
-            threads = [
-                threading.Thread(target=worker, args=(t,), daemon=True)
-                for t in range(n_threads)
-            ]
-            for t in threads:
-                t.start()
-            barrier.wait()
-            start = time.perf_counter()
-            time.sleep(seconds)
-            stop.set()
-            for t in threads:
-                t.join()
-            elapsed = time.perf_counter() - start
-            all_lat = np.asarray([x for ml in lats if ml for x in ml])
-            return {
-                "value": round(sum(counts) / elapsed, 1),
-                "p50_ms": round(float(np.percentile(all_lat, 50)) * 1e3, 3)
-                if all_lat.size else None,
-                "p99_ms": round(float(np.percentile(all_lat, 99)) * 1e3, 3)
-                if all_lat.size else None,
-                "requests": int(sum(counts)),
-                "errors": int(sum(errors)),
-            }
+            return _drive_herd(
+                np, entry.grpc_address, payloads, n_threads, seconds
+            )
 
         healthy = measure(MEASURE_SECONDS)
         victim = n_nodes - 1  # never the entry node
@@ -1280,6 +1306,108 @@ def _run_deadpeer(np, platform: str) -> dict:
             "degraded_local": degraded,
             "healthy": healthy,
             "dead": dead,
+            "platform": platform,
+        }
+    finally:
+        h.stop()
+
+
+def _run_reshard(np, platform: str) -> dict:
+    """Elastic-membership A/B (ISSUE 7 acceptance): throughput/latency
+    while the cluster RESHARDS under load — a 5th node joins mid-run,
+    then an original owner drains out — vs a same-shape
+    static-membership control (BENCH_RESHARD_STATIC=1, committed as
+    the *_static artifact).
+
+    4 in-process daemons; a client herd drives single-item requests
+    with keys spread across all owners through node 0.  In reshard
+    mode an event thread fires `add_peer` at ~25% of the window and
+    `drain_peer` (a non-entry original) at ~60%; the artifact embeds
+    the drain stats, handoff row counters, epochs, and dual-window
+    seconds so scripts/bench_trend.py can fold them."""
+    from gubernator_tpu.cluster.harness import ClusterHarness
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    n_threads = int(os.environ.get("BENCH_RESHARD_THREADS", 8))
+    static = os.environ.get("BENCH_RESHARD_STATIC", "0") != "0"
+    h = ClusterHarness().start(n_nodes, cache_size=CAPACITY)
+    try:
+        entry = h.daemons[0]
+        # Keys vary a LEADING byte (FNV-1 trailing-byte collapse; see
+        # harness._verify_membership) so every owner gets a share and
+        # the reshard actually moves live traffic.
+        payloads = [
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="reshard", unique_key=f"{i}_rs", hits=1,
+                        limit=10**9, duration=3_600_000,
+                    )
+                ]
+            ).SerializeToString()
+            for i in range(256)
+        ]
+
+        events: dict = {}
+
+        def reshard_events() -> None:
+            # Join at ~25% of the window, drain an original owner at
+            # ~60% — both land while the herd is at full rate.
+            time.sleep(MEASURE_SECONDS * 0.25)
+            t0 = time.perf_counter()
+            h.add_peer()
+            h.wait_membership_settled(30)
+            events["join_settle_s"] = round(time.perf_counter() - t0, 3)
+            time.sleep(MEASURE_SECONDS * 0.35)
+            t0 = time.perf_counter()
+            victim = h.daemons[1]
+            events["drain"] = h.drain_peer(1)
+            h.wait_membership_settled(30)
+            events["drain_settle_s"] = round(time.perf_counter() - t0, 3)
+            # drain_peer popped the victim from h.daemons — snapshot
+            # its counters here or the summed totals silently drop
+            # the entire drain volume (and any drain forfeits).
+            events["drained_node"] = dict(victim.instance.handoff_counters)
+
+        result = _drive_herd(
+            np, entry.grpc_address, payloads, n_threads,
+            MEASURE_SECONDS, during=None if static else reshard_events,
+        )
+        value = result["value"]
+        drained = events.get("drained_node", {})
+        membership = {
+            "epochs": h.membership_epochs(),
+            "dual_seconds": round(
+                max(d.membership.dual_seconds() for d in h.daemons), 4
+            ),
+            "handoff": {
+                k: sum(
+                    d.instance.handoff_counters[k] for d in h.daemons
+                )
+                + drained.get(k, 0)
+                for k in ("shipped", "forfeited", "received")
+            },
+            **{k: v for k, v in events.items() if k != "drained_node"},
+        }
+        return {
+            "metric": "rate-limit decisions/sec, "
+            + (
+                f"static {n_nodes}-node control"
+                if static
+                else f"{n_nodes}-node cluster resharding mid-run "
+                "(join a 5th, drain an original owner)"
+            )
+            + f" ({n_threads} client threads, single-item RPCs via node 0)",
+            "value": value,
+            "unit": "decisions/sec",
+            "vs_baseline": round(value / BASELINE_DECISIONS_PER_SEC, 2),
+            "p50_ms": result["p50_ms"],
+            "p99_ms": result["p99_ms"],
+            "requests": result["requests"],
+            "errors": result["errors"],
+            "reshard": not static,
+            "membership": membership,
             "platform": platform,
         }
     finally:

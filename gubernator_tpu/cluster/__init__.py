@@ -8,12 +8,16 @@ within the mesh, ICI collectives) lives in `gubernator_tpu.parallel`.
 
 from gubernator_tpu.cluster.hash_ring import (
     DEFAULT_REPLICAS,
+    DualRingWindow,
     ReplicatedConsistentHash,
     RegionPicker,
 )
+from gubernator_tpu.cluster.membership import MembershipManager
 
 __all__ = [
     "DEFAULT_REPLICAS",
+    "DualRingWindow",
+    "MembershipManager",
     "ReplicatedConsistentHash",
     "RegionPicker",
 ]

@@ -1,0 +1,390 @@
+"""Ownership-transfer protocol: ship bucket rows to their new owner.
+
+The other half of elastic membership (cluster/membership.py): when an
+epoch transition moves a key range off this node — a peer joined and
+now owns it, or this node is draining out — the range's LIVE bucket
+state (packed-slot snapshot rows, full fidelity including the leaky
+32.32 fixed-point words) travels to the new owner in batched windows
+over a dedicated peer RPC (``PeersV1/TransferBuckets``), instead of
+being dropped on the floor the way a static-membership restart would.
+
+Protocol shape, sender side (one pass per epoch transition):
+
+1. **Barrier** — ``ledger.invalidate_keys`` on every moving key first:
+   live credit leases are revoked (native-plane leases pulled via the
+   dp_pull path) and their unused credit settles back synchronously,
+   so the device rows snapshotted next are sequential-exact.
+2. **Snapshot** — one ``engine.export_items()`` sweep, filtered to the
+   moving keys (expired rows are skipped; there is nothing to move).
+3. **Ship** — rows grouped by target owner, sent in windows of
+   ``GUBER_HANDOFF_WINDOW`` rows per RPC with explicit timeouts and a
+   capped-exponential/full-jitter backoff between retries.  The peer
+   health plane gates every send: a broken target delays the epoch
+   commit (the membership manager waits on the sender) until the
+   epoch deadline, after which the remaining rows are **forfeited** —
+   counted, and safe under the same N_partitions × limit
+   over-admission bound RESILIENCE.md proves for degraded answering
+   (the new owner simply starts those buckets fresh).
+
+Receiver side: rows restore through the engine's bulk-load scatter
+(the same path the persistence Loader uses), after invalidating any
+local ledger entries for those keys.  A restore OVERWRITES a bucket
+the receiver may have freshly created between cutover and row arrival
+— the hits admitted into that fresh bucket are forgotten, which is
+exactly the bounded over-admission the window's length controls (and
+strictly tighter than forfeiting the source's whole count).
+
+Dead source (kill mid-handoff, unplanned leave): nothing ships; every
+moved key is implicitly forfeited and the bound still holds — the old
+owner admitted ≤ limit before dying, the new owner admits ≤ limit
+fresh.  tests/test_membership.py pins both the zero-forfeit drain and
+the kill-during-handoff bound deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
+from gubernator_tpu.types import Algorithm
+
+log = logging.getLogger("gubernator_tpu.handoff")
+
+_TOKEN = int(Algorithm.TOKEN_BUCKET)
+_LEAKY = int(Algorithm.LEAKY_BUCKET)
+
+
+# ----------------------------------------------------------------------
+# Wire format: one JSON document per TransferBuckets RPC.  JSON (not a
+# new protobuf) because no grpc_python_plugin exists in this image
+# (net/grpc_service.py documents the constraint) and the handoff plane
+# is windows-of-hundreds-of-rows at membership-change rate, not the
+# per-decision hot path — schema clarity beats codec speed here.
+
+
+def encode_transfer(
+    epoch: int, src_addr: str, items: List[CacheItem], *, boot: str = ""
+) -> bytes:
+    """Serialize one window of bucket rows.
+
+    `boot` is the sender's per-process token: epochs are per-process
+    counters that restart at 1 on reboot, so the receiver's
+    stale-window guard compares epochs only within one (src, boot)
+    stream.
+
+    Row layouts (positional, by algorithm):
+      token: [key, 0, expire_at, invalid_at, status, limit, duration,
+              remaining, created_at]
+      leaky: [key, 1, expire_at, invalid_at, limit, duration, burst,
+              updated_at, remf_hi, remf_lo]
+    """
+    rows = []
+    for it in items:
+        v = it.value
+        if isinstance(v, TokenBucketItem):
+            rows.append(
+                [it.key, _TOKEN, it.expire_at, it.invalid_at, v.status,
+                 v.limit, v.duration, v.remaining, v.created_at]
+            )
+        elif isinstance(v, LeakyBucketItem):
+            hi, lo = v.remaining_words or (int(v.remaining), 0)
+            rows.append(
+                [it.key, _LEAKY, it.expire_at, it.invalid_at, v.limit,
+                 v.duration, v.burst, v.updated_at, hi, lo]
+            )
+    return json.dumps(
+        {"epoch": epoch, "src": src_addr, "boot": boot, "rows": rows},
+        separators=(",", ":"),
+    ).encode()
+
+
+def decode_transfer(raw: bytes) -> Tuple[int, str, str, List[CacheItem]]:
+    """Inverse of encode_transfer — (epoch, src, boot, items); raises
+    ValueError on malformed payloads (the RPC adapter maps that to
+    INVALID_ARGUMENT)."""
+    obj = json.loads(raw)
+    items: List[CacheItem] = []
+    for row in obj["rows"]:
+        key, algo, expire_at, invalid_at = row[0], row[1], row[2], row[3]
+        if algo == _TOKEN:
+            value = TokenBucketItem(
+                status=row[4], limit=row[5], duration=row[6],
+                remaining=row[7], created_at=row[8],
+            )
+        elif algo == _LEAKY:
+            hi, lo = row[8], row[9]
+            value = LeakyBucketItem(
+                limit=row[4], duration=row[5], burst=row[6],
+                updated_at=row[7],
+                remaining=float(hi) + float(lo) * 2.0**-32,
+                remaining_words=(hi, lo),
+            )
+        else:
+            raise ValueError(f"unknown algorithm {algo!r} in transfer row")
+        items.append(
+            CacheItem(
+                key=key, value=value, expire_at=expire_at,
+                algorithm=algo, invalid_at=invalid_at,
+            )
+        )
+    return (
+        int(obj["epoch"]), str(obj.get("src", "")),
+        str(obj.get("boot", "")), items,
+    )
+
+
+class ListLoader:
+    """Loader-protocol shim over an in-memory row list: the receiver
+    reuses the engine's bulk-restore scatter (engine.load) verbatim."""
+
+    def __init__(self, items: List[CacheItem]):
+        self.items = items
+
+    def load(self) -> Iterable[CacheItem]:
+        return self.items
+
+    def save(self, items) -> None:  # pragma: no cover - protocol stub
+        raise NotImplementedError("handoff loader is restore-only")
+
+
+# ----------------------------------------------------------------------
+# Receiver
+
+
+def receive_transfer(instance, raw: bytes) -> int:
+    """Restore one shipped window into the local engine; returns rows
+    applied.  Ledger entries for the keys are invalidated first (their
+    local view predates the authoritative shipped rows); expired rows
+    are dropped rather than interned just to be swept.
+
+    Stale-epoch guard: a window carrying an epoch LOWER than the last
+    one seen from the same (source, boot) stream is dropped — a
+    delayed/retried ship from a superseded transition must not
+    overwrite rows a newer transition already installed.  Epochs are
+    per-process counters that restart on reboot, so a changed boot
+    token resets the tracking (a restarted node's fresh stream is
+    never mistaken for staleness).  The check-then-update on the seen
+    map is unlocked: the benign race admits at worst one stale
+    window, the pre-guard behavior."""
+    epoch, src, boot, items = decode_transfer(raw)
+    if src:
+        seen = instance.handoff_epoch_seen
+        last = seen.get(src)
+        if last is not None and last[0] == boot and epoch < last[1]:
+            return 0
+        seen[src] = (boot, epoch)
+    now_ms = instance.engine.clock.now_ms()
+    live = [it for it in items if it.expire_at == 0 or it.expire_at > now_ms]
+    if not live:
+        return 0
+    if instance.ledger is not None:
+        instance.ledger.invalidate_keys([it.key.encode() for it in live])
+    instance.engine.load(ListLoader(live))
+    instance.handoff_counters["received"] += len(live)
+    return len(live)
+
+
+# ----------------------------------------------------------------------
+# Sender
+
+
+class HandoffSender:
+    """Ship a set of bucket rows to their new owners, window by window.
+
+    One sender per epoch transition (or per drain).  `targets` maps
+    owner address → (PeerClient, rows).  Rows that cannot be delivered
+    before `deadline` — circuit stays open, RPCs keep failing — are
+    forfeited and counted; everything else ships with explicit
+    per-RPC timeouts and backoff between retries, so one broken
+    target can delay (never wedge) the epoch commit.
+    """
+
+    def __init__(
+        self,
+        *,
+        epoch: int,
+        src_addr: str,
+        src_boot: str = "",
+        window: int,
+        rpc_timeout: float,
+        backoff: float,
+        backoff_cap: float,
+        counters: Dict[str, int],
+        on_window: Optional[Callable[[str, int], None]] = None,
+        stop: Optional[threading.Event] = None,
+    ):
+        self.epoch = epoch
+        self.src_addr = src_addr
+        self.src_boot = src_boot
+        self.window = max(1, window)
+        self.rpc_timeout = rpc_timeout
+        self.backoff = backoff
+        self.backoff_cap = backoff_cap
+        # Shared with the owning V1Instance: {"shipped","forfeited",...}.
+        self.counters = counters
+        # Test hook: called after every delivered window (the seeded
+        # kill-during-handoff chaos test injects its fault here, so
+        # "mid-handoff" is a deterministic point, not a sleep race).
+        self.on_window = on_window
+        # Shutdown signal (the membership manager's): a daemon closing
+        # mid-handoff must not keep retrying toward a long epoch
+        # deadline — the remaining rows forfeit immediately (they are
+        # lost either way; the count stays truthful).
+        self.stop = stop
+
+    def ship(
+        self,
+        targets: Dict[str, Tuple[object, List[CacheItem]]],
+        deadline: float,
+    ) -> Dict[str, int]:
+        """Deliver every target's rows; returns
+        {"shipped": n, "forfeited": n}.  Blocking — the membership
+        manager runs it on its transition thread, drain runs it
+        inline."""
+        from gubernator_tpu.cluster.health import backoff_delay
+        from gubernator_tpu.cluster.peer_client import PeerError
+
+        shipped = 0
+        forfeited = 0
+        pending = {
+            addr: (peer, list(rows))
+            for addr, (peer, rows) in targets.items()
+            if rows
+        }
+        attempt = 0
+        while pending:
+            if self.stop is not None and self.stop.is_set():
+                # Daemon closing: the tail cannot ship and is lost —
+                # forfeit it now instead of retrying into teardown.
+                for addr, (_peer, rows) in pending.items():
+                    forfeited += len(rows)
+                    log.warning(
+                        "handoff to %s forfeited %d rows at shutdown",
+                        addr, len(rows),
+                    )
+                pending.clear()
+                break
+            made_progress = False
+            for addr in list(pending):
+                peer, rows = pending[addr]
+                window, rest = rows[: self.window], rows[self.window:]
+                payload = encode_transfer(
+                    self.epoch, self.src_addr, window, boot=self.src_boot
+                )
+                try:
+                    peer.transfer_buckets_raw(
+                        payload, timeout=self.rpc_timeout
+                    )
+                except PeerError as e:
+                    if time.monotonic() >= deadline:
+                        # Epoch deadline: forfeit this target's tail.
+                        # The new owner starts these buckets fresh —
+                        # bounded over-admission, RESILIENCE.md §10.
+                        forfeited += len(rows)
+                        del pending[addr]
+                        log.warning(
+                            "handoff to %s forfeited %d rows past the "
+                            "epoch deadline: %s", addr, len(rows), e,
+                        )
+                        continue
+                    # Broken/unreachable target: the retry below backs
+                    # off; a circuit-open refusal costs one dict probe
+                    # so waiting out the window is cheap.
+                    continue
+                shipped += len(window)
+                made_progress = True
+                if rest:
+                    pending[addr] = (peer, rest)
+                else:
+                    del pending[addr]
+                if self.on_window is not None:
+                    self.on_window(addr, len(window))
+            if pending and not made_progress:
+                if time.monotonic() >= deadline:
+                    for addr, (_peer, rows) in pending.items():
+                        forfeited += len(rows)
+                        log.warning(
+                            "handoff to %s forfeited %d rows at the "
+                            "epoch deadline", addr, len(rows),
+                        )
+                    pending.clear()
+                    break
+                delay = min(
+                    backoff_delay(attempt, self.backoff, self.backoff_cap),
+                    max(0.0, deadline - time.monotonic()),
+                )
+                attempt += 1
+                if self.stop is not None:
+                    # Interruptible backoff: shutdown cuts the wait.
+                    self.stop.wait(delay)
+                else:
+                    time.sleep(delay)
+            else:
+                attempt = 0
+        self.counters["shipped"] += shipped
+        self.counters["forfeited"] += forfeited
+        return {"shipped": shipped, "forfeited": forfeited}
+
+
+def snapshot_moved_rows(
+    instance,
+    owners_of: Callable[[List[str]], List[Optional[object]]],
+    was_mine: Optional[Callable[[List[str]], List[bool]]] = None,
+) -> Dict[str, Tuple[object, List[CacheItem]]]:
+    """Snapshot every held bucket MOVING off this node: its owner
+    under the NEW view is another node AND this node was its
+    authoritative owner before the change.
+
+    `owners_of(keys)` maps hash keys → owning PeerClient under the NEW
+    view (None = unroutable, kept local; an is_owner client = us).
+    `was_mine(keys)` maps hash keys → whether this node owned them
+    under the OLD view — REQUIRED for correctness whenever the engine
+    can hold non-authoritative local copies (degraded answers, GLOBAL
+    miss-local copies): without it, a membership event anywhere in
+    the cluster would ship those stale copies onto their healthy
+    owners' authoritative state.  None means "everything held is
+    mine" (bare-engine callers/tests).
+    Returns HandoffSender-shaped targets: {addr: (client, rows)}.
+
+    Two passes over the engine snapshot: the first finds the moving
+    keys so their ledger state can be settled back to the device
+    (lease credit revoked via invalidate_keys — the dp_pull path for
+    native-plane leases), the second re-reads the now-sequential rows
+    that actually ship.
+    """
+    now_ms = instance.engine.clock.now_ms()
+
+    def _moving() -> Dict[str, object]:
+        keys: List[str] = []
+        for it in instance.engine.export_items():
+            if it.expire_at and it.expire_at <= now_ms:
+                continue
+            keys.append(it.key)
+        owners = owners_of(keys)
+        mine = was_mine(keys) if was_mine is not None else [True] * len(keys)
+        return {
+            k: client
+            for k, client, m in zip(keys, owners, mine)
+            if m and client is not None and not client.info.is_owner
+        }
+
+    moving = _moving()
+    if not moving:
+        return {}
+    if instance.ledger is not None:
+        instance.ledger.invalidate_keys([k.encode() for k in moving])
+    out: Dict[str, Tuple[object, List[CacheItem]]] = {}
+    for it in instance.engine.export_items():
+        client = moving.get(it.key)
+        if client is None:
+            continue
+        if it.expire_at and it.expire_at <= now_ms:
+            continue
+        out.setdefault(
+            client.info.grpc_address, (client, [])
+        )[1].append(it)
+    return out

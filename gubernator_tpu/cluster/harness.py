@@ -105,6 +105,11 @@ class ClusterHarness:
             data_center=datacenter,
             peer_discovery_type="none",
             device_count=1,  # one engine per in-process daemon
+            # Membership plane on a test timescale: epoch transitions
+            # and drains must settle (or forfeit) in seconds, not the
+            # production 30s budgets.
+            membership_epoch_timeout=3.0,
+            drain_deadline=5.0,
         )
         return spawn_daemon(conf, clock=self._clock)
 
@@ -208,6 +213,67 @@ class ClusterHarness:
             if dc == "" and d.peer_info().grpc_address != owner_addr:
                 return d
         raise AssertionError("cluster too small for a non-owner")
+
+    # -- elastic membership (cluster/membership.py; reshard chaos) -----
+
+    def add_peer(self, datacenter: str = "") -> Daemon:
+        """JOIN under live traffic: spawn a new daemon and push the
+        grown peer list to every node.  Each existing node's
+        membership manager opens a dual-ring window and ships the
+        buckets the newcomer now owns (cluster/handoff.py); call
+        wait_membership_settled() to barrier on the cutover."""
+        d = self._spawn(datacenter)
+        self.daemons.append(d)
+        self._datacenters.append(datacenter)
+        self._push_peers()
+        return d
+
+    def remove_peer(self, idx: int) -> Daemon:
+        """Unplanned LEAVE: kill the daemon AND remove it from every
+        peer list (unlike kill(), which leaves the corpse in the
+        ring).  Its buckets are implicitly forfeited — survivors own
+        them fresh, within the N_partitions × limit bound."""
+        d = self.daemons.pop(idx)
+        self._datacenters.pop(idx)
+        d.close()
+        self._push_peers()
+        return d
+
+    def drain_peer(self, idx: int, deadline: float | None = None) -> dict:
+        """Planned leave with handoff: the node ships every held
+        bucket to its new owners, then leaves the ring and shuts
+        down.  Returns the drain stats ({"shipped", "forfeited",
+        "targets"}); a clean drain reports forfeited == 0."""
+        d = self.daemons[idx]
+        stats = d.drain(deadline)
+        self.daemons.pop(idx)
+        self._datacenters.pop(idx)
+        self._push_peers()
+        d.close()
+        return stats
+
+    def wait_membership_settled(self, timeout: float = 10.0) -> bool:
+        """Barrier: every daemon's current epoch transition committed
+        (phase back to `stable`)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        for d in self.daemons:
+            if d.membership is None:
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not d.membership.wait_settled(remaining):
+                return False
+        return True
+
+    def membership_epochs(self) -> dict:
+        """{addr: epoch} across the cluster — the reshard suite's
+        convergence oracle."""
+        return {
+            d.peer_info().grpc_address: d.membership.epoch()
+            for d in self.daemons
+            if d.membership is not None
+        }
 
     # -- fault injection (cluster/faults.py; chaos tests) --------------
 

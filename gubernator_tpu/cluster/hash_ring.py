@@ -224,6 +224,79 @@ def make_picker(
     )
 
 
+class RingMember:
+    """Address-only ring member: a PeerInfo with no client attached.
+    Membership planning (cluster/membership.py) builds throwaway rings
+    over candidate views before any PeerClient exists for them."""
+
+    __slots__ = ("info",)
+
+    def __init__(self, info: PeerInfo):
+        self.info = info
+
+
+def address_ring(
+    infos: Sequence[PeerInfo],
+    hash_name: str = "fnv1",
+    picker: str = "replicated-hash",
+    replicas: int = DEFAULT_REPLICAS,
+) -> "ReplicatedConsistentHash[RingMember]":
+    """A routing ring over bare PeerInfos (no clients, no daemon) —
+    the membership plane's way of asking "who WOULD own key k under
+    view V" without mutating any serving state."""
+    ring = make_picker(picker, hash_name, replicas)
+    ring.add_all([RingMember(i) for i in infos])
+    return ring
+
+
+class DualRingWindow:
+    """Old + new rings valid simultaneously during a membership
+    cutover (the DualMap-style routing window, PAPERS.md).
+
+    While an epoch transition is in flight, requests ROUTE to the new
+    ring's owner, but the old ring's owner remains an ACCEPTABLE
+    destination.  In this codebase the acceptance half is realized by
+    the peer-serving contract itself — `get_peer_rate_limits`
+    receivers answer authoritatively and never re-forward, so
+    in-flight forwards and hit pushes keyed to the old owner cannot
+    404 — which makes this object the window's *verification and
+    introspection* surface rather than a serving-path gate: the
+    membership manager exposes it (`dual_window()`) while a cutover
+    is open, and tests/test_hash_ring.py pins its invariant — every
+    key lands on its old or new owner, never a third node."""
+
+    __slots__ = ("old", "new")
+
+    def __init__(
+        self,
+        old: "ReplicatedConsistentHash",
+        new: "ReplicatedConsistentHash",
+    ):
+        self.old = old
+        self.new = new
+
+    def owner(self, key: str) -> str:
+        """Routing decision: the NEW ring's owner address (traffic
+        converges toward the post-cutover topology)."""
+        return self.new.get(key).info.grpc_address
+
+    def owners(self, key: str):
+        """(old_owner_addr, new_owner_addr) for one key."""
+        return (
+            self.old.get(key).info.grpc_address,
+            self.new.get(key).info.grpc_address,
+        )
+
+    def acceptable(self, key: str, addr: str) -> bool:
+        """True when `addr` may serve `key` during the window (it is
+        the key's owner in the old OR the new ring)."""
+        return addr in self.owners(key)
+
+    def moved(self, key: str) -> bool:
+        old_addr, new_addr = self.owners(key)
+        return old_addr != new_addr
+
+
 class RegionPicker(Generic[T]):
     """One consistent-hash ring per datacenter.
 

@@ -1,0 +1,412 @@
+"""Elastic membership: epoch-numbered views with live resharding.
+
+Ownership used to be effectively static: the consistent-hash ring only
+ever re-picked on failure, so scaling out meant a restart and a node
+leaving dropped every bucket it owned.  This module makes membership a
+first-class, *live* plane (ROADMAP open item 3):
+
+  STABLE ──view changed──▶ DUAL ──handoff done / epoch deadline──▶ STABLE
+                            │
+                            └── old + new rings BOTH valid
+                                (hash_ring.DualRingWindow)
+
+Every daemon runs one ``MembershipManager``.  Peer-list pushes — etcd
+watch events through discovery/, harness pushes in tests, static
+config at boot — all land in ``apply_view``:
+
+* An unchanged view (same addresses + datacenters) is a no-op: the
+  discovery planes re-push on every watch event and re-registration,
+  and none of that may open spurious dual windows.
+* A changed view bumps the local **epoch**, snapshots the old ring,
+  enters the DUAL phase, and starts a handoff transition on a
+  background thread: every held bucket whose NEW owner is another
+  node ships there (cluster/handoff.py), then the epoch commits.
+  Epochs are per-node counters that agree across the cluster exactly
+  when every node observes the same sequence of views — which is what
+  one etcd prefix (or one harness) delivers.
+
+During DUAL, routing follows the NEW ring (traffic converges toward
+the post-cutover topology) while the OLD ring's owners remain
+acceptable destinations, so in-flight forwards and hit pushes keyed
+pre-cutover never 404 (acceptance is inherent in the peer-serving
+contract — receivers answer authoritatively, never re-forward — and
+the DualRingWindow object pins/introspects the invariant).  The peer health plane gates the commit: a
+suspect/broken handoff target delays it (the sender keeps backing off
+and retrying) until ``GUBER_MEMBERSHIP_EPOCH_TIMEOUT``, at which point
+the undeliverable rows are forfeited — counted, and bounded by the
+same N_partitions × limit over-admission argument RESILIENCE.md §10
+derives.
+
+``drain`` is planned-leave-with-handoff: the node ships **all** held
+buckets to their owners under the ring-without-self, bounded by
+``GUBER_DRAIN_DEADLINE``, and reports ``forfeited == 0`` on a clean
+exit — the zero-downtime-deploy primitive.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gubernator_tpu.cluster.handoff import HandoffSender, snapshot_moved_rows
+from gubernator_tpu.cluster.hash_ring import DualRingWindow, address_ring
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.membership")
+
+STABLE = "stable"
+DUAL = "dual"
+
+
+def _view_key(peers: Sequence[PeerInfo]) -> frozenset:
+    return frozenset((p.grpc_address, p.datacenter) for p in peers)
+
+
+class MembershipManager:
+    """Per-daemon epoch state machine + handoff driver.
+
+    Thread-safe: ``apply_view`` may be called from discovery watch
+    threads, the harness, and tests concurrently; transitions are
+    serialized (a new view joins the previous transition thread
+    before starting its own, so at most one handoff ships at a time
+    and epochs commit in order).
+    """
+
+    # guberlint: guard _epoch, _phase, _view, _infos, _dual_since, _dual_window, _active_transition, dual_window_seconds by _lock
+
+    def __init__(
+        self,
+        daemon,
+        *,
+        epoch_timeout: float = 30.0,
+        handoff_window: int = 512,
+        drain_deadline: float = 30.0,
+    ):
+        self._daemon = daemon
+        self.epoch_timeout = epoch_timeout
+        self.handoff_window = max(1, handoff_window)
+        self.drain_deadline = drain_deadline
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._phase = STABLE
+        self._view: Optional[frozenset] = None
+        self._infos: List[PeerInfo] = []
+        self._dual_since = 0.0
+        self._dual_window: Optional[DualRingWindow] = None
+        # Cumulative seconds spent in DUAL windows — exported as
+        # gubernator_ring_dual_window_seconds (a closed window's span
+        # plus the open window's age at scrape time).
+        self.dual_window_seconds = 0.0
+        self._shipper: Optional[threading.Thread] = None
+        # Token of the transition that owns the next commit (the epoch
+        # it was spawned at).  A superseding transition re-points it;
+        # an epoch bump WITHOUT a new transition (cross-dc delta, no
+        # local reshard) leaves it alone so the in-flight transition
+        # still commits.
+        self._active_transition = 0
+        self._settled = threading.Event()
+        self._settled.set()
+        # Shutdown signal for in-flight handoff senders: close() sets
+        # it so a ship retrying toward a long epoch deadline forfeits
+        # its tail and exits instead of outliving the daemon.
+        self._stop = threading.Event()
+        self._closed = False
+        # Per-process token carried on every transfer: receivers scope
+        # their stale-epoch guard to one (src, boot) stream, so a
+        # restarted node (epoch counter back at 1) is never mistaken
+        # for a stale sender (cluster/handoff.py).
+        import uuid
+
+        self.boot_id = uuid.uuid4().hex[:12]
+        # Test hook forwarded to HandoffSender.on_window (the seeded
+        # kill-during-handoff chaos test injects its fault there).
+        self.handoff_hook = None
+
+    # -- view ingestion ------------------------------------------------
+
+    def apply_view(self, peers: Sequence[PeerInfo]) -> bool:
+        """Observe a (possibly unchanged) full peer list.  Returns
+        True when the view changed and an epoch transition started."""
+        key = _view_key(peers)
+        with self._lock:
+            if self._closed or key == self._view:
+                return False
+            first = self._view is None
+            old_infos = self._infos
+            self._view = key
+            self._infos = [
+                PeerInfo(
+                    grpc_address=p.grpc_address,
+                    http_address=p.http_address,
+                    datacenter=p.datacenter,
+                    is_owner=p.is_owner,
+                )
+                for p in peers
+            ]
+            self._epoch += 1
+            if first:
+                # Boot view: nothing held yet, nothing to hand off.
+                return False
+            conf = self._daemon.conf
+            dc = conf.data_center
+            old_local = [i for i in old_infos if i.datacenter == dc]
+            new_local = [i for i in self._infos if i.datacenter == dc]
+            if {i.grpc_address for i in old_local} == {
+                i.grpc_address for i in new_local
+            }:
+                # The delta is entirely in another datacenter: the
+                # local-dc ring is unchanged, nothing reshards here.
+                # The epoch still bumps (the VIEW changed) but no
+                # dual window opens and — crucially — no transition
+                # thread runs a full engine snapshot to discover
+                # nothing moved.
+                return True
+            window = None
+            if old_local and new_local:
+                window = DualRingWindow(
+                    address_ring(
+                        old_local, conf.hash_algorithm,
+                        conf.peer_picker, conf.picker_replicas,
+                    ),
+                    address_ring(
+                        new_local, conf.hash_algorithm,
+                        conf.peer_picker, conf.picker_replicas,
+                    ),
+                )
+            self._dual_window = window
+            if self._phase == DUAL:
+                # Superseding an open window: bank its elapsed time
+                # before re-stamping, or the cumulative counter loses
+                # the superseded span.
+                self.dual_window_seconds += (
+                    time.monotonic() - self._dual_since
+                )
+            self._phase = DUAL
+            self._dual_since = time.monotonic()
+            self._settled.clear()
+            epoch = self._epoch
+            self._active_transition = epoch
+            prev = self._shipper
+            self._shipper = threading.Thread(
+                target=self._transition,
+                args=(epoch, prev, window),
+                name=f"guber-membership-{epoch}",
+                daemon=True,
+            )
+            shipper = self._shipper
+        shipper.start()
+        return True
+
+    def _transition(
+        self,
+        epoch: int,
+        prev: Optional[threading.Thread],
+        window: Optional[DualRingWindow],
+    ) -> None:
+        """One epoch transition: ship moved rows, then commit.
+
+        The OLD ring (window.old) gates the ship set: only keys this
+        node was the authoritative owner of before the change may
+        travel.  The engine also holds non-authoritative local copies
+        (degraded answers, GLOBAL miss-local copies) for keys owned
+        elsewhere — shipping those would overwrite healthy owners'
+        authoritative buckets on every unrelated membership event."""
+        if prev is not None:
+            prev.join()
+        try:
+            instance = self._daemon.instance
+            if (
+                instance is not None
+                and window is not None
+                and not self._stop.is_set()
+            ):
+                me = self._daemon.peer_info().grpc_address
+
+                def was_mine(keys):
+                    return [
+                        m.info.grpc_address == me
+                        for m in window.old.get_batch(keys)
+                    ]
+
+                targets = snapshot_moved_rows(
+                    instance, instance.get_peer_batch, was_mine
+                )
+                if targets:
+                    sender = self._sender(epoch, instance)
+                    deadline = time.monotonic() + self.epoch_timeout
+                    stats = sender.ship(targets, deadline)
+                    log.info(
+                        "epoch %d handoff: shipped %d forfeited %d "
+                        "across %d targets", epoch, stats["shipped"],
+                        stats["forfeited"], len(targets),
+                    )
+        except Exception:  # noqa: BLE001 — the commit must happen
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("membership.transition")
+            log.exception("epoch %d handoff failed", epoch)
+        finally:
+            self._commit(epoch)
+
+    def _sender(self, epoch: int, instance) -> HandoffSender:
+        b = self._daemon.conf.behaviors
+        return HandoffSender(
+            epoch=epoch,
+            src_addr=self._daemon.peer_info().grpc_address,
+            src_boot=self.boot_id,
+            window=self.handoff_window,
+            rpc_timeout=b.batch_timeout,
+            backoff=b.forward_backoff,
+            backoff_cap=b.forward_backoff_cap,
+            counters=instance.handoff_counters,
+            on_window=self.handoff_hook,
+            stop=self._stop,
+        )
+
+    def _commit(self, epoch: int) -> None:
+        with self._lock:
+            if epoch != self._active_transition:
+                # A newer transition superseded us mid-ship; its
+                # thread owns the commit (it joined us first).
+                return
+            if self._phase == DUAL:
+                self.dual_window_seconds += (
+                    time.monotonic() - self._dual_since
+                )
+            self._phase = STABLE
+            self._dual_window = None
+            self._settled.set()
+
+    # -- drain (planned leave) -----------------------------------------
+
+    def drain(self, deadline: Optional[float] = None) -> Dict[str, int]:
+        """Ship EVERY held bucket to its owner under the
+        ring-without-self, bounded by `deadline` seconds (default
+        GUBER_DRAIN_DEADLINE).  Returns {"shipped", "forfeited",
+        "targets"}; forfeited == 0 is the clean-exit contract.  The
+        caller removes this node from the cluster afterwards (etcd
+        deregister / harness peer push) — state first, then topology,
+        so the watchers' cutover finds the rows already in place."""
+        instance = self._daemon.instance
+        if instance is None:
+            return {"shipped": 0, "forfeited": 0, "targets": 0}
+        # Settle any in-flight transition first: a drain racing a
+        # join's handoff would double-ship rows.  A transition commits
+        # no later than its own epoch deadline, so a small margin past
+        # epoch_timeout suffices; if it STILL hasn't settled something
+        # is wedged — proceed (the node is leaving either way; a
+        # double-shipped row restores to the same state) but say so.
+        if not self.wait_settled(self.epoch_timeout + 1.0):
+            log.warning(
+                "drain proceeding while epoch %d transition is still "
+                "unsettled", self.epoch(),
+            )
+        conf = self._daemon.conf
+        peers = instance.get_peer_list()
+        others = {
+            p.info.grpc_address: p for p in peers if not p.info.is_owner
+        }
+        if not others:
+            # No target to ship to: every live held row this node OWNS
+            # is lost when it exits.  Reporting that as forfeited == 0
+            # would read as "clean drain, state travelled" — count the
+            # loss honestly instead.
+            now_ms = instance.engine.clock.now_ms()
+            lost = 0
+            for it in instance.engine.export_items():
+                if it.expire_at and it.expire_at <= now_ms:
+                    continue
+                lost += 1
+            instance.handoff_counters["forfeited"] += lost
+            return {"shipped": 0, "forfeited": lost, "targets": 0}
+        ring = address_ring(
+            [p.info for p in others.values()],
+            conf.hash_algorithm, conf.peer_picker, conf.picker_replicas,
+        )
+
+        def owners_of(keys: List[str]):
+            return [others.get(m.info.grpc_address) for m in ring.get_batch(keys)]
+
+        def was_mine(keys: List[str]):
+            # Only rows this node is the AUTHORITATIVE owner of (the
+            # current ring, self still in it) may ship: the engine
+            # also holds non-authoritative local copies of peer-owned
+            # keys (degraded answers, GLOBAL miss-local copies), and
+            # their owners hold newer state.
+            owners = instance.get_peer_batch(keys)
+            return [o is not None and o.info.is_owner for o in owners]
+
+        targets = snapshot_moved_rows(instance, owners_of, was_mine)
+        with self._lock:
+            epoch = self._epoch
+        sender = self._sender(epoch, instance)
+        budget = self.drain_deadline if deadline is None else deadline
+        stats = sender.ship(targets, time.monotonic() + budget)
+        stats["targets"] = len(targets)
+        return stats
+
+    # -- introspection -------------------------------------------------
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def phase(self) -> str:
+        with self._lock:
+            return self._phase
+
+    def dual_window(self) -> Optional[DualRingWindow]:
+        with self._lock:
+            return self._dual_window
+
+    def dual_seconds(self) -> float:
+        """Cumulative DUAL time, including the open window's age."""
+        with self._lock:
+            total = self.dual_window_seconds
+            if self._phase == DUAL:
+                total += time.monotonic() - self._dual_since
+            return total
+
+    def stats(self) -> Dict[str, object]:
+        """Operator/bench view (Daemon.membership_stats) — the same
+        numbers /metrics exports as gubernator_membership_epoch,
+        gubernator_handoff_keys and
+        gubernator_ring_dual_window_seconds."""
+        instance = self._daemon.instance
+        with self._lock:
+            out: Dict[str, object] = {
+                "epoch": self._epoch,
+                "phase": self._phase,
+                "peers": len(self._infos),
+                "dual_window_seconds": round(
+                    self.dual_window_seconds
+                    + (
+                        time.monotonic() - self._dual_since
+                        if self._phase == DUAL
+                        else 0.0
+                    ),
+                    4,
+                ),
+            }
+        out["handoff"] = (
+            dict(instance.handoff_counters) if instance is not None else {}
+        )
+        return out
+
+    def wait_settled(self, timeout: float = 10.0) -> bool:
+        """Block until the current epoch transition committed (True)
+        or `timeout` elapsed (False).  Tests and drain use it as the
+        convergence barrier."""
+        return self._settled.wait(timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        # Wake any in-flight sender out of its backoff/retry loop —
+        # it forfeits its tail and exits, so the join below is bounded
+        # by one RPC timeout, not the epoch deadline.
+        self._stop.set()
+        if self._shipper is not None:
+            self._shipper.join(timeout=5.0)

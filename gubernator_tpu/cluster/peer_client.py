@@ -137,6 +137,7 @@ class PeerClient:
         self._stub: Optional[PeersV1Stub] = None
         self._raw_get_peer = None
         self._raw_update_globals = None
+        self._raw_transfer = None
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
         self._queue_cv = threading.Condition(self._lock)
@@ -170,6 +171,11 @@ class PeerClient:
                 )
                 self._raw_update_globals = self._channel.unary_unary(
                     f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                self._raw_transfer = self._channel.unary_unary(
+                    f"/{PEERS_SERVICE}/TransferBuckets",
                     request_serializer=lambda raw: raw,
                     response_deserializer=lambda raw: raw,
                 )
@@ -392,6 +398,36 @@ class PeerClient:
             self.health.record_success()
         except grpc.RpcError as e:
             err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            self._observe_rpc_error(e)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
+    def transfer_buckets_raw(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> None:
+        """Ship one window of bucket-state rows to this peer — the
+        ownership-transfer protocol (cluster/handoff.py encodes the
+        payload; the receiver restores through the engine's bulk-load
+        scatter).  Membership-change-rate traffic, never the decision
+        hot path."""
+        self._gate()
+        self._connect()
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            raw = self._raw_transfer
+            self._inflight += 1
+        try:
+            raw(payload, timeout=timeout or self.behaviors.batch_timeout)
+            self.health.record_success()
+        except grpc.RpcError as e:
+            err = f"TransferBuckets to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
             self._observe_rpc_error(e)
             raise PeerError(

@@ -339,6 +339,20 @@ class DaemonConfig:
     # per-connection C threads, so lanes are what lets the front scale
     # across cores instead of serializing on one listener.
     h2_lanes: int = 0
+    # ---- elastic membership (cluster/membership.py; RESILIENCE §10) -
+    # Wall budget for one epoch transition, seconds: a handoff that
+    # cannot deliver (target broken/suspect) delays the epoch commit
+    # up to this long, then forfeits the undeliverable rows
+    # (GUBER_MEMBERSHIP_EPOCH_TIMEOUT).
+    membership_epoch_timeout: float = 30.0
+    # Bucket rows per TransferBuckets RPC during ownership handoff
+    # (GUBER_HANDOFF_WINDOW).
+    handoff_window: int = 512
+    # Wall budget for a planned-leave drain to ship every held bucket,
+    # seconds (GUBER_DRAIN_DEADLINE).  A clean drain reports zero
+    # forfeited rows well inside it.
+    drain_deadline: float = 30.0
+
     # Native decision plane (GUBER_NATIVE_LEDGER, default on): delegate
     # the ledger's exact fast path (sticky over-limit + lease drains)
     # into the C front so hot-key RPCs never enter Python.  Only
@@ -503,6 +517,11 @@ def setup_daemon_config(
         global_serve_window=_env_float_seconds(
             d, "GUBER_GLOBAL_SERVE_WINDOW", 0.002
         ),
+        membership_epoch_timeout=_env_float_seconds(
+            d, "GUBER_MEMBERSHIP_EPOCH_TIMEOUT", 30.0
+        ),
+        handoff_window=_env_int(d, "GUBER_HANDOFF_WINDOW", 512),
+        drain_deadline=_env_float_seconds(d, "GUBER_DRAIN_DEADLINE", 30.0),
         h2_fast_address=_env(d, "GUBER_H2_FAST_ADDRESS", ""),
         h2_fast_window=_env_float_seconds(d, "GUBER_H2_FAST_WINDOW", 0.002),
         h2_lanes=_env_int(d, "GUBER_H2_LANES", 0),

@@ -63,6 +63,7 @@ class Daemon:
         self.http_address = conf.http_listen_address
         self._tls_bundle = None
         self._discovery = None
+        self.membership = None
         self._closed = False
 
     # ------------------------------------------------------------------
@@ -187,6 +188,19 @@ class Daemon:
             ledger_settle_interval=conf.ledger_settle_interval,
         )
         self.instance = V1Instance(service_conf, engine)
+        # Elastic membership plane (cluster/membership.py): every peer
+        # list this daemon observes — discovery pushes, static config,
+        # harness — flows through set_peers into the manager, which
+        # drives epoch transitions and ownership handoff.
+        from gubernator_tpu.cluster.membership import MembershipManager
+
+        self.membership = MembershipManager(
+            self,
+            epoch_timeout=conf.membership_epoch_timeout,
+            handoff_window=conf.handoff_window,
+            drain_deadline=conf.drain_deadline,
+        )
+        self.instance.membership = self.membership
         self.registry = build_registry(
             self.instance, metric_flags=conf.metric_flags
         )
@@ -393,6 +407,12 @@ class Daemon:
             marked.append(me)
         assert self.instance is not None
         self.instance.set_peers(marked)
+        # New routing is live; now let the membership plane observe
+        # the view — on a real change it bumps the epoch, opens the
+        # dual-ring window, and ships moved buckets to their new
+        # owners in the background (cluster/membership.py).
+        if self.membership is not None:
+            self.membership.apply_view(marked)
 
     # ------------------------------------------------------------------
 
@@ -438,6 +458,28 @@ class Daemon:
             }
         return out
 
+    def membership_stats(self) -> dict:
+        """This node's membership-plane view: epoch, phase
+        (stable|dual), cumulative dual-window seconds, and handoff
+        row counters — the same numbers /metrics exports as
+        gubernator_membership_epoch / gubernator_handoff_keys /
+        gubernator_ring_dual_window_seconds (bench artifacts embed
+        it, like peer_health())."""
+        if self.membership is None:
+            return {}
+        return self.membership.stats()
+
+    def drain(self, deadline: Optional[float] = None) -> dict:
+        """Planned leave: ship EVERY held bucket to its owner under
+        the ring-without-self (cluster/membership.py), bounded by
+        `deadline` seconds (default GUBER_DRAIN_DEADLINE).  Returns
+        {"shipped", "forfeited", "targets"}; the caller then removes
+        this node from the cluster (deregister / peer push) and calls
+        close() — state first, then topology."""
+        if self.membership is None:
+            return {"shipped": 0, "forfeited": 0, "targets": 0}
+        return self.membership.drain(deadline)
+
     def stage_budget(self) -> dict:
         """The measured GLOBAL-path p50 budget on this node: per-stage
         {count, mean_ms, max_ms} for the five pipeline stages (client
@@ -467,6 +509,10 @@ class Daemon:
             self._sweeper.join(timeout=5.0)
         if self._discovery is not None:
             self._discovery.close()
+        if self.membership is not None:
+            # Join any in-flight epoch transition before tearing the
+            # engine down under its snapshot/ship pass.
+            self.membership.close()
         if getattr(self, "h2_fast", None) is not None:
             self.h2_fast.close()
         if self.gateway is not None:

@@ -159,8 +159,23 @@ class EtcdPool(DiscoveryBase):
                 )
             except (ValueError, KeyError):
                 continue
+        # Watch events fire for every keepalive refresh and value
+        # rewrite, not just membership changes; only a CHANGED view may
+        # reach set_peers — each push rebuilds the consistent-hash
+        # rings, and the membership plane treats a changed view as an
+        # epoch transition (cluster/membership.py double-checks, but
+        # the rebuild cost is saved here).  http_address participates:
+        # a node re-registering with a new gateway port must propagate
+        # even though its ring identity (grpc, dc) is unchanged.
+        changed = {
+            (a, p.datacenter, p.http_address) for a, p in peers.items()
+        } != {
+            (a, p.datacenter, p.http_address)
+            for a, p in self._peers.items()
+        }
         self._peers = peers
-        self.on_update(list(peers.values()))
+        if changed:
+            self.on_update(list(peers.values()))
 
     def _on_event(self, event) -> None:
         self._sync()

@@ -44,6 +44,8 @@ class PeersV1Servicer(Protocol):
         self, request: peers_pb.UpdatePeerGlobalsReq, context: grpc.ServicerContext
     ) -> peers_pb.UpdatePeerGlobalsResp: ...
 
+    def TransferBuckets(self, request, context) -> bytes: ...
+
 
 def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
     return grpc.unary_unary_rpc_method_handler(
@@ -95,6 +97,12 @@ def add_peers_v1_to_server(servicer: PeersV1Servicer, server: grpc.Server) -> No
                     ),
                     "UpdatePeerGlobals": _unary_raw(
                         servicer.UpdatePeerGlobals
+                    ),
+                    # Ownership-transfer protocol (cluster/handoff.py):
+                    # raw JSON windows of bucket rows — no generated
+                    # messages (no grpc_python_plugin in this image).
+                    "TransferBuckets": _unary_raw(
+                        servicer.TransferBuckets
                     ),
                 },
             ),

@@ -184,3 +184,16 @@ class GrpcPeersV1Adapter:
             [serde.update_peer_global_from_pb(g) for g in request.globals]
         )
         return peers_pb.UpdatePeerGlobalsResp()
+
+    def TransferBuckets(self, request, context):
+        # Ownership handoff (cluster/handoff.py): restore a shipped
+        # window of bucket rows into the local engine.  Raw JSON in,
+        # empty response out.
+        try:
+            self.instance.receive_transfer(bytes(request))
+        except (ValueError, KeyError, IndexError, TypeError) as e:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"malformed bucket transfer: {e}",
+            )
+        return b""

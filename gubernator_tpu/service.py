@@ -342,6 +342,18 @@ class V1Instance:
             # with bounded over-admission — RESILIENCE.md.
             "degraded_answers": 0,
         }
+        # Ownership-handoff traffic (cluster/handoff.py), exported as
+        # gubernator_handoff_keys{event}: rows shipped to new owners,
+        # rows forfeited at the epoch deadline, rows received and
+        # restored here.  The membership manager (attached by the
+        # daemon as `self.membership`) shares this dict.
+        self.handoff_counters = {"shipped": 0, "forfeited": 0, "received": 0}
+        # Highest handoff (boot, epoch) seen per source address — the
+        # receiver's stale-window guard (cluster/handoff.py).
+        self.handoff_epoch_seen: Dict[str, Tuple[str, int]] = {}
+        # MembershipManager (cluster/membership.py), set by the daemon
+        # after construction; None for bare library instances.
+        self.membership = None
         from gubernator_tpu.utils.metrics import DurationStat
 
         # Peer-flush duration summary, shared by every PeerClient this
@@ -1222,6 +1234,15 @@ class V1Instance:
     def update_peer_globals_columns(self, dec) -> None:
         """Columnar variant (raw wire path — net/server.py)."""
         self.global_cache.put_columns(dec)
+
+    def receive_transfer(self, raw: bytes) -> int:
+        """Ownership-handoff receiver (PeersV1/TransferBuckets):
+        restore one shipped window of bucket rows into the local
+        engine; returns rows applied (cluster/handoff.py documents
+        the protocol and its over-admission bound)."""
+        from gubernator_tpu.cluster.handoff import receive_transfer
+
+        return receive_transfer(self, raw)
 
     def health_check(self) -> HealthCheckResp:
         """Aggregate recent peer errors. reference: gubernator.go:562-619."""

@@ -187,6 +187,47 @@ class InstanceCollector(Collector):
         yield g
         yield transitions
 
+        # ---- elastic membership (cluster/membership.py; RESILIENCE
+        # §10): epoch counter, handoff row traffic, dual-window time.
+        mem = getattr(inst, "membership", None)
+        if mem is not None:
+            g = GaugeMetricFamily(
+                "gubernator_membership_epoch",
+                "This node's membership epoch (bumps on every observed "
+                "view change; equal across nodes once a transition "
+                "settles).",
+            )
+            g.add_metric([], mem.epoch())
+            yield g
+            g = GaugeMetricFamily(
+                "gubernator_membership_dual",
+                "1 while a dual-ring cutover window is open (old + new "
+                "rings both valid), else 0.",
+            )
+            g.add_metric([], 1 if mem.phase() == "dual" else 0)
+            yield g
+            c = CounterMetricFamily(
+                "gubernator_ring_dual_window_seconds",
+                "Cumulative seconds this node has spent inside "
+                "dual-ring cutover windows.",
+            )
+            c.add_metric([], mem.dual_seconds())
+            yield c
+        hoff = getattr(inst, "handoff_counters", None)
+        if hoff is not None:
+            c = CounterMetricFamily(
+                "gubernator_handoff_keys",
+                "Ownership-handoff bucket rows by event: shipped to a "
+                "new owner, forfeited at the epoch deadline (bounded "
+                "over-admission, RESILIENCE.md §10), or received and "
+                "restored here.",
+                labels=["event"],
+            )
+            c.add_metric(["shipped"], hoff["shipped"])
+            c.add_metric(["forfeited"], hoff["forfeited"])
+            c.add_metric(["received"], hoff["received"])
+            yield c
+
         c = CounterMetricFamily(
             "gubernator_hits_requeue",
             "GLOBAL hit-window re-queue traffic toward unreachable "

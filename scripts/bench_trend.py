@@ -78,6 +78,22 @@ def _extract(data: dict) -> dict | None:
         out["healthy_value"] = healthy["value"]
         if healthy.get("p99_ms") is not None:
             out["healthy_p99_ms"] = healthy["p99_ms"]
+    # Reshard A/B artifacts (reshard mode): fold the membership-plane
+    # counters so the trend shows live-resharding cost alongside
+    # throughput (handoff rows shipped/forfeited/received, dual-ring
+    # window time, and the end-to-end error rate under the reshard).
+    mem = data.get("membership")
+    if isinstance(mem, dict):
+        hoff = mem.get("handoff") or {}
+        for k in ("shipped", "forfeited", "received"):
+            if hoff.get(k) is not None:
+                out[f"handoff_{k}"] = hoff[k]
+        if mem.get("dual_seconds") is not None:
+            out["dual_seconds"] = mem["dual_seconds"]
+        if data.get("errors") is not None and data.get("requests"):
+            out["error_rate"] = round(
+                data["errors"] / data["requests"], 4
+            )
     return out or None
 
 

@@ -5,12 +5,17 @@
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, and peer-network
-#      discipline — retry loops without backoff, peer RPCs without an
-#      explicit timeout (STATIC_ANALYSIS.md);
+#      discipline — retry loops without backoff, peer RPCs (including
+#      the membership handoff's TransferBuckets sites) without an
+#      explicit timeout (STATIC_ANALYSIS.md); the pass's seeded bad
+#      fixtures run inside the tier-1 pytest below
+#      (tests/test_guberlint.py);
 #   2. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
-#      kill/partition/heal invariants; the multi-cycle soak is @slow);
+#      kill/partition/heal invariants; tests/test_membership.py:
+#      join/drain/kill-during-handoff reshard invariants; the
+#      multi-cycle soaks are @slow);
 #   3. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.

@@ -615,3 +615,65 @@ def test_net_pass_suppression_escape_hatch(tmp_path):
             peer.send_peer_hits(reqs)  # guberlint: ok net — probe uses channel default
     """
     assert netcheck.check_file(_src(tmp_path, code)) == []
+
+
+# Handoff RPC discipline (ISSUE 7): TransferBuckets call sites are held
+# to the same rules as every peer RPC — an epoch commit waits on the
+# sender, so an unbudgeted send or a backoff-free retry loop stalls a
+# membership transition, not just one request.
+
+HANDOFF_BAD = """
+    from gubernator_tpu.cluster.peer_client import PeerError
+
+    def ship(pending, window):
+        while pending:
+            for addr, (peer, rows) in list(pending.items()):
+                try:
+                    peer.transfer_buckets_raw(rows[:window])
+                except PeerError as e:
+                    if e.not_ready:
+                        continue
+                pending.pop(addr)
+"""
+
+
+def test_net_pass_catches_handoff_rpc_without_timeout(tmp_path):
+    from tools.guberlint import netcheck
+
+    findings = netcheck.check_file(_src(tmp_path, HANDOFF_BAD))
+    assert any(
+        f.rule == "net-rpc-no-timeout"
+        and "transfer_buckets_raw" in f.message
+        for f in findings
+    )
+
+
+def test_net_pass_catches_handoff_retry_without_backoff(tmp_path):
+    from tools.guberlint import netcheck
+
+    findings = netcheck.check_file(_src(tmp_path, HANDOFF_BAD))
+    assert any(f.rule == "net-retry-no-backoff" for f in findings)
+
+
+def test_net_pass_handoff_with_timeout_and_backoff_ok(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = """
+        import time
+        from gubernator_tpu.cluster.health import backoff_delay
+        from gubernator_tpu.cluster.peer_client import PeerError
+
+        def ship(pending, window, deadline):
+            attempt = 0
+            while pending:
+                for addr, (peer, rows) in list(pending.items()):
+                    try:
+                        peer.transfer_buckets_raw(rows[:window], timeout=1.0)
+                    except PeerError as e:
+                        if e.not_ready:
+                            continue
+                    pending.pop(addr)
+                time.sleep(backoff_delay(attempt, 0.01, 0.25))
+                attempt += 1
+    """
+    assert netcheck.check_file(_src(tmp_path, code)) == []

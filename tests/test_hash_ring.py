@@ -101,6 +101,100 @@ def test_re_add_same_peer_is_idempotent():
     assert len(ring._hashes) == DEFAULT_REPLICAS
 
 
+# ----------------------------------------------------------------------
+# Churn properties (ISSUE 7): the minimal-disruption invariant that
+# elastic membership leans on, pinned as seeded property tests across
+# both hash functions and several cluster sizes — the latent bug class
+# here is any ring-construction change that silently reshuffles
+# unrelated keys on a one-peer membership delta.
+
+
+@pytest.mark.parametrize("hash_name", ["fnv1", "fnv1a"])
+@pytest.mark.parametrize("n_hosts", [3, 5, 8])
+def test_add_one_peer_moves_about_one_over_n(hash_name, n_hosts):
+    """Adding one peer to an N-ring moves ~1/(N+1) of keys — and every
+    moved key moves TO the new peer, never between survivors."""
+    hosts = [f"h{i}.svc.local" for i in range(n_hosts)]
+    ring = ReplicatedConsistentHash(hash_name)
+    ring.add_all([member(h) for h in hosts])
+    keys = _random_ips(20_000, seed=n_hosts)
+    before = [m.info.grpc_address for m in ring.get_batch(keys)]
+    ring.add(member("joiner.svc.local"))
+    after = [m.info.grpc_address for m in ring.get_batch(keys)]
+    moved = [(b, a) for b, a in zip(before, after) if b != a]
+    expected = 1.0 / (n_hosts + 1)
+    assert 0.5 * expected < len(moved) / len(keys) < 1.6 * expected, (
+        f"{len(moved)} of {len(keys)} moved, expected ~{expected:.2%}"
+    )
+    assert all(a == "joiner.svc.local" for _b, a in moved), (
+        "a key moved between surviving peers on an add"
+    )
+
+
+@pytest.mark.parametrize("hash_name", ["fnv1", "fnv1a"])
+@pytest.mark.parametrize("n_hosts", [4, 6])
+def test_remove_one_peer_moves_only_its_keys(hash_name, n_hosts):
+    """Removing one peer re-homes exactly the keys it owned; every
+    other key keeps its owner (the drain/leave invariant)."""
+    hosts = [f"h{i}.svc.local" for i in range(n_hosts)]
+    ring = ReplicatedConsistentHash(hash_name)
+    ring.add_all([member(h) for h in hosts])
+    keys = _random_ips(20_000, seed=100 + n_hosts)
+    before = [m.info.grpc_address for m in ring.get_batch(keys)]
+    gone = hosts[1]
+    survivor_ring = ring.new()
+    survivor_ring.add_all([member(h) for h in hosts if h != gone])
+    after = [m.info.grpc_address for m in survivor_ring.get_batch(keys)]
+    for b, a in zip(before, after):
+        if b != gone:
+            assert a == b, "an unaffected key changed owner on a remove"
+        else:
+            assert a != gone
+    departed = sum(1 for b in before if b == gone)
+    expected = len(keys) / n_hosts
+    assert 0.5 * expected < departed < 1.6 * expected
+
+
+@pytest.mark.parametrize("hash_name", ["fnv1", "fnv1a"])
+@pytest.mark.parametrize("delta", ["join", "leave"])
+def test_dual_ring_window_routes_old_or_new_never_third(hash_name, delta):
+    """The cutover window's core property: while both rings are
+    valid, every key is routed/accepted at its OLD or NEW owner —
+    never a third node (cluster membership can change under traffic
+    without a single misrouted key)."""
+    from gubernator_tpu.cluster.hash_ring import DualRingWindow, address_ring
+
+    hosts = [f"h{i}.svc.local" for i in range(5)]
+    old_infos = [PeerInfo(grpc_address=h) for h in hosts]
+    if delta == "join":
+        new_infos = old_infos + [PeerInfo(grpc_address="joiner.svc.local")]
+    else:
+        new_infos = old_infos[:-1]
+    window = DualRingWindow(
+        address_ring(old_infos, hash_name),
+        address_ring(new_infos, hash_name),
+    )
+    keys = _random_ips(5_000, seed=7)
+    n_moved = 0
+    for k in keys:
+        old_addr, new_addr = window.owners(k)
+        routed = window.owner(k)
+        # Routing converges on the new topology...
+        assert routed == new_addr
+        # ...and acceptance covers exactly the two owners.
+        assert window.acceptable(k, old_addr)
+        assert window.acceptable(k, new_addr)
+        third = next(
+            h for h in hosts if h not in (old_addr, new_addr)
+        )
+        assert not window.acceptable(k, third)
+        if window.moved(k):
+            n_moved += 1
+    # The window is consistent with the minimal-disruption property:
+    # only the delta's share of keys sees two distinct owners.
+    assert n_moved / len(keys) < 0.35
+
+
 def test_region_picker_routes_per_dc():
     rp = RegionPicker()
     rp.add(member("a1", dc="us-east"))

@@ -1,0 +1,521 @@
+"""Elastic membership chaos suite: join / leave / drain under traffic.
+
+Pins the ISSUE 7 acceptance invariants on real in-process clusters
+(cluster/membership.py + cluster/handoff.py):
+
+- JOIN ships moved bucket state to the new owner — a consumed limit
+  stays consumed after the cutover (no fresh-bucket amnesia);
+- DRAIN under live traffic completes with 0 forfeited rows and 0%
+  request errors (planned leave = zero-downtime deploy primitive);
+- kill-during-handoff (seeded injector, deterministic fault point via
+  the sender's window hook) converges — epochs settle, survivors stay
+  healthy — with measured over-admission ≤ N_partitions × limit;
+- unplanned leave (remove_peer) forfeits within the same bound;
+- no-op peer pushes do NOT open epochs/dual windows (discovery
+  re-pushes on every watch event);
+- the metrics surface: gubernator_membership_epoch,
+  gubernator_handoff_keys{event}, gubernator_ring_dual_window_seconds
+  on /metrics, mirrored by Daemon.membership_stats().
+
+Fast cases run tier-1; the sustained reshard soak is @slow.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster.harness import ClusterHarness
+from gubernator_tpu.cluster.health import HEALTHY
+from gubernator_tpu.types import RateLimitReq, Status
+
+
+def _req(name, key, limit=1_000_000, hits=1, duration=60_000):
+    return RateLimitReq(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=duration,
+    )
+
+
+def _keys_owned_by(h, daemon_idx, name, n, prefix):
+    """`n` keys whose owner is daemons[daemon_idx].  Keys vary a
+    LEADING byte (FNV-1 does not avalanche trailing-byte differences;
+    see hash_ring.py)."""
+    want = h.daemons[daemon_idx].peer_info().grpc_address
+    out = []
+    i = 0
+    while len(out) < n:
+        key = f"{i}_{prefix}"
+        if (
+            h.daemons[0].instance.get_peer(f"{name}_{key}").info.grpc_address
+            == want
+        ):
+            out.append(key)
+        i += 1
+        assert i < 50_000, "ring never mapped enough keys to the target"
+    return out
+
+
+def _consume(h, name, key, limit):
+    """Exhaust `key`'s limit through node 0; returns hits admitted."""
+    admitted = 0
+    with V1Client(h.peer_at(0).grpc_address) as c:
+        for _ in range(limit + 2):
+            r = c.get_rate_limits(
+                [_req(name, key, limit=limit)], timeout=15
+            )[0]
+            assert r.error == ""
+            if r.status == Status.UNDER_LIMIT:
+                admitted += 1
+    return admitted
+
+
+# ----------------------------------------------------------------------
+# Wire round trip (pure unit).
+
+
+def test_transfer_codec_round_trip():
+    from gubernator_tpu.cluster.handoff import (
+        decode_transfer,
+        encode_transfer,
+    )
+    from gubernator_tpu.store import CacheItem, LeakyBucketItem, TokenBucketItem
+
+    items = [
+        CacheItem(
+            key="tok_1", algorithm=0, expire_at=123_456, invalid_at=7,
+            value=TokenBucketItem(
+                status=1, limit=100, duration=60_000, remaining=3,
+                created_at=99,
+            ),
+        ),
+        CacheItem(
+            key="leak_1", algorithm=1, expire_at=222_222,
+            value=LeakyBucketItem(
+                limit=50, duration=30_000, burst=60, updated_at=88,
+                remaining=12.5, remaining_words=(12, 1 << 31),
+            ),
+        ),
+    ]
+    epoch, src, boot, out = decode_transfer(
+        encode_transfer(7, "1.2.3.4:81", items, boot="bootabc")
+    )
+    assert (epoch, src, boot) == (7, "1.2.3.4:81", "bootabc")
+    assert out[0].key == "tok_1"
+    assert out[0].value.remaining == 3
+    assert out[0].value.status == 1
+    assert out[0].invalid_at == 7
+    assert out[1].value.remaining_words == (12, 1 << 31)
+    assert out[1].value.burst == 60
+
+
+def test_receiver_drops_stale_epoch_windows():
+    """A delayed window from a superseded transition must not
+    overwrite rows a newer transition installed — unless the sender
+    rebooted (fresh boot token resets its epoch stream)."""
+    from gubernator_tpu.cluster.handoff import encode_transfer
+    from gubernator_tpu.store import CacheItem, TokenBucketItem
+
+    h = ClusterHarness().start(1)
+    try:
+        inst = h.daemons[0].instance
+        now = inst.engine.clock.now_ms()
+
+        def row(key, remaining):
+            return [
+                CacheItem(
+                    key=key, algorithm=0, expire_at=now + 60_000,
+                    value=TokenBucketItem(
+                        status=0, limit=10, duration=60_000,
+                        remaining=remaining, created_at=now,
+                    ),
+                )
+            ]
+
+        src = "10.0.0.9:81"
+        assert inst.receive_transfer(
+            encode_transfer(5, src, row("st_k", 4), boot="b1")
+        ) == 1
+        # Older epoch, same boot: dropped.
+        assert inst.receive_transfer(
+            encode_transfer(4, src, row("st_k", 9), boot="b1")
+        ) == 0
+        # Same epoch (another window of the same transition): applied.
+        assert inst.receive_transfer(
+            encode_transfer(5, src, row("st_k2", 4), boot="b1")
+        ) == 1
+        # Lower epoch but a NEW boot (sender restarted): applied.
+        assert inst.receive_transfer(
+            encode_transfer(1, src, row("st_k3", 4), boot="b2")
+        ) == 1
+        assert inst.handoff_counters["received"] == 3
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# JOIN: moved state ships to the new owner.
+
+
+def test_join_ships_moved_state():
+    h = ClusterHarness().start(3)
+    try:
+        limit = 3
+        keys = [f"{i}_js" for i in range(40)]
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for k in keys:
+                for _ in range(limit):
+                    c.get_rate_limits(
+                        [_req("mem_join", k, limit=limit)], timeout=15
+                    )
+        pre = {
+            k: h.daemons[0].instance.get_peer(f"mem_join_{k}").info.grpc_address
+            for k in keys
+        }
+        d_new = h.add_peer()
+        assert h.wait_membership_settled(10)
+        new_addr = d_new.peer_info().grpc_address
+        moved = [
+            k for k in keys
+            if pre[k] != new_addr
+            and h.daemons[0].instance.get_peer(
+                f"mem_join_{k}"
+            ).info.grpc_address == new_addr
+        ]
+        assert moved, "the join moved no sampled keys (ring bug?)"
+        assert d_new.instance.handoff_counters["received"] >= len(moved)
+        shipped = sum(
+            d.instance.handoff_counters["shipped"] for d in h.daemons
+        )
+        assert shipped >= len(moved)
+        # Every moved, fully-consumed key is still OVER_LIMIT at its
+        # new owner: the bucket state travelled, it did not restart.
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for k in moved:
+                r = c.get_rate_limits(
+                    [_req("mem_join", k, limit=limit)], timeout=15
+                )[0]
+                assert r.error == ""
+                assert r.status == Status.OVER_LIMIT, (
+                    f"moved key {k} restarted fresh at the new owner"
+                )
+        # The join opened (and closed) dual windows on the old nodes.
+        assert any(
+            d.membership.dual_seconds() > 0 for d in h.daemons[:3]
+        )
+    finally:
+        h.stop()
+
+
+def test_non_authoritative_copies_do_not_ship():
+    """The engine can hold LOCAL copies of peer-owned keys (degraded
+    answers, GLOBAL miss-local copies).  A membership event must ship
+    only rows this node was the authoritative owner of — a stale
+    fresh copy travelling would overwrite the real owner's consumed
+    state and re-admit past the limit."""
+    h = ClusterHarness().start(3)
+    try:
+        assert h.wait_membership_settled(10)
+        limit = 4
+        key = _keys_owned_by(h, 2, "mem_copy", 1, "cp")[0]
+        # Plant a NON-authoritative fresh copy of the key on node 0
+        # (the peer-serving path answers anything it is sent; hits=0
+        # interns the bucket without consuming).
+        h.daemons[0].instance.get_peer_rate_limits(
+            [_req("mem_copy", key, limit=limit, hits=0)]
+        )
+        # Properly exhaust the key at its real owner via routing.
+        assert _consume(h, "mem_copy", key, limit) == limit
+        # An unrelated membership event (a join) triggers transitions
+        # on every node — node 0's stale copy must stay put.
+        h.add_peer()
+        assert h.wait_membership_settled(10)
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            r = c.get_rate_limits(
+                [_req("mem_copy", key, limit=limit)], timeout=15
+            )[0]
+        assert r.error == ""
+        assert r.status == Status.OVER_LIMIT, (
+            "a non-authoritative local copy was shipped over the "
+            "owner's consumed state"
+        )
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# DRAIN under live traffic: 0 forfeited, 0% errors (ISSUE 7 acceptance).
+
+
+def test_drain_under_traffic_zero_forfeit_zero_errors():
+    h = ClusterHarness().start(4)
+    try:
+        limit = 5
+        victim = 3
+        owned = _keys_owned_by(h, victim, "mem_drain", 6, "dr")
+        for k in owned[:3]:
+            assert _consume(h, "mem_drain", k, limit) == limit
+
+        stop = threading.Event()
+        errors = []
+        served = [0]
+
+        def traffic():
+            with V1Client(h.peer_at(0).grpc_address) as c:
+                i = 0
+                while not stop.is_set():
+                    batch = [
+                        _req("mem_drain", owned[i % len(owned)], limit=limit),
+                        _req("mem_live", f"{i}_lv"),
+                    ]
+                    for r in c.get_rate_limits(batch, timeout=15):
+                        served[0] += 1
+                        if r.error:
+                            errors.append(r.error)
+                    i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)  # traffic flowing before the drain
+        stats = h.drain_peer(victim)
+        time.sleep(0.5)  # traffic across the cutover
+        stop.set()
+        t.join(timeout=10)
+
+        assert stats["forfeited"] == 0, stats
+        assert stats["shipped"] >= 3, stats
+        assert errors == [], errors[:5]
+        assert served[0] > 0
+        assert h.wait_membership_settled(10)
+        # The consumed keys remain OVER_LIMIT at their new owners.
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for k in owned[:3]:
+                r = c.get_rate_limits(
+                    [_req("mem_drain", k, limit=limit)], timeout=15
+                )[0]
+                assert r.error == ""
+                assert r.status == Status.OVER_LIMIT
+        # Survivors agree on the epoch.
+        assert len(set(h.membership_epochs().values())) == 1
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Kill-during-handoff: convergence + the over-admission bound.
+
+
+def test_kill_during_handoff_converges_within_bound():
+    """Seeded + deterministic: the drain's sender delivers exactly one
+    window, then the victim is isolated (the hook fires inside the
+    sender loop, not on a timer).  The rest of its rows forfeit at the
+    deadline; total admission per key stays ≤ N_partitions × limit
+    (source side ≤ limit before the kill, fresh side ≤ limit after)."""
+    h = ClusterHarness().start(4)
+    try:
+        limit = 5
+        victim = 3
+        victim_addr = h.daemons[victim].peer_info().grpc_address
+        owned = _keys_owned_by(h, victim, "mem_kill", 6, "kd")
+        admitted = {k: _consume(h, "mem_kill", k, limit) for k in owned}
+        assert all(v == limit for v in admitted.values())
+
+        h.install_faults(seed=77)
+        mgr = h.daemons[victim].membership
+        mgr.handoff_window = 1  # several windows → a mid-handoff point
+
+        fired = []
+
+        def kill_mid_handoff(addr, n_rows):
+            if not fired:
+                fired.append(addr)
+                h._injector.isolate(victim_addr)
+
+        mgr.handoff_hook = kill_mid_handoff
+        stats = h.drain_peer(victim, deadline=1.0)
+        assert fired, "the handoff never delivered a first window"
+        assert stats["shipped"] >= 1
+        assert stats["forfeited"] >= 1, stats
+        h.heal()
+
+        # Convergence: every survivor settles, healthy, equal epochs.
+        assert h.wait_membership_settled(10)
+        assert len(set(h.membership_epochs().values())) == 1
+        states = h.health_states()
+        for _src, peers in states.items():
+            for dst, st in peers.items():
+                if dst != victim_addr:
+                    assert st == HEALTHY, states
+
+        # Over-admission bound, asserted per key: limit before + what
+        # the (shipped-or-fresh) new owner admits after ≤ 2 × limit.
+        n_partitions = 2
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for k in owned:
+                after = 0
+                for _ in range(3 * limit):
+                    r = c.get_rate_limits(
+                        [_req("mem_kill", k, limit=limit)], timeout=15
+                    )[0]
+                    assert r.error == ""
+                    if r.status == Status.UNDER_LIMIT:
+                        after += 1
+                total = admitted[k] + after
+                assert total <= n_partitions * limit, (
+                    f"key {k}: {admitted[k]} + {after} > "
+                    f"{n_partitions} × {limit}"
+                )
+        # At least one key forfeited → took the fresh path (the bound
+        # was exercised, not vacuous).
+        assert any(
+            stats["forfeited"] > 0 for stats in [stats]
+        )
+    finally:
+        h.stop()
+
+
+def test_remove_peer_forfeits_within_bound():
+    """Unplanned leave (node killed and dropped from the ring): its
+    buckets restart fresh at the survivors — total admission per key
+    stays within the same 2 × limit bound, with zero request errors
+    after the cutover."""
+    h = ClusterHarness().start(3)
+    try:
+        limit = 4
+        key = _keys_owned_by(h, 2, "mem_rm", 1, "rm")[0]
+        assert _consume(h, "mem_rm", key, limit) == limit
+        h.remove_peer(2)
+        assert h.wait_membership_settled(10)
+        after = 0
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for _ in range(3 * limit):
+                r = c.get_rate_limits(
+                    [_req("mem_rm", key, limit=limit)], timeout=15
+                )[0]
+                assert r.error == ""
+                if r.status == Status.UNDER_LIMIT:
+                    after += 1
+        assert after <= limit
+        assert limit + after <= 2 * limit
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Epoch hygiene + metrics surface.
+
+
+def test_noop_peer_push_does_not_bump_epoch():
+    h = ClusterHarness().start(2)
+    try:
+        # Barrier on the start-up transition first — its commit may
+        # still be in flight right after start() under suite load.
+        assert h.wait_membership_settled(10)
+        before = h.membership_epochs()
+        for _ in range(3):
+            h._push_peers()  # discovery-style re-push, same view
+        assert h.membership_epochs() == before
+        for d in h.daemons:
+            assert d.membership.phase() == "stable"
+    finally:
+        h.stop()
+
+
+def test_membership_metrics_exported():
+    import urllib.request
+
+    h = ClusterHarness().start(3)
+    try:
+        with V1Client(h.peer_at(0).grpc_address) as c:
+            for i in range(8):
+                c.get_rate_limits([_req("mem_m", f"{i}_mm")], timeout=15)
+        h.drain_peer(2)
+        assert h.wait_membership_settled(10)
+        body = urllib.request.urlopen(
+            f"http://{h.daemons[0].http_address}/metrics", timeout=5
+        ).read().decode()
+        assert "gubernator_membership_epoch" in body
+        assert 'gubernator_handoff_keys_total{event="received"}' in body
+        assert 'gubernator_handoff_keys_total{event="shipped"}' in body
+        assert "gubernator_ring_dual_window_seconds" in body
+        ms = h.daemons[0].membership_stats()
+        assert ms["epoch"] >= 2
+        assert ms["phase"] == "stable"
+        assert set(ms["handoff"]) == {"shipped", "forfeited", "received"}
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------------------------------
+# Soak: repeated join/drain cycles under sustained traffic.
+
+
+@pytest.mark.slow
+def test_reshard_soak_cycles():
+    """Two full join+drain cycles with traffic throughout: zero
+    errors, every cycle settles, epochs agree, and a limited key's
+    cumulative admission stays within the cycle-count bound."""
+    h = ClusterHarness().start(4)
+    try:
+        original = {d.peer_info().grpc_address for d in h.daemons}
+        limit = 50
+        bound_key = "0_soakb"
+        n_err = 0
+        n_total = 0
+        admitted = 0
+        stop = threading.Event()
+
+        def traffic():
+            nonlocal n_err, n_total, admitted
+            with V1Client(h.peer_at(0).grpc_address) as c:
+                i = 0
+                while not stop.is_set():
+                    rs = c.get_rate_limits(
+                        [
+                            _req("soak_r", f"{i % 61}_sk"),
+                            _req("soak_rb", bound_key, limit=limit),
+                        ],
+                        timeout=15,
+                    )
+                    for r in rs:
+                        n_total += 1
+                        if r.error:
+                            n_err += 1
+                    if rs[1].status == Status.UNDER_LIMIT and not rs[1].error:
+                        admitted += 1
+                    i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            for _cycle in range(2):
+                h.add_peer()
+                assert h.wait_membership_settled(15)
+                time.sleep(0.5)
+                stats = h.drain_peer(1)
+                assert stats["forfeited"] == 0, stats
+                assert h.wait_membership_settled(15)
+                time.sleep(0.5)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert n_total > 0
+        assert n_err == 0, f"{n_err}/{n_total}"
+        # Each membership event may fork the bound key's bucket once:
+        # ≤ (1 + events) × limit total.
+        assert admitted <= 5 * limit, admitted
+        # Per-node epochs agree exactly for nodes that observed every
+        # view — i.e. the original daemons still in the cluster
+        # (mid-soak joiners booted later and counted fewer views).
+        survivors_from_start = {
+            addr: e
+            for addr, e in h.membership_epochs().items()
+            if addr in original
+        }
+        assert survivors_from_start
+        assert len(set(survivors_from_start.values())) == 1, (
+            survivors_from_start
+        )
+    finally:
+        h.stop()

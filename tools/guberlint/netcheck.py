@@ -30,7 +30,10 @@ from tools.guberlint.common import Finding, SourceFile, attr_path
 
 PASS = "net"
 
-# The PeerClient RPC surface (every one takes timeout=).
+# The PeerClient RPC surface (every one takes timeout=).  The handoff
+# RPC (cluster/handoff.py) is held to the same discipline: an epoch
+# commit waits on the sender, so an unbudgeted TransferBuckets call
+# would let one slow peer stall a membership transition indefinitely.
 PEER_RPC_METHODS = {
     "get_peer_rate_limit",
     "get_peer_rate_limits",
@@ -38,6 +41,8 @@ PEER_RPC_METHODS = {
     "send_peer_hits_raw",
     "update_peer_globals",
     "update_peer_globals_raw",
+    "transfer_buckets",
+    "transfer_buckets_raw",
 }
 
 # Backoff-shaped calls that satisfy net-retry-no-backoff.
