@@ -74,7 +74,7 @@ class MembershipManager:
     and epochs commit in order).
     """
 
-    # guberlint: guard _epoch, _phase, _view, _infos, _dual_since, _dual_window, _active_transition, dual_window_seconds by _lock
+    # guberlint: guard _epoch, _phase, _view, _infos, _dual_since, _dual_window, _active_transition, dual_window_seconds, _shipper, _closed by _lock
 
     def __init__(
         self,
@@ -189,14 +189,18 @@ class MembershipManager:
             epoch = self._epoch
             self._active_transition = epoch
             prev = self._shipper
-            self._shipper = threading.Thread(
+            shipper = threading.Thread(
                 target=self._transition,
                 args=(epoch, prev, window),
                 name=f"guber-membership-{epoch}",
                 daemon=True,
             )
-            shipper = self._shipper
-        shipper.start()
+            # Start BEFORE publishing: close() joins whatever
+            # self._shipper holds, and joining a never-started thread
+            # raises.  Starting under the lock is safe — the new
+            # thread only takes _lock at commit time.
+            shipper.start()
+            self._shipper = shipper
         return True
 
     def _transition(
@@ -402,11 +406,17 @@ class MembershipManager:
         return self._settled.wait(timeout)
 
     def close(self) -> None:
+        # Snapshot the shipper under the lock: apply_view swaps
+        # self._shipper from discovery watch threads, and a torn read
+        # here could join a thread the manager no longer owns while
+        # the freshly-spawned one outlives close() (the post-PR-3
+        # audit's sender/receiver-state finding).
         with self._lock:
             self._closed = True
+            shipper = self._shipper
         # Wake any in-flight sender out of its backoff/retry loop —
         # it forfeits its tail and exits, so the join below is bounded
         # by one RPC timeout, not the epoch deadline.
         self._stop.set()
-        if self._shipper is not None:
-            self._shipper.join(timeout=5.0)
+        if shipper is not None:
+            shipper.join(timeout=5.0)

@@ -155,6 +155,37 @@ class Config:
     ledger_settle_interval: float = 0.05
 
 
+# ----------------------------------------------------------------------
+# Canonical GUBER_* env-surface index (guberlint's drift pass pins it:
+# every knob read ANYWHERE must appear in this file and in the README
+# knob table).  Daemon knobs load in setup_daemon_config below; the
+# debug/infra knobs here are read at their point of use — they gate
+# process bootstrap (before a DaemonConfig exists) or test-only builds,
+# so hauling them through the dataclass would be ceremony.  Each entry
+# names its read site.
+
+KNOWN_ENV_KNOBS = (
+    # Engine / device plane.
+    "GUBER_PLATFORM",         # daemon.py: jax platform override (cpu/tpu)
+    "GUBER_BACKEND_PROBE",    # daemon.py: probe the backend in a subprocess
+    "GUBER_BACKEND_PROBE_TIMEOUT",  # daemon.py: probe wall budget, seconds
+    "GUBER_PUMP",             # core/engine.py: step-pump mode override
+    "GUBER_PUMP_SCAN",        # core/pump.py: fused-scan round loop toggle
+    "GUBER_MULTI_THREADS",    # core/native.py: native scheduler threads
+    "GUBER_SHARDS_SINGLE_PROGRAM",  # parallel/sharded_engine.py: one
+                              # pjit program across shards vs per-shard
+    # Build / test infra.
+    "GUBER_NATIVE_SAN",       # core/native_build.py: TSan/ASan build tag
+    # Process bootstrap (read before config loads).
+    "GUBER_LOG_LEVEL",        # utils/logging_setup.py
+    "GUBER_LOG_FORMAT",       # utils/logging_setup.py ("json" | "text")
+    "GUBER_TRACING",          # utils/tracing.py ("memory" recorder)
+    # Discovery plane (read by the k8s watcher, not the daemon config).
+    "GUBER_K8S_NAMESPACE",    # discovery/kubernetes.py
+    "GUBER_K8S_POD_SELECTOR",  # discovery/kubernetes.py
+)
+
+
 def _env(d: Dict[str, str], key: str, default: str = "") -> str:
     return d.get(key, os.environ.get(key, default)) or default
 

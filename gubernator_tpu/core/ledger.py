@@ -80,7 +80,11 @@ _UNDER = int(Status.UNDER_LIMIT)
 # NO_BATCHING / BATCHING do not change the bucket update itself.
 _BREAKERS = int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.RESET_REMAINING)
 
-# Entry kinds.
+# Entry kinds.  _K_OVER/_K_LEASE are the wire-level protocol with the
+# native decision plane: dp_pull returns decision_plane.cpp's
+# kOver/kLease and the branches below compare against these — the two
+# tiers are pinned numerically equal by guberlint's contract pass
+# (tools/guberlint/config.py:CONTRACT_CONSTANTS).
 _K_COUNTER = 0
 _K_OVER = 1
 _K_LEASE = 2

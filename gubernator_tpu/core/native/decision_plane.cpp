@@ -73,6 +73,7 @@ struct DpEntry {
 };
 
 struct Plane {
+  // guberlint: guard items, answered, rpcs, declined, installs, pulls by mu
   std::mutex mu;
   std::unordered_map<std::string, DpEntry> items;  // guarded by mu
   int64_t max_keys;
@@ -102,6 +103,7 @@ int64_t real_now_ms() {
 // drains.  Returns true when the item is answerable; fills
 // (status, remaining, reset).  `staged` maps entry → drain staged so
 // far within this RPC, so duplicate keys see sequential credit.
+// guberlint: gil-free
 bool probe_locked(Plane* p, const std::string& key, int32_t algo,
                   int32_t behavior, int64_t hits, int64_t limit,
                   int64_t duration, int64_t now,
@@ -264,6 +266,7 @@ void dp_clear(void* handle) {
 // Single-item probe with an explicit clock — the parity-fuzz entry.
 // Commits the drain.  out3 = {status, remaining, reset}; returns 1
 // answered / 0 declined.
+// guberlint: gil-free
 int64_t dp_probe(void* handle, const uint8_t* key, int64_t klen,
                  int32_t algo, int32_t behavior, int64_t hits,
                  int64_t limit, int64_t duration, int64_t now_ms,
@@ -291,6 +294,7 @@ int64_t dp_probe(void* handle, const uint8_t* key, int64_t klen,
 // Drains commit only when the whole RPC answers; a decline mutates
 // nothing.  now_ms = -1 uses the plane clock (realtime + offset).
 // Returns response byte count, or -1 to decline.
+// guberlint: gil-free
 int64_t dp_try_serve(void* handle, const uint8_t* body, int64_t len,
                      int64_t max_items, int64_t now_ms, uint8_t* out,
                      int64_t out_cap) {

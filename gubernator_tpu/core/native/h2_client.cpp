@@ -338,6 +338,7 @@ extern "C" {
 //   out_resp_len: first grpc-framed response payload (callers verify
 //   it decodes correctly).
 // Returns 0, or -1 if no connection could be established.
+// guberlint: gil-free
 int64_t h2_bench_unary(const char* host, int32_t port, const char* path,
                        const char* authority, const uint8_t* payload,
                        int64_t payload_len, double seconds, int32_t n_conns,
@@ -378,9 +379,11 @@ int64_t h2_bench_unary(const char* host, int32_t port, const char* path,
         if (r == 1) {
           const double dt =
               std::chrono::duration<double>(Clock::now() - t0).count();
+          // guberlint: ok native — bench counters: the only reads are
+          // after the thread joins below, which publish everything.
           total.fetch_add(1, std::memory_order_relaxed);
           const int64_t i =
-              lat_cursor.fetch_add(1, std::memory_order_relaxed);
+              lat_cursor.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — same join-publishes argument
           if (max_lats > 0) out_lats[i % max_lats] = dt;
           if (want_resp && !resp.empty()) {
             const int64_t n = std::min<int64_t>(
@@ -391,9 +394,9 @@ int64_t h2_bench_unary(const char* host, int32_t port, const char* path,
           }
         } else if (r == 2) {
           // grpc error status; the connection is still healthy.
-          errors.fetch_add(1, std::memory_order_relaxed);
+          errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
         } else {
-          errors.fetch_add(1, std::memory_order_relaxed);
+          errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
           if (!c.connect_to(host, port)) {
             std::this_thread::sleep_for(std::chrono::milliseconds(10));
             if (!c.connect_to(host, port)) return;

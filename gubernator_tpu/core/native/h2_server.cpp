@@ -117,6 +117,8 @@ bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
 
 // Count top-level `requests` (field 1, wire type 2) entries in a
 // GetRateLimitsReq body; -1 on malformed input.
+// guberlint: gil-free
+// guberlint: wire GetRateLimitsReq requests=1:len
 int64_t count_items(const uint8_t* p, const uint8_t* end) {
   int64_t n = 0;
   while (p < end) {
@@ -169,6 +171,8 @@ struct PendingRpc {
 };
 
 struct Server {
+  // guberlint: guard queue, queued_items by q_mu
+  // guberlint: guard conns by conns_mu
   // SO_REUSEPORT listener lanes: one listen fd + accept thread per
   // lane, all bound to the same port, so the kernel spreads incoming
   // connections (and therefore framing/decide work, which runs on the
@@ -220,6 +224,7 @@ struct PendingSend {
 };
 
 struct Conn : std::enable_shared_from_this<Conn> {
+  // guberlint: guard conn_send_window, initial_stream_window, blocked, early_credits by write_mu
   int fd;
   std::mutex write_mu;
   std::atomic<bool> dead{false};
@@ -238,7 +243,7 @@ struct Conn : std::enable_shared_from_this<Conn> {
   std::vector<std::pair<uint32_t, int64_t>> early_credits;
   static constexpr size_t kMaxEarlyCredits = 128;
 
-  int64_t take_early_credit(uint32_t stream) {
+  int64_t take_early_credit(uint32_t stream) {  // guberlint: holds write_mu
     for (size_t i = 0; i < early_credits.size(); ++i)
       if (early_credits[i].first == stream) {
         const int64_t c = early_credits[i].second;
@@ -257,6 +262,10 @@ struct Conn : std::enable_shared_from_this<Conn> {
     const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
     size_t n = buf.size();
     while (n) {
+      // guberlint: ok native — the write path serializes on write_mu by
+      // design (responses must not interleave frames); the send is
+      // bounded by the socket buffer, and a stalled peer flips `dead`
+      // so the conn tears down instead of convoying its server threads.
       ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
       if (w <= 0) {
         dead.store(true);
@@ -399,6 +408,9 @@ std::string trailers_block(int code) {
 
 // The grpc-framed message payload of a success response (the DATA
 // frame's payload; framing happens window-chunked in Conn::pump_locked).
+// guberlint: gil-free
+// guberlint: wire GetRateLimitsResp responses=1:len
+// guberlint: wire RateLimitResp status=1:varint limit=2:varint remaining=3:varint reset_time=4:varint
 std::string build_data_payload(const int64_t* cols, int64_t offset,
                                int64_t k, int64_t total) {
   // GetRateLimitsResp{ repeated RateLimitResp responses = 1 }
@@ -477,6 +489,13 @@ struct StreamState {
   bool headers_done = false;
 };
 
+// The per-connection serve loop: frame → deframe → native-plane probe
+// → respond, entirely inside this C thread.  The zero-GIL guarantee
+// of the native fast path (PERF.md §20) is checked here: nothing
+// reachable from this loop may call Python C-API or the window
+// callback trampoline — queueing to the dispatch thread (which DOES
+// re-enter Python) is the only bridge, and it is data, not a call.
+// guberlint: gil-free
 void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
   std::vector<uint8_t> buf(1 << 16);
   size_t len = 0;

@@ -264,6 +264,7 @@ int64_t git_len(void* t) { return static_cast<Table*>(t)->used; }
 // `idx`: optional indirection — schedule items buf[offsets[idx[j]]..]
 // for j in [0, n) (the sharded engine's per-shard subsets over ONE
 // decoded wire buffer; nullptr = identity).
+// guberlint: gil-free
 int64_t git_schedule_idx(void* tp, const uint8_t* buf, const int64_t* offsets,
                          const int64_t* idx, int64_t n, int64_t now_ms,
                          int32_t* out_slots, int32_t* out_rounds,
@@ -317,6 +318,7 @@ int64_t git_schedule_idx(void* tp, const uint8_t* buf, const int64_t* offsets,
   return n_evicted;
 }
 
+// guberlint: gil-free
 int64_t git_schedule(void* tp, const uint8_t* buf, const int64_t* offsets,
                      int64_t n, int64_t now_ms, int32_t* out_slots,
                      int32_t* out_rounds, int32_t* out_evicted,

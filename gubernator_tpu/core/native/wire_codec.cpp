@@ -99,6 +99,9 @@ extern "C" {
 //   -1 malformed protobuf    -2 more than max_items items
 //   -3 key_buf overflow      -4 item needs the slow path
 //      (disqualifying behavior bits or empty name/unique_key)
+// guberlint: gil-free
+// guberlint: wire GetRateLimitsReq requests=1:len
+// guberlint: wire RateLimitReq name=1:len unique_key=2:len hits=3:varint limit=4:varint duration=5:varint algorithm=6:varint behavior=7:varint burst=8:varint
 int64_t wire_decode_reqs(const uint8_t* buf, int64_t len,
                          int64_t max_items, int64_t disqualify_mask,
                          uint8_t* key_buf, int64_t key_cap,
@@ -219,6 +222,9 @@ inline int varint_size(uint64_t v) {
 // columns.  Proto3 semantics: zero-valued fields are omitted.  The
 // caller provides `out` of capacity out_cap; returns bytes written or
 // -1 if out_cap is too small.
+// guberlint: gil-free
+// guberlint: wire GetRateLimitsResp responses=1:len
+// guberlint: wire RateLimitResp status=1:varint limit=2:varint remaining=3:varint reset_time=4:varint
 int64_t wire_encode_resps(const int32_t* status, const int64_t* limit,
                           const int64_t* remaining, const int64_t* reset_time,
                           int64_t n, uint8_t* out, int64_t out_cap) {
@@ -260,6 +266,9 @@ int64_t wire_encode_resps(const int32_t* status, const int64_t* limit,
 // map<string,string> field 6) — the GLOBAL non-owner responses echo
 // the owner address, reference: gubernator.go:448-452.  Owner strings
 // are (owner_offsets[k], owner_offsets[k+1]) slices of owner_buf.
+// guberlint: gil-free
+// guberlint: wire GetRateLimitsResp responses=1:len
+// guberlint: wire RateLimitResp status=1:varint limit=2:varint remaining=3:varint reset_time=4:varint metadata=6:len
 int64_t wire_encode_resps_owner(const int32_t* status, const int64_t* limit,
                                 const int64_t* remaining,
                                 const int64_t* reset_time,
@@ -329,6 +338,9 @@ int64_t wire_encode_resps_owner(const int32_t* status, const int64_t* limit,
 // hits-forward plane (owner fan-out windows).  Each item's joined key
 // (key_buf slice) splits back into name/unique_key via name_lens.
 // Returns bytes written, or -1 if out_cap is too small.
+// guberlint: gil-free
+// guberlint: wire GetPeerRateLimitsReq requests=1:len
+// guberlint: wire RateLimitReq name=1:len unique_key=2:len hits=3:varint limit=4:varint duration=5:varint algorithm=6:varint behavior=7:varint burst=8:varint
 int64_t wire_encode_reqs(const uint8_t* key_buf, const int64_t* key_offsets,
                          const int32_t* name_lens, const int32_t* algo,
                          const int32_t* behavior, const int64_t* hits,
@@ -405,6 +417,10 @@ int64_t wire_encode_reqs(const uint8_t* key_buf, const int64_t* key_offsets,
 // columns, decode straight into status-cache columns.
 
 // Encode: returns bytes written, or -1 if out_cap is too small.
+// guberlint: gil-free
+// guberlint: wire UpdatePeerGlobalsReq globals=1:len
+// guberlint: wire UpdatePeerGlobal key=1:len status=2:len algorithm=3:varint
+// guberlint: wire RateLimitResp status=1:varint limit=2:varint remaining=3:varint reset_time=4:varint
 int64_t wire_encode_globals(const uint8_t* key_buf,
                             const int64_t* key_offsets,
                             const int32_t* algo, const int32_t* status,
@@ -462,6 +478,10 @@ int64_t wire_encode_globals(const uint8_t* key_buf,
 // Decode: returns n >= 0, or -1 malformed, -2 too many items,
 // -3 key_buf overflow.  Items with an absent status submessage get
 // status/limit/remaining/reset 0 and has_status[i] = 0.
+// guberlint: gil-free
+// guberlint: wire UpdatePeerGlobalsReq globals=1:len
+// guberlint: wire UpdatePeerGlobal key=1:len status=2:len algorithm=3:varint
+// guberlint: wire RateLimitResp status=1:varint limit=2:varint remaining=3:varint reset_time=4:varint
 int64_t wire_decode_globals(const uint8_t* buf, int64_t len,
                             int64_t max_items, uint8_t* key_buf,
                             int64_t key_cap, int64_t* key_offsets,

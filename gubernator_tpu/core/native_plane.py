@@ -29,7 +29,9 @@ _lib = None
 
 # Same breaker set as core/ledger._BREAKERS — the two tiers must agree
 # on what falls through, or a native answer could cover a row the
-# Python ledger would have revoked on.
+# Python ledger would have revoked on.  Pinned numerically equal by
+# guberlint's contract pass (CONTRACT_CONSTANTS), so editing one side
+# alone fails CI.
 _BREAKERS = int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.RESET_REMAINING)
 
 

@@ -7,6 +7,12 @@ ints on the hot-path objects (engine/service/managers) — zero
 contention on the decision path — and exported through one custom
 Collector at scrape time, which also serves as the test oracle
 (SURVEY.md §4.2: metrics-as-oracle tests).
+
+This file is the metric REGISTRY guberlint's drift pass anchors on:
+every ``*MetricFamily`` name constructed here must appear in the
+README catalog (or PERF/RESILIENCE/STATIC_ANALYSIS/bench_trend), and
+every documented ``gubernator_*`` series must still be constructed
+here — registering a metric without documenting it fails CI.
 """
 
 from __future__ import annotations

@@ -4,12 +4,15 @@
 # Runs exactly three things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
-#      JAX trace hygiene, thread lifecycle, and peer-network
-#      discipline — retry loops without backoff, peer RPCs (including
-#      the membership handoff's TransferBuckets sites) without an
-#      explicit timeout (STATIC_ANALYSIS.md); the pass's seeded bad
-#      fixtures run inside the tier-1 pytest below
-#      (tests/test_guberlint.py);
+#      JAX trace hygiene, thread lifecycle, peer-network discipline,
+#      the NATIVE tier (C guard/GIL/blocking/atomics over
+#      core/native/*.cpp), the Python<->C CONTRACT (wire layout,
+#      decision-plane constants, GUBER_* knobs), and knob/metric/doc
+#      DRIFT (STATIC_ANALYSIS.md); findings also land in
+#      guberlint.sarif so CI surfaces them as annotations, and the
+#      stage is held to a 10 s wall budget so it stays cheap enough to
+#      run first; the passes' seeded bad fixtures run inside the
+#      tier-1 pytest below (tests/test_guberlint.py);
 #   2. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
@@ -31,9 +34,18 @@ cd "$(dirname "$0")/.."
 ROUND="${1:-${BENCH_ROUND:-ci}}"
 
 echo "=== guberlint (static analysis vs baseline) ===" >&2
-if ! python -m tools.guberlint; then
+LINT_T0=$(date +%s%N)
+if ! python -m tools.guberlint --sarif guberlint.sarif; then
   echo "guberlint: NEW findings vs guberlint_baseline.json — fix or" >&2
-  echo "suppress with '# guberlint: ok <pass> — <why>' (STATIC_ANALYSIS.md)" >&2
+  echo "suppress with '# guberlint: ok <pass> — <why>' (STATIC_ANALYSIS.md;" >&2
+  echo "machine-readable findings in guberlint.sarif)" >&2
+  exit 1
+fi
+LINT_MS=$(( ($(date +%s%N) - LINT_T0) / 1000000 ))
+echo "guberlint: ${LINT_MS} ms (budget 10000 ms)" >&2
+if [ "${LINT_MS}" -gt 10000 ]; then
+  echo "guberlint: blew its 10 s budget — it must stay cheap enough" >&2
+  echo "to run as ci_fast stage one; profile the new pass" >&2
   exit 1
 fi
 

@@ -189,3 +189,77 @@ def test_daemon_threads_reaped_on_close():
         and t.is_alive()
     ]
     assert leftover == []
+
+
+def test_membership_close_joins_shipper_snapshotted_under_lock():
+    """MembershipManager.close() read self._shipper OUTSIDE _lock
+    while discovery watch threads swap it in apply_view: a torn read
+    could join a superseded thread while the freshly-spawned shipper
+    outlived close().  Post-fix, _closed and the shipper snapshot are
+    taken atomically, so every transition thread ever spawned is dead
+    once close() returns and no apply_view can start one afterwards
+    (the post-PR-3 sender/receiver-state audit; guberlint lock pass
+    now declares _shipper/_closed guarded)."""
+    from types import SimpleNamespace
+
+    from gubernator_tpu.cluster.membership import MembershipManager
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.types import PeerInfo
+
+    daemon = SimpleNamespace(conf=DaemonConfig(), instance=None)
+    mem = MembershipManager(daemon, epoch_timeout=5.0)
+    spawned = []
+    orig_transition = MembershipManager._transition
+
+    def tracked(self, epoch, prev, window):
+        spawned.append(threading.current_thread())
+        # Hold the transition open until close() signals shutdown, so
+        # close always races a live shipper.
+        self._stop.wait(timeout=10.0)
+        orig_transition(self, epoch, prev, window)
+
+    try:
+        MembershipManager._transition = tracked
+        views = [
+            [PeerInfo(grpc_address=f"10.1.0.{i}:81") for i in range(n)]
+            for n in (2, 3, 4, 5)
+        ]
+        mem.apply_view(views[0])  # boot: no transition
+        mem.apply_view(views[1])  # live shipper, parked on _stop
+        errs = []
+
+        def guarded(fn, *args):
+            try:
+                fn(*args)
+            except Exception as e:  # noqa: BLE001 — the assert below
+                errs.append(e)
+
+        racers = [
+            threading.Thread(
+                target=guarded, args=(mem.apply_view, v), daemon=True
+            )
+            for v in views[2:]
+        ]
+        closer = threading.Thread(
+            target=guarded, args=(mem.close,), daemon=True
+        )
+        for t in racers + [closer]:
+            t.start()
+        for t in racers + [closer]:
+            t.join(timeout=30)
+        assert not closer.is_alive(), "close() wedged"
+        # A close() that died (e.g. joining a published-but-unstarted
+        # shipper raises RuntimeError) is not alive either — the crash
+        # must fail the test, not hide in a thread-exception warning.
+        assert errs == []
+        for t in spawned:
+            t.join(timeout=10)
+        assert all(not t.is_alive() for t in spawned), (
+            "a shipper thread outlived close()"
+        )
+        assert mem.apply_view(views[0]) is False, (
+            "apply_view after close must be a no-op"
+        )
+    finally:
+        MembershipManager._transition = orig_transition
+        mem.close()

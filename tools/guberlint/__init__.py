@@ -1,15 +1,30 @@
 """guberlint — repo-native static analysis for gubernator_tpu.
 
-Three AST passes over the concurrent host tier (STATIC_ANALYSIS.md):
+Seven passes over the concurrent host tier AND the native C decision
+plane (STATIC_ANALYSIS.md):
 
-- ``lock``   — guarded-attribute discipline + lock acquisition-order
+- ``lock``     — guarded-attribute discipline + lock acquisition-order
   inversions (tools/guberlint/lockcheck.py);
-- ``trace``  — JAX trace hygiene over the jit-reachable kernel code
+- ``trace``    — JAX trace hygiene over the jit-reachable kernel code
   (tools/guberlint/tracecheck.py);
-- ``thread`` — daemon-thread lifecycle + silent exception swallowing
-  (tools/guberlint/threadcheck.py).
+- ``thread``   — daemon-thread lifecycle + silent exception swallowing
+  (tools/guberlint/threadcheck.py);
+- ``net``      — peer-network discipline: retry backoff + RPC timeouts
+  (tools/guberlint/netcheck.py);
+- ``native``   — C tier over core/native/*.cpp: mutex guard
+  discipline, GIL-freedom, blocking-calls-under-mutex, atomics
+  memory-order audit (tools/guberlint/nativecheck.py, parsed by
+  tools/guberlint/csource.py);
+- ``contract`` — the Python<->C boundary pinned bit-equal: wire field
+  layout vs the proto, decision-plane protocol constants vs
+  core/ledger.py, C GUBER_* reads vs config.py
+  (tools/guberlint/contractcheck.py);
+- ``drift``    — knob/metric/doc surface: every GUBER_* read has a
+  config.py home + README row, every registered metric is documented
+  (tools/guberlint/driftcheck.py).
 
-Run locally with ``python -m tools.guberlint``; CI fails on findings
+Run locally with ``python -m tools.guberlint`` (``--only <pass>`` for
+fast iteration, ``--sarif`` for CI annotations); CI fails on findings
 not present in the committed ``guberlint_baseline.json``.
 """
 

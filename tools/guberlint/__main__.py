@@ -7,11 +7,15 @@ Options:
   --baseline FILE    baseline JSON (default: guberlint_baseline.json
                      at the repo root)
   --write-baseline   rewrite the baseline to the current finding set
-  --fix-annotations  insert `# guberlint: guarded-by` stubs for
-                     attributes whose every non-__init__ access already
-                     happens under one consistent lock (review the diff
-                     before committing)
+  --fix-annotations  insert guarded-by stubs (Python attributes AND C
+                     struct fields) whose every access already happens
+                     under one consistent lock (review the diff before
+                     committing)
+  --only PASS        run a single pass (lock/trace/thread/net/native/
+                     contract/drift) for fast local iteration
   --json             machine-readable output
+  --sarif [FILE]     write SARIF 2.1.0 (CI annotations); with no FILE,
+                     SARIF replaces the console output
   --no-baseline      ignore the baseline (report everything)
 """
 
@@ -20,38 +24,96 @@ from __future__ import annotations
 import argparse
 import ast
 import json
+import re
 import sys
 from pathlib import Path
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from tools.guberlint import baseline as baseline_mod
-from tools.guberlint import lockcheck, netcheck, threadcheck, tracecheck
-from tools.guberlint.common import Finding, SourceFile, attr_path, iter_py_files
-from tools.guberlint.config import EXCLUDE, LINT_ROOTS, TRACE_SCOPES
+from tools.guberlint import (
+    contractcheck,
+    driftcheck,
+    lockcheck,
+    nativecheck,
+    netcheck,
+    threadcheck,
+    tracecheck,
+)
+from tools.guberlint.common import (
+    PASS_NAMES,
+    Finding,
+    SourceFile,
+    attr_path,
+    iter_py_files,
+)
+from tools.guberlint.config import (
+    EXCLUDE,
+    LINT_ROOTS,
+    NATIVE_ROOTS,
+    TRACE_SCOPES,
+)
+from tools.guberlint.csource import CSourceFile, iter_c_files
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
-def run(paths: List[Path]) -> List[Finding]:
-    files = iter_py_files(paths, REPO_ROOT, exclude=EXCLUDE)
+def run(
+    paths: List[Path],
+    only: Optional[str] = None,
+    repo_scope: Optional[bool] = None,
+) -> List[Finding]:
+    """Run the suite.  `paths` filters the per-file Python passes; the
+    native/contract passes scan config.NATIVE_ROOTS and the drift pass
+    scans the whole repo surface — those three run only when the
+    default roots are linted (`repo_scope`, inferred from `paths` when
+    not given) or when --only selects one directly, so a single-file
+    invocation stays a single-file report."""
+    if repo_scope is None:
+        repo_scope = sorted(paths) == sorted(
+            REPO_ROOT / r for r in LINT_ROOTS
+        )
+
+    def want(name: str) -> bool:
+        if name in ("native", "contract", "drift"):
+            return only == name or (only is None and repo_scope)
+        return only is None or only == name
+
     findings: List[Finding] = []
     edges: Set[Tuple[str, str, str, int]] = set()
-    for src in files:
-        if src.parse_error:
-            findings.append(
-                Finding(
-                    "meta", "parse-error", src.rel, 0, "<module>",
-                    "parse", f"syntax error: {src.parse_error}",
+    py_passes = any(want(p) for p in ("lock", "trace", "thread", "net"))
+    if py_passes:
+        for src in iter_py_files(paths, REPO_ROOT, exclude=EXCLUDE):
+            if src.parse_error:
+                findings.append(
+                    Finding(
+                        "meta", "parse-error", src.rel, 0, "<module>",
+                        "parse", f"syntax error: {src.parse_error}",
+                    )
                 )
-            )
-            continue
-        findings.extend(src.bad_suppressions)
-        findings.extend(lockcheck.check_file(src, edges))
-        if any(src.rel.startswith(s) for s in TRACE_SCOPES):
-            findings.extend(tracecheck.check_file(src))
-        findings.extend(threadcheck.check_file(src))
-        findings.extend(netcheck.check_file(src))
-    findings.extend(lockcheck.order_findings(edges))
+                continue
+            findings.extend(src.bad_suppressions)
+            if want("lock"):
+                findings.extend(lockcheck.check_file(src, edges))
+            if want("trace") and any(
+                src.rel.startswith(s) for s in TRACE_SCOPES
+            ):
+                findings.extend(tracecheck.check_file(src))
+            if want("thread"):
+                findings.extend(threadcheck.check_file(src))
+            if want("net"):
+                findings.extend(netcheck.check_file(src))
+        if want("lock"):
+            findings.extend(lockcheck.order_findings(edges))
+    if want("native") or want("contract") or want("drift"):
+        csrcs = iter_c_files(
+            [REPO_ROOT / r for r in NATIVE_ROOTS], REPO_ROOT
+        )
+        if want("native"):
+            findings.extend(nativecheck.check_files(csrcs))
+        if want("contract"):
+            findings.extend(contractcheck.check(csrcs, REPO_ROOT))
+        if want("drift"):
+            findings.extend(driftcheck.check(REPO_ROOT, csrcs))
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 
@@ -125,6 +187,78 @@ def _declared_attrs(src: SourceFile, cls: ast.ClassDef) -> Set[str]:
     return declared
 
 
+def fix_c_annotations(paths: List[Path]) -> int:
+    """C twin of fix_annotations: insert `// guberlint: guarded-by
+    <mutex>` stubs on struct-field declaration lines whose every
+    access across the scanned sources happens under one consistent
+    mutex.  Conservative: any unlocked access or mixed mutexes skips
+    the field."""
+    from tools.guberlint.csource import _code_line, _field_names
+
+    csrcs = iter_c_files(paths, REPO_ROOT)
+    inserted = 0
+    for src in csrcs:
+        field_lines: Dict[Tuple[str, str], int] = {}
+        declared: Set[Tuple[str, str]] = set()
+        fn_spans = [(f.body_start, f.body_end) for f in src.functions]
+        for s in src.structs:
+            for attr in s.guards:
+                declared.add((s.name, attr))
+            first, last = src.line_of(s.start), src.line_of(s.end)
+            for ln in range(first + 1, last + 1):
+                off = src._line_starts[ln - 1]
+                if any(a < off < b for a, b in fn_spans):
+                    continue  # a local inside a member function body
+                decl = _code_line(src.code, src._line_starts, ln)
+                if "mutex" in decl or "atomic" in decl \
+                        or "condition_variable" in decl:
+                    continue  # locks/atomics are not guarded data
+                if "constexpr" in decl or "static" in decl:
+                    continue  # compile-time constants need no guard
+                for name in _field_names(decl):
+                    field_lines.setdefault((s.name, name), ln)
+        if not field_lines:
+            continue
+        usage: Dict[Tuple[str, str], Set[Optional[str]]] = {}
+        for fn in src.functions:
+            body = src.code[fn.body_start:fn.body_end]
+            for (sname, attr), _ln in field_lines.items():
+                for m in re.finditer(
+                    r"(?:([A-Za-z_]\w*)\s*(?:->|\.)\s*)?\b%s\b"
+                    % re.escape(attr), body,
+                ):
+                    recv = m.group(1) or ""
+                    if not recv and fn.struct != sname:
+                        continue
+                    held = src.held_at(fn, fn.body_start + m.start())
+                    mutexes = {
+                        mu for r, mu in held
+                        if mu != "*" and (r == "" or r == recv or recv == "")
+                    }
+                    usage.setdefault((sname, attr), set()).add(
+                        next(iter(mutexes)) if len(mutexes) == 1
+                        else (sorted(mutexes)[0] if mutexes else None)
+                    )
+        new_lines = list(src.lines)
+        changed = False
+        for key, locks in sorted(usage.items()):
+            if key in declared or None in locks or len(locks) != 1:
+                continue
+            ln = field_lines[key] - 1
+            if "guberlint" in new_lines[ln]:
+                continue
+            new_lines[ln] = (
+                new_lines[ln].rstrip()
+                + f"  // guberlint: guarded-by {next(iter(locks))}"
+            )
+            changed = True
+            inserted += 1
+        if changed:
+            src.path.write_text("\n".join(new_lines) + "\n")
+            print(f"annotated {src.rel}")
+    return inserted
+
+
 def _attr_lock_usage(cls: ast.ClassDef) -> Dict[str, Set[str]]:
     """attr -> set of lock names (None = some unlocked access) over
     every method except __init__."""
@@ -162,6 +296,65 @@ def _attr_lock_usage(cls: ast.ClassDef) -> Dict[str, Set[str]]:
     return usage
 
 
+# -- SARIF -------------------------------------------------------------
+
+
+def to_sarif(findings: List[Finding]) -> dict:
+    """SARIF 2.1.0 document for CI annotation surfaces: one rule per
+    (pass, rule), one result per finding."""
+    rules: Dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rule_id = f"{f.pass_name}/{f.rule}"
+        rules.setdefault(
+            rule_id,
+            {
+                "id": rule_id,
+                "shortDescription": {"text": f.rule},
+                "helpUri": "STATIC_ANALYSIS.md",
+            },
+        )
+        results.append(
+            {
+                "ruleId": rule_id,
+                "level": "error",
+                "message": {"text": f"{f.scope}: {f.message}"},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.file},
+                            "region": {"startLine": max(1, f.line)},
+                        }
+                    }
+                ],
+                "fingerprints": {
+                    "guberlint/v1": ":".join(f.fingerprint()),
+                },
+            }
+        )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "guberlint",
+                        "informationUri": "STATIC_ANALYSIS.md",
+                        "rules": sorted(
+                            rules.values(), key=lambda r: r["id"]
+                        ),
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
 # -- CLI ---------------------------------------------------------------
 
 
@@ -172,7 +365,16 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--fix-annotations", action="store_true")
+    ap.add_argument(
+        "--only", choices=PASS_NAMES, default=None,
+        help="run a single pass (fast local iteration)",
+    )
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--sarif", nargs="?", const="-", default=None, metavar="FILE",
+        help="write SARIF 2.1.0 to FILE (console output kept); with "
+        "no FILE, SARIF replaces the console output",
+    )
     args = ap.parse_args(argv)
 
     if args.paths:
@@ -194,10 +396,14 @@ def main(argv=None) -> int:
 
     if args.fix_annotations:
         n = fix_annotations(paths)
+        n += fix_c_annotations(
+            [REPO_ROOT / r for r in NATIVE_ROOTS]
+            if not args.paths else paths
+        )
         print(f"guberlint: inserted {n} guarded-by stub(s) — review the diff")
         return 0
 
-    findings = run(paths)
+    findings = run(paths, only=args.only)
     base_path = Path(args.baseline)
     base = set() if args.no_baseline else baseline_mod.load(base_path)
 
@@ -210,6 +416,12 @@ def main(argv=None) -> int:
         return 0
 
     new, accepted, stale = baseline_mod.partition(findings, base)
+    if args.sarif is not None:
+        doc = json.dumps(to_sarif(new), indent=2)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            Path(args.sarif).write_text(doc + "\n")
     if args.as_json:
         print(
             json.dumps(
@@ -221,6 +433,8 @@ def main(argv=None) -> int:
                 indent=2,
             )
         )
+    elif args.sarif == "-":
+        pass  # SARIF replaced the console report
     else:
         for f in new:
             print(f.render())
@@ -236,11 +450,12 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 1
-    print(
-        f"guberlint: clean ({len(accepted)} baselined, "
-        f"{len(stale)} stale baseline entr{'y' if len(stale)==1 else 'ies'})"
-        if (accepted or stale) else "guberlint: clean"
-    )
+    if not (args.as_json or args.sarif == "-"):
+        print(
+            f"guberlint: clean ({len(accepted)} baselined, "
+            f"{len(stale)} stale baseline entr{'y' if len(stale)==1 else 'ies'})"
+            if (accepted or stale) else "guberlint: clean"
+        )
     return 0
 
 

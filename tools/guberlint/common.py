@@ -27,7 +27,7 @@ import re
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-PASS_NAMES = ("lock", "trace", "thread", "net")
+PASS_NAMES = ("lock", "trace", "thread", "net", "native", "contract", "drift")
 
 # Reason separator accepts em/en dash, hyphen, or colon.
 _SUPPRESS_RE = re.compile(

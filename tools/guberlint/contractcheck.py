@@ -1,0 +1,493 @@
+"""Pass 6 — the Python↔C boundary, pinned bit-equal.
+
+Three surfaces (STATIC_ANALYSIS.md documents grammar and limits):
+
+1. **Wire layout** (``contract-wire-*``): every codec function in the
+   native sources carries ``// guberlint: wire <Message>
+   <field>=<num>:<kind>`` annotations.  The pass parses the .proto
+   files (the source the Python codec is generated from) and checks
+   each annotation three ways: the message exists, every declared
+   field matches the proto's number AND wire kind
+   (len/varint/64bit/32bit), and the function body actually uses
+   exactly the declared field numbers (recognized idioms: ``(N << 3)``
+   tag builds, ``case N:`` / ``field == N`` / ``sf == N`` decode
+   dispatch, ``field >= A && field <= B`` ranges, and hex tag-byte
+   ``push_back(0xNN)``).  Mutating the proto, the annotation, or the
+   C literals trips it — the three can only move together.
+2. **Protocol constants** (``contract-constant-mismatch``):
+   config.CONTRACT_CONSTANTS pairs (decision-plane record kinds vs
+   core/ledger.py's _K_* states, the lease breaker mask vs the bridge
+   copy) must be numerically identical.  C values parse from
+   constexpr/const declarations; Python values evaluate module-level
+   int expressions (types.py enum members resolve).
+3. **Enums** (``contract-enum-mismatch``): every proto enum member
+   must exist in its types.py IntEnum twin with the same value
+   (Python may extend — Behavior.SKETCH has no wire presence).
+4. **Knobs** (``contract-knob-homeless``): every ``getenv("GUBER_*")``
+   in the native sources must appear in config.py (the canonical
+   env-surface index) — a C-only knob is invisible to operators.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.guberlint.common import Finding
+from tools.guberlint.config import (
+    CONTRACT_CONSTANTS,
+    ENUM_CONTRACTS,
+    KNOB_HOME,
+    PROTO_FILES,
+)
+from tools.guberlint.csource import CSourceFile
+
+PASS = "contract"
+
+# proto scalar type -> wire kind (proto3 wire format).
+_WIRE_KINDS = {
+    "int32": "varint", "int64": "varint", "uint32": "varint",
+    "uint64": "varint", "sint32": "varint", "sint64": "varint",
+    "bool": "varint", "enum": "varint",
+    "fixed64": "64bit", "sfixed64": "64bit", "double": "64bit",
+    "fixed32": "32bit", "sfixed32": "32bit", "float": "32bit",
+    "string": "len", "bytes": "len", "message": "len", "map": "len",
+}
+
+_FIELD_NUM_PATTERNS = (
+    re.compile(r"\((\d+)\s*<<\s*3\)"),
+    re.compile(r"\bcase\s+(\d+)\s*:"),
+    re.compile(r"\b(?:field|sf|f)\s*==\s*(\d+)"),
+    re.compile(r"\(\s*(?:tag|t)\s*>>\s*3\s*\)\s*[!=]=\s*(\d+)"),
+)
+_FIELD_RANGE_PATTERNS = (
+    re.compile(
+        r"\b(?:field|sf)\s*>=\s*(\d+)\s*&&\s*(?:field|sf)\s*<=\s*(\d+)"
+    ),
+)
+_TAG_BYTE_RE = re.compile(r"push_back\(0x([0-9a-fA-F]{1,2})\)")
+
+
+# -- proto parsing -----------------------------------------------------
+
+
+class ProtoSchema:
+    def __init__(self) -> None:
+        # message -> field name -> (number, wire kind)
+        self.messages: Dict[str, Dict[str, Tuple[int, str]]] = {}
+        # enum -> member -> value
+        self.enums: Dict[str, Dict[str, int]] = {}
+
+
+_PROTO_FIELD_RE = re.compile(
+    r"^\s*(?:repeated\s+|optional\s+)?"
+    r"(map\s*<[^>]*>|[\w.]+)\s+(\w+)\s*=\s*(\d+)\s*;"
+)
+_PROTO_ENUM_MEMBER_RE = re.compile(r"^\s*([A-Z][A-Z0-9_]*)\s*=\s*(\d+)\s*;")
+_PROTO_BLOCK_RE = re.compile(r"^\s*(message|enum)\s+(\w+)\s*\{")
+
+
+def parse_protos(paths: List[Path]) -> ProtoSchema:
+    schema = ProtoSchema()
+    for path in paths:
+        _parse_proto(path.read_text(), schema)
+    return schema
+
+
+def _parse_proto(text: str, schema: ProtoSchema) -> None:
+    text = re.sub(r"//[^\n]*", "", text)
+    # Block stack: (kind, name) entries pushed per '{'.
+    stack: List[Tuple[str, str]] = []
+    for line in text.splitlines():
+        m = _PROTO_BLOCK_RE.match(line)
+        if m:
+            stack.append((m.group(1), m.group(2)))
+            if m.group(1) == "message":
+                schema.messages.setdefault(m.group(2), {})
+            else:
+                schema.enums.setdefault(m.group(2), {})
+            continue
+        if re.match(r"^\s*(service|rpc|oneof)\b.*\{", line):
+            stack.append(("other", ""))
+            continue
+        if stack:
+            kind, name = stack[-1]
+            if kind == "enum":
+                em = _PROTO_ENUM_MEMBER_RE.match(line)
+                if em:
+                    schema.enums[name][em.group(1)] = int(em.group(2))
+            elif kind == "message":
+                fm = _PROTO_FIELD_RE.match(line)
+                if fm:
+                    ptype = fm.group(1).strip()
+                    if ptype.startswith("map"):
+                        wire = "len"
+                    else:
+                        base = ptype.split(".")[-1]
+                        wire = _WIRE_KINDS.get(base)
+                        if wire is None:
+                            # Message or enum reference.
+                            wire = (
+                                "varint"
+                                if base in schema.enums else "len"
+                            )
+                    schema.messages[name][fm.group(2)] = (
+                        int(fm.group(3)), wire,
+                    )
+        if "}" in line and stack:
+            stack.pop()
+
+
+# -- constant evaluation -----------------------------------------------
+
+
+def _cpp_constants(text: str) -> Dict[str, int]:
+    """Module-level constexpr/const integer declarations, including
+    comma-separated multi-declarations."""
+    out: Dict[str, int] = {}
+    for m in re.finditer(
+        r"\b(?:constexpr|const)\s+[\w:<>]+\s+([^;=]*=[^;]*);", text
+    ):
+        for chunk in m.group(1).split(","):
+            cm = re.match(
+                r"\s*([A-Za-z_]\w*)\s*=\s*(-?(?:0x[0-9a-fA-F]+|\d+))\s*$",
+                chunk,
+            )
+            if cm:
+                out[cm.group(1)] = int(cm.group(2), 0)
+    return out
+
+
+class _PyConstEvaluator:
+    """Evaluate module-level int constants in a .py file, resolving
+    enum attributes (Behavior.GLOBAL, Status.OVER_LIMIT, ...) through
+    the enum classes defined in gubernator_tpu/types.py."""
+
+    def __init__(self, repo_root: Path):
+        self.repo_root = repo_root
+        self._enums: Optional[Dict[str, Dict[str, int]]] = None
+        self._cache: Dict[str, Dict[str, Optional[int]]] = {}
+
+    def enums(self) -> Dict[str, Dict[str, int]]:
+        if self._enums is None:
+            self._enums = parse_py_enums(
+                self.repo_root / "gubernator_tpu" / "types.py"
+            )
+        return self._enums
+
+    def lookup(self, rel: str, symbol: str) -> Optional[int]:
+        if rel not in self._cache:
+            self._cache[rel] = self._module_constants(rel)
+        return self._cache[rel].get(symbol)
+
+    def _module_constants(self, rel: str) -> Dict[str, Optional[int]]:
+        path = self.repo_root / rel
+        out: Dict[str, Optional[int]] = {}
+        try:
+            tree = ast.parse(path.read_text())
+        except (OSError, SyntaxError):
+            return out
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = self._eval(node.value, out)
+        return out
+
+    def _eval(self, node: ast.AST, env: Dict[str, Optional[int]]) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return int(node.value)
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            return self.enums().get(node.value.id, {}).get(node.attr)
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Name) and func.id == "int"
+                and len(node.args) == 1
+            ):
+                return self._eval(node.args[0], env)
+            return None
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env)
+            right = self._eval(node.right, env)
+            if left is None or right is None:
+                return None
+            ops = {
+                ast.BitOr: lambda a, b: a | b,
+                ast.BitAnd: lambda a, b: a & b,
+                ast.BitXor: lambda a, b: a ^ b,
+                ast.Add: lambda a, b: a + b,
+                ast.Sub: lambda a, b: a - b,
+                ast.LShift: lambda a, b: a << b,
+                ast.Mult: lambda a, b: a * b,
+            }
+            fn = ops.get(type(node.op))
+            return fn(left, right) if fn else None
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self._eval(node.operand, env)
+            return -v if v is not None else None
+        return None
+
+
+def parse_py_enums(path: Path) -> Dict[str, Dict[str, int]]:
+    """IntEnum/IntFlag class bodies -> {class: {member: value}}."""
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return out
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        members: Dict[str, int] = {}
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, int)
+            ):
+                members[stmt.targets[0].id] = int(stmt.value.value)
+        if members:
+            out[node.name] = members
+    return out
+
+
+# -- the pass ----------------------------------------------------------
+
+
+def check(
+    csrcs: List[CSourceFile],
+    repo_root: Path,
+    *,
+    proto_files: Tuple[str, ...] = PROTO_FILES,
+    constants: Tuple[Tuple[str, str, str, str], ...] = CONTRACT_CONSTANTS,
+    enum_contracts: Tuple[Tuple[str, str], ...] = ENUM_CONTRACTS,
+    knob_home: str = KNOB_HOME,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    schema = parse_protos(
+        [repo_root / p for p in proto_files if (repo_root / p).exists()]
+    )
+    for src in csrcs:
+        _check_wire(src, schema, findings)
+        _check_getenv(src, repo_root, knob_home, findings)
+    _check_constants(csrcs, repo_root, constants, findings)
+    _check_enums(repo_root, schema, enum_contracts, findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def _check_wire(
+    src: CSourceFile, schema: ProtoSchema, findings: List[Finding]
+) -> None:
+    for fn in src.functions:
+        decls = src.wire_decls(fn)
+        if not decls:
+            continue
+        declared_nums: Set[int] = set()
+        for msg, fields, ln in decls:
+            proto_fields = schema.messages.get(msg)
+            if proto_fields is None:
+                if not src.suppressed(ln, PASS):
+                    findings.append(
+                        Finding(
+                            PASS, "wire-unknown-message", src.rel, ln,
+                            fn.name, f"{fn.name}:{msg}",
+                            f"wire annotation names message {msg!r} "
+                            "not found in the proto contract",
+                        )
+                    )
+                continue
+            for fname, (num, kind) in sorted(fields.items()):
+                declared_nums.add(num)
+                proto = proto_fields.get(fname)
+                if proto is None:
+                    if not src.suppressed(ln, PASS):
+                        findings.append(
+                            Finding(
+                                PASS, "wire-mismatch", src.rel, ln,
+                                fn.name, f"{msg}.{fname}",
+                                f"{msg}.{fname} declared in "
+                                f"{fn.name}'s wire annotation does "
+                                "not exist in the proto",
+                            )
+                        )
+                    continue
+                pnum, pkind = proto
+                if pnum != num or pkind != kind:
+                    if not src.suppressed(ln, PASS):
+                        findings.append(
+                            Finding(
+                                PASS, "wire-mismatch", src.rel, ln,
+                                fn.name, f"{msg}.{fname}",
+                                f"{msg}.{fname}: annotation says "
+                                f"{num}:{kind}, proto says "
+                                f"{pnum}:{pkind} — the codec and the "
+                                "Python contract have drifted",
+                            )
+                        )
+        # Code-literal check: the body must use exactly the declared
+        # field-number set through the recognized idioms.
+        used = _field_numbers(src.code[fn.body_start:fn.body_end])
+        anno_line = decls[0][2]
+        if src.suppressed(anno_line, PASS):
+            continue
+        for num in sorted(declared_nums - used):
+            findings.append(
+                Finding(
+                    PASS, "wire-unimplemented-field", src.rel,
+                    anno_line, fn.name, f"{fn.name}:{num}",
+                    f"{fn.name} declares wire field number {num} but "
+                    "its body never builds or dispatches on it",
+                )
+            )
+        for num in sorted(used - declared_nums):
+            findings.append(
+                Finding(
+                    PASS, "wire-undeclared-field", src.rel, anno_line,
+                    fn.name, f"{fn.name}:{num}",
+                    f"{fn.name} handles wire field number {num} that "
+                    "its annotation does not declare — declare it so "
+                    "the proto pin covers it",
+                )
+            )
+
+
+def _field_numbers(body: str) -> Set[int]:
+    out: Set[int] = set()
+    for pat in _FIELD_NUM_PATTERNS:
+        for m in pat.finditer(body):
+            out.add(int(m.group(1)))
+    for pat in _FIELD_RANGE_PATTERNS:
+        for m in pat.finditer(body):
+            out.update(range(int(m.group(1)), int(m.group(2)) + 1))
+    for m in _TAG_BYTE_RE.finditer(body):
+        b = int(m.group(1), 16)
+        field, wt = b >> 3, b & 7
+        if field >= 1 and wt in (0, 1, 2, 5):
+            out.add(field)
+    return out
+
+
+def _check_getenv(
+    src: CSourceFile, repo_root: Path, knob_home: str,
+    findings: List[Finding],
+) -> None:
+    home_path = repo_root / knob_home
+    home_text = home_path.read_text() if home_path.exists() else ""
+    code = src.code
+    for lineno, value in src.strings:
+        if not value.startswith("GUBER_"):
+            continue
+        # Only getenv("...") reads count (docs/log strings don't).
+        line_code = src.lines[lineno - 1] if lineno <= len(src.lines) else ""
+        prev_code = src.lines[lineno - 2] if lineno >= 2 else ""
+        if "getenv" not in line_code and "getenv" not in prev_code:
+            continue
+        if value in home_text:
+            continue
+        if src.suppressed(lineno, PASS):
+            continue
+        findings.append(
+            Finding(
+                PASS, "knob-homeless", src.rel, lineno, "<module>",
+                value,
+                f"C reads {value} but {knob_home} (the canonical "
+                "GUBER_* index) never mentions it — a C-only knob is "
+                "invisible to operators",
+            )
+        )
+
+
+def _check_constants(
+    csrcs: List[CSourceFile],
+    repo_root: Path,
+    constants: Tuple[Tuple[str, str, str, str], ...],
+    findings: List[Finding],
+) -> None:
+    ev = _PyConstEvaluator(repo_root)
+    cpp_cache: Dict[str, Dict[str, int]] = {}
+
+    def value_of(rel: str, symbol: str) -> Optional[int]:
+        if rel.endswith((".cpp", ".cc", ".c", ".h", ".hpp")):
+            if rel not in cpp_cache:
+                for src in csrcs:
+                    if src.rel == rel:
+                        cpp_cache[rel] = _cpp_constants(src.code)
+                        break
+                else:
+                    path = repo_root / rel
+                    cpp_cache[rel] = (
+                        _cpp_constants(path.read_text())
+                        if path.exists() else {}
+                    )
+            return cpp_cache[rel].get(symbol)
+        return ev.lookup(rel, symbol)
+
+    for file_a, sym_a, file_b, sym_b in constants:
+        va = value_of(file_a, sym_a)
+        vb = value_of(file_b, sym_b)
+        detail = f"{file_a}:{sym_a}<->{file_b}:{sym_b}"
+        if va is None or vb is None:
+            missing = f"{file_a}:{sym_a}" if va is None else f"{file_b}:{sym_b}"
+            findings.append(
+                Finding(
+                    PASS, "constant-unresolved", file_a, 0, "<module>",
+                    detail,
+                    f"contract constant {missing} could not be "
+                    "resolved — the pinned pair no longer parses "
+                    "(renamed or restructured?)",
+                )
+            )
+            continue
+        if va != vb:
+            findings.append(
+                Finding(
+                    PASS, "constant-mismatch", file_a, 0, "<module>",
+                    detail,
+                    f"{file_a}:{sym_a} = {va} but {file_b}:{sym_b} = "
+                    f"{vb} — the two tiers of the protocol have "
+                    "drifted",
+                )
+            )
+
+
+def _check_enums(
+    repo_root: Path,
+    schema: ProtoSchema,
+    enum_contracts: Tuple[Tuple[str, str], ...],
+    findings: List[Finding],
+) -> None:
+    for enum_name, py_rel in enum_contracts:
+        proto_members = schema.enums.get(enum_name)
+        if proto_members is None:
+            continue
+        py_enums = parse_py_enums(repo_root / py_rel)
+        py_members = py_enums.get(enum_name)
+        if py_members is None:
+            findings.append(
+                Finding(
+                    PASS, "enum-mismatch", py_rel, 0, enum_name,
+                    f"{enum_name}:<missing>",
+                    f"proto enum {enum_name} has no {py_rel} twin",
+                )
+            )
+            continue
+        for member, value in sorted(proto_members.items()):
+            pv = py_members.get(member)
+            if pv != value:
+                findings.append(
+                    Finding(
+                        PASS, "enum-mismatch", py_rel, 0, enum_name,
+                        f"{enum_name}.{member}",
+                        f"{enum_name}.{member} is {value} on the wire "
+                        f"but {pv} in {py_rel} — enum drift",
+                    )
+                )

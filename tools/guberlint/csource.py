@@ -1,0 +1,535 @@
+"""Structural C/C++ parsing layer for the native guberlint tier.
+
+Stdlib-only, deliberately NOT a compiler: a comment/string-aware
+scanner plus brace matching gives the passes what they need —
+function spans, struct spans with annotated fields, lexical
+lock-guard regions, string literals, and the same annotation /
+suppression grammar the Python tier uses (STATIC_ANALYSIS.md
+documents the full contract and its limits).
+
+Annotation grammar mirrored from the Python side:
+
+- ``// guberlint: guarded-by <mutex>`` — trailing comment on a struct
+  field declaration: every access outside a ``*_locked`` function (or
+  one annotated ``holds``) must happen while a
+  ``std::lock_guard``/``unique_lock``/``scoped_lock`` on the SAME
+  receiver's ``<mutex>`` is lexically live.
+- ``// guberlint: guard a, b by <mutex>`` — per-struct registry form.
+- ``// guberlint: holds <mutex>[, ...]`` — on (or directly above) a
+  function signature: the function is documented to be CALLED with
+  those mutexes held.
+- ``// guberlint: gil-free`` — on (or above) a function: no ``Py*``
+  API call and no GIL-acquiring trampoline (config.NATIVE_GIL_CALLS)
+  may be reachable from it through functions defined in the scanned
+  native sources.
+- ``// guberlint: wire <Message> <field>=<num>:<kind> ...`` — on (or
+  above) a codec function: declares the wire layout the body
+  implements; the contract pass pins it against the .proto AND
+  against the field-number literals in the body.
+- ``// guberlint: ok <pass> — <reason>`` — suppression, same grammar
+  as Python (a reasonless one is itself a finding).
+
+Documented limits (by design — this is a lexical analyzer):
+
+- Lock regions are lexical: a mutex held across a lambda that escapes
+  the scope (stored callback) is still counted held inside the lambda
+  body.  The repo's native code only uses lambdas for thread bodies
+  and cv predicates, where the lexical reading is the correct one.
+- Constructor/destructor bodies are exempt from the guard check
+  (construction happens before publication, like Python __init__).
+- Receiver matching is textual: ``p->items`` needs ``lock(p->mu)``;
+  aliasing through references is out of scope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.guberlint.common import Finding, PASS_NAMES
+
+_SUPPRESS_RE = re.compile(
+    r"//\s*guberlint:\s*ok\s+(\w+)\s*(?:[—–:-]+\s*(.*))?$"
+)
+_GUARDED_RE = re.compile(r"//\s*guberlint:\s*guarded-by\s+([A-Za-z_]\w*)")
+_GUARD_STRUCT_RE = re.compile(
+    r"//\s*guberlint:\s*guard\s+([\w,\s]+?)\s+by\s+([A-Za-z_]\w*)"
+)
+_HOLDS_RE = re.compile(r"//\s*guberlint:\s*holds\s+([\w.>-]+(?:\s*,\s*[\w.>-]+)*)")
+_GILFREE_RE = re.compile(r"//\s*guberlint:\s*gil-free\b")
+_WIRE_RE = re.compile(r"//\s*guberlint:\s*wire\s+(\w+)\s+(.*)$")
+_WIRE_FIELD_RE = re.compile(r"([A-Za-z_]\w*)=(\d+):(\w+)")
+
+_LOCK_RE = re.compile(
+    r"(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"<[^;{}]*>\s*\w+\s*\(([^;]*?)\)\s*[;)]"
+)
+_RECV_RE = re.compile(r"^([A-Za-z_]\w*)\s*(?:->|\.)\s*([A-Za-z_]\w*)$")
+_STRUCT_RE = re.compile(r"\b(?:struct|class)\s+([A-Za-z_]\w*)\s*(?::[^{;]*)?\{")
+_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+_STRING_RE = re.compile(r'"((?:[^"\\]|\\.)*)"')
+
+_CONTROL = {
+    "if", "for", "while", "switch", "catch", "return", "sizeof",
+    "alignof", "static_assert", "defined", "throw", "assert",
+}
+_POST_SIG = {"const", "noexcept", "override", "final"}
+
+
+@dataclasses.dataclass
+class CStruct:
+    name: str
+    start: int  # char offset of '{'
+    end: int    # char offset of matching '}'
+    start_line: int
+    guards: Dict[str, str] = dataclasses.field(default_factory=dict)
+    mutexes: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class CFunction:
+    name: str
+    name_line: int
+    body_start: int  # char offset of '{'
+    body_end: int    # char offset of matching '}'
+    start_line: int  # line of '{'
+    end_line: int
+    struct: Optional[str] = None  # owning struct, if a member
+
+
+@dataclasses.dataclass(frozen=True)
+class LockRegion:
+    start: int  # char offset where the guard is constructed
+    end: int    # char offset of the enclosing block's '}'
+    recv: str   # receiver text ('' = bare / implicit this)
+    mutex: str
+
+
+class CSourceFile:
+    """One parsed native source: blanked code + spans + annotations."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        # `code`: comments and string/char literal CONTENTS blanked to
+        # spaces (same length/line structure as `text`), so structural
+        # regexes never match inside either.
+        self.code, self.strings = _blank(self.text)
+        self._line_starts = _line_starts(self.text)
+        self.brace_match = _match_braces(self.code)
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.bad_suppressions: List[Finding] = []
+        self._scan_suppressions()
+        self.structs = self._scan_structs()
+        self.functions = self._scan_functions()
+
+    # -- positions -----------------------------------------------------
+
+    def line_of(self, offset: int) -> int:
+        """1-based line number of a char offset."""
+        lo, hi = 0, len(self._line_starts) - 1
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if self._line_starts[mid] <= offset:
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo + 1
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    # -- suppressions / annotations ------------------------------------
+
+    def _scan_suppressions(self) -> None:
+        for i, raw in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            pass_name, reason = m.group(1), (m.group(2) or "").strip()
+            if pass_name not in PASS_NAMES:
+                self.bad_suppressions.append(
+                    Finding(
+                        "meta", "bad-suppression", self.rel, i, "<module>",
+                        f"unknown-pass:{pass_name}",
+                        f"suppression names unknown pass {pass_name!r} "
+                        f"(one of {PASS_NAMES})",
+                    )
+                )
+                continue
+            if not reason:
+                self.bad_suppressions.append(
+                    Finding(
+                        "meta", "bad-suppression", self.rel, i, "<module>",
+                        f"missing-reason:{pass_name}:{i}",
+                        "suppression without a reason — write "
+                        "'// guberlint: ok %s — <why>'" % pass_name,
+                    )
+                )
+                continue
+            target = i
+            if raw.lstrip().startswith("//"):
+                for j in range(i + 1, len(self.lines) + 1):
+                    s = self.lines[j - 1].strip()
+                    if s and not s.startswith("//"):
+                        target = j
+                        break
+            self.suppressions.setdefault(target, set()).add(pass_name)
+
+    def suppressed(self, line: int, pass_name: str) -> bool:
+        return pass_name in self.suppressions.get(line, set())
+
+    def _sig_lines(self, fn: CFunction) -> List[int]:
+        """Lines an annotation for `fn` may live on: the signature
+        line, the line above it, and the '{' line."""
+        return [fn.name_line - 1, fn.name_line, fn.start_line]
+
+    def holds(self, fn: CFunction) -> Set[str]:
+        out: Set[str] = set()
+        for ln in self._sig_lines(fn):
+            m = _HOLDS_RE.search(self.line_text(ln))
+            if m:
+                out |= {s.strip() for s in m.group(1).split(",") if s.strip()}
+        return out
+
+    def gil_free(self, fn: CFunction) -> bool:
+        lines = set(self._sig_lines(fn))
+        ln = min(lines) - 1
+        while ln >= 1 and self.line_text(ln).lstrip().startswith("//"):
+            lines.add(ln)
+            ln -= 1
+        return any(
+            _GILFREE_RE.search(self.line_text(ln)) for ln in sorted(lines)
+        )
+
+    def wire_decls(self, fn: CFunction) -> List[Tuple[str, Dict[str, Tuple[int, str]], int]]:
+        """[(message, {field: (number, kind)}, lineno)] declared on the
+        signature lines and contiguous comment block above them."""
+        out = []
+        lines = set(self._sig_lines(fn))
+        # Walk the contiguous // block above the signature.
+        ln = min(lines) - 1
+        while ln >= 1 and self.line_text(ln).lstrip().startswith("//"):
+            lines.add(ln)
+            ln -= 1
+        for ln in sorted(lines):
+            m = _WIRE_RE.search(self.line_text(ln))
+            if not m:
+                continue
+            fields = {
+                f: (int(num), kind)
+                for f, num, kind in _WIRE_FIELD_RE.findall(m.group(2))
+            }
+            out.append((m.group(1), fields, ln))
+        return out
+
+    # -- structure -----------------------------------------------------
+
+    def _scan_structs(self) -> List[CStruct]:
+        out: List[CStruct] = []
+        for m in _STRUCT_RE.finditer(self.code):
+            open_brace = m.end() - 1
+            close = self.brace_match.get(open_brace)
+            if close is None:
+                continue
+            s = CStruct(
+                m.group(1), open_brace, close, self.line_of(m.start())
+            )
+            self._collect_guards(s)
+            out.append(s)
+        return out
+
+    def _collect_guards(self, s: CStruct) -> None:
+        first, last = self.line_of(s.start), self.line_of(s.end)
+        for ln in range(first, last + 1):
+            raw = self.line_text(ln)
+            gm = _GUARD_STRUCT_RE.search(raw)
+            if gm:
+                for attr in re.split(r"[,\s]+", gm.group(1).strip()):
+                    if attr:
+                        s.guards[attr] = gm.group(2)
+                        s.mutexes.add(gm.group(2))
+                continue
+            m = _GUARDED_RE.search(raw)
+            if not m:
+                continue
+            for name in _field_names(_code_line(self.code, self._line_starts, ln)):
+                s.guards[name] = m.group(1)
+                s.mutexes.add(m.group(1))
+
+    def _scan_functions(self) -> List[CFunction]:
+        out: List[CFunction] = []
+        code = self.code
+        struct_spans = [(s.start, s.end, s.name) for s in self.structs]
+        for open_brace, close in self.brace_match.items():
+            name, name_pos = _function_name_before(code, open_brace)
+            if not name or name in _CONTROL:
+                continue
+            owner = None
+            for st, en, sname in struct_spans:
+                if st < open_brace < en:
+                    owner = sname
+            if owner and (name == owner or name == "~" + owner):
+                continue  # constructor/destructor: pre-publication
+            out.append(
+                CFunction(
+                    name=name,
+                    name_line=self.line_of(name_pos),
+                    body_start=open_brace,
+                    body_end=close,
+                    start_line=self.line_of(open_brace),
+                    end_line=self.line_of(close),
+                    struct=owner,
+                )
+            )
+        out.sort(key=lambda f: f.body_start)
+        # Drop spans nested inside another function span (lambdas that
+        # happened to parse function-like): the outer span covers them.
+        top: List[CFunction] = []
+        for f in out:
+            if top and top[-1].body_end > f.body_end:
+                continue
+            top.append(f)
+        return top
+
+    # -- lock regions --------------------------------------------------
+
+    def lock_regions(self, fn: CFunction) -> List[LockRegion]:
+        out: List[LockRegion] = []
+        body = self.code[fn.body_start:fn.body_end]
+        opens = sorted(
+            b for b in self.brace_match
+            if fn.body_start <= b <= fn.body_end
+        )
+        for m in _LOCK_RE.finditer(body):
+            pos = fn.body_start + m.start()
+            # Innermost block containing the guard construction.
+            enclosing = fn.body_start
+            for b in opens:
+                if b < pos < self.brace_match[b]:
+                    enclosing = b
+            end = self.brace_match[enclosing]
+            for arg in _split_args(m.group(1)):
+                arg = arg.strip()
+                if not arg or "defer_lock" in arg or "adopt_lock" in arg:
+                    continue
+                rm = _RECV_RE.match(arg)
+                if rm:
+                    out.append(LockRegion(pos, end, rm.group(1), rm.group(2)))
+                elif re.fullmatch(r"[A-Za-z_]\w*", arg):
+                    out.append(LockRegion(pos, end, "", arg))
+        return out
+
+    def held_at(self, fn: CFunction, offset: int) -> Set[Tuple[str, str]]:
+        """(recv, mutex) pairs lexically held at `offset` in `fn`,
+        including `holds` annotations and the *_locked convention
+        (reported as the wildcard ('', '*'))."""
+        held: Set[Tuple[str, str]] = set()
+        for r in self.lock_regions(fn):
+            if r.start <= offset <= r.end:
+                held.add((r.recv, r.mutex))
+        for h in self.holds(fn):
+            rm = _RECV_RE.match(h)
+            if rm:
+                held.add((rm.group(1), rm.group(2)))
+            else:
+                held.add(("", h))
+        if fn.name.endswith("_locked"):
+            held.add(("", "*"))
+        return held
+
+
+# -- low-level helpers -------------------------------------------------
+
+
+def _blank(text: str) -> Tuple[str, List[Tuple[int, str]]]:
+    """Blank comments and string/char contents to spaces (newlines
+    kept).  Returns (code, [(lineno, string_literal_value)])."""
+    out = list(text)
+    strings: List[Tuple[int, str]] = []
+    i, n = 0, len(text)
+    line = 1
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                out[i] = " "
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "*":
+            out[i] = out[i + 1] = " "
+            i += 2
+            while i + 1 < n and not (text[i] == "*" and text[i + 1] == "/"):
+                if text[i] == "\n":
+                    line += 1
+                else:
+                    out[i] = " "
+                i += 1
+            if i + 1 < n:
+                out[i] = out[i + 1] = " "
+                i += 2
+            continue
+        if c in "\"'":
+            quote = c
+            start_line = line
+            i += 1
+            lit = []
+            while i < n and text[i] != quote:
+                if text[i] == "\\" and i + 1 < n:
+                    lit.append(text[i:i + 2])
+                    out[i] = out[i + 1] = " "
+                    i += 2
+                    continue
+                if text[i] == "\n":
+                    line += 1
+                    i += 1
+                    continue
+                lit.append(text[i])
+                out[i] = " "
+                i += 1
+            if i < n:
+                i += 1  # closing quote (kept in `code`)
+            if quote == '"':
+                strings.append((start_line, "".join(lit)))
+            continue
+        i += 1
+    return "".join(out), strings
+
+
+def _line_starts(text: str) -> List[int]:
+    starts = [0]
+    for i, c in enumerate(text):
+        if c == "\n":
+            starts.append(i + 1)
+    return starts
+
+
+def _match_braces(code: str) -> Dict[int, int]:
+    match: Dict[int, int] = {}
+    stack: List[int] = []
+    for i, c in enumerate(code):
+        if c == "{":
+            stack.append(i)
+        elif c == "}" and stack:
+            match[stack.pop()] = i
+    return match
+
+
+def _function_name_before(code: str, open_brace: int) -> Tuple[str, int]:
+    """Function name owning the body at `open_brace`, or ('', 0).
+    Walks back over trailing qualifiers and the parameter list."""
+    i = open_brace - 1
+    while True:
+        while i >= 0 and code[i].isspace():
+            i -= 1
+        if i < 0:
+            return "", 0
+        # Trailing qualifiers between ')' and '{'.
+        if code[i].isalpha() or code[i] == "_":
+            j = i
+            while j >= 0 and (code[j].isalnum() or code[j] == "_"):
+                j -= 1
+            word = code[j + 1:i + 1]
+            if word in _POST_SIG:
+                i = j
+                continue
+            return "", 0  # `struct X {`, `namespace {`, init lists...
+        break
+    if code[i] != ")":
+        return "", 0
+    depth = 0
+    while i >= 0:
+        if code[i] == ")":
+            depth += 1
+        elif code[i] == "(":
+            depth -= 1
+            if depth == 0:
+                break
+        i -= 1
+    if i < 0:
+        return "", 0
+    i -= 1
+    while i >= 0 and code[i].isspace():
+        i -= 1
+    j = i
+    while j >= 0 and (code[j].isalnum() or code[j] == "_" or code[j] == "~"):
+        j -= 1
+    name = code[j + 1:i + 1]
+    # Strip a qualifying Class:: prefix if present.
+    if j >= 1 and code[j] == ":" and code[j - 1] == ":":
+        pass  # name already holds the unqualified tail
+    return name, j + 1 if name else 0
+
+
+def _split_args(s: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for c in s:
+        if c in "(<[":
+            depth += 1
+        elif c in ")>]":
+            depth -= 1
+        if c == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    out.append("".join(cur))
+    return out
+
+
+def _code_line(code: str, line_starts: List[int], lineno: int) -> str:
+    start = line_starts[lineno - 1]
+    end = (
+        line_starts[lineno] - 1
+        if lineno < len(line_starts) else len(code)
+    )
+    return code[start:end]
+
+
+def _field_names(decl: str) -> List[str]:
+    """Declared names on one struct-field line: strip the trailing ';'
+    and initializers, split multi-declarations on commas, take the
+    last identifier of each chunk."""
+    decl = decl.strip()
+    if not decl.endswith(";"):
+        return []
+    decl = decl[:-1]
+    names = []
+    for chunk in _split_args(decl):
+        chunk = chunk.split("=")[0].strip()
+        chunk = re.sub(r"\{[^{}]*\}\s*$", "", chunk).strip()
+        m = re.search(r"([A-Za-z_]\w*)\s*(?:\[[^\]]*\])?$", chunk)
+        if m:
+            names.append(m.group(1))
+    return names
+
+
+def iter_c_files(
+    roots: Iterable[Path], repo_root: Path
+) -> List[CSourceFile]:
+    out: List[CSourceFile] = []
+    seen: Set[Path] = set()
+    for root in roots:
+        if root.is_file():
+            paths = [root]
+        else:
+            paths = sorted(
+                p for ext in ("*.cpp", "*.cc", "*.c", "*.h", "*.hpp")
+                for p in root.rglob(ext)
+            )
+        for p in paths:
+            if p in seen or p.suffix not in (".cpp", ".cc", ".c", ".h", ".hpp"):
+                continue
+            seen.add(p)
+            out.append(CSourceFile(p, p.relative_to(repo_root).as_posix()))
+    return out

@@ -1,0 +1,237 @@
+"""Pass 5 — native tier: the C decision plane's concurrency contract.
+
+Four rules over ``gubernator_tpu/core/native/*.cpp`` (parsed by
+tools/guberlint/csource.py; STATIC_ANALYSIS.md documents the grammar
+and limits):
+
+- ``native-unguarded-access`` — a struct field annotated
+  ``// guberlint: guarded-by <mutex>`` is touched outside a lexical
+  ``lock_guard``/``unique_lock`` region on the same receiver's mutex
+  (functions named ``*_locked`` or annotated ``holds`` are callee-held,
+  constructors/destructors are pre-publication).
+- ``native-gil-call`` — a function annotated ``// guberlint: gil-free``
+  reaches (transitively, through functions defined in the scanned
+  sources) a ``Py*`` C-API call or a GIL-acquiring trampoline
+  (config.NATIVE_GIL_CALLS, i.e. the ctypes window callback).  The
+  native plane's zero-GIL guarantee becomes checked, not claimed.
+- ``native-blocking-under-lock`` — a call from
+  config.NATIVE_BLOCKING_CALLS (socket/sleep syscalls) while a mutex
+  is lexically held: every thread contending that mutex convoys behind
+  the kernel.  Designed exceptions carry reasoned suppressions.
+- ``native-atomic-order`` — an explicit relaxed/acquire/release/
+  acq_rel/consume memory order: each use must carry a reasoned
+  suppression citing the happens-before argument it relies on (the
+  default seq_cst never needs one).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.guberlint.common import Finding
+from tools.guberlint.config import NATIVE_BLOCKING_CALLS, NATIVE_GIL_CALLS
+from tools.guberlint.csource import CFunction, CSourceFile, _CALL_RE
+
+PASS = "native"
+
+_PY_API_RE = re.compile(r"\bPy[A-Z_]\w*\s*\(")
+_ATOMIC_ORDER_RE = re.compile(
+    r"\bmemory_order_(relaxed|acquire|release|acq_rel|consume)\b"
+)
+
+
+def check_files(srcs: List[CSourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    table = _function_table(srcs)
+    for src in srcs:
+        findings.extend(src.bad_suppressions)
+        _check_guards(src, findings)
+        _check_blocking(src, findings)
+        _check_atomics(src, findings)
+    _check_gil(srcs, table, findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# -- guard discipline --------------------------------------------------
+
+
+def _check_guards(src: CSourceFile, findings: List[Finding]) -> None:
+    guarded: List[Tuple[str, str, str]] = []  # (struct, field, mutex)
+    for s in src.structs:
+        for field, mutex in s.guards.items():
+            guarded.append((s.name, field, mutex))
+    if not guarded:
+        return
+    for fn in src.functions:
+        body = src.code[fn.body_start:fn.body_end]
+        for sname, field, mutex in guarded:
+            for m in re.finditer(
+                r"(?:([A-Za-z_]\w*)\s*(?:->|\.)\s*)?\b%s\b" % re.escape(field),
+                body,
+            ):
+                recv = m.group(1) or ""
+                if recv and m.group(0).startswith(recv):
+                    pass
+                elif not recv and fn.struct != sname:
+                    continue  # bare name in a foreign scope: a local
+                offset = fn.body_start + m.start()
+                if _held_ok(src, fn, offset, recv, mutex):
+                    continue
+                line = src.line_of(offset)
+                if src.suppressed(line, PASS):
+                    continue
+                ref = f"{recv}->{field}" if recv else field
+                findings.append(
+                    Finding(
+                        PASS, "unguarded-access", src.rel, line,
+                        fn.name, f"{sname}.{field}",
+                        f"access to {ref} (guarded by {mutex} in "
+                        f"{sname}) outside a lock region on {mutex}",
+                    )
+                )
+                break  # one finding per (fn, field): fingerprint-stable
+
+
+def _held_ok(
+    src: CSourceFile, fn: CFunction, offset: int, recv: str, mutex: str
+) -> bool:
+    held = src.held_at(fn, offset)
+    for h_recv, h_mutex in held:
+        if h_mutex == "*":  # *_locked convention: caller holds
+            return True
+        if h_mutex != mutex:
+            continue
+        # Bare-held (holds annotation or member-scope guard) vouches
+        # for any receiver; otherwise receivers must match textually.
+        if h_recv == "" or h_recv == recv or recv == "":
+            return True
+    return False
+
+
+# -- blocking calls under a mutex --------------------------------------
+
+_BLOCKING_RE = re.compile(
+    r"\b(%s)\s*\(" % "|".join(re.escape(c) for c in NATIVE_BLOCKING_CALLS)
+)
+
+
+def _check_blocking(src: CSourceFile, findings: List[Finding]) -> None:
+    for fn in src.functions:
+        body = src.code[fn.body_start:fn.body_end]
+        for m in _BLOCKING_RE.finditer(body):
+            offset = fn.body_start + m.start()
+            if not src.held_at(fn, offset):
+                continue
+            line = src.line_of(offset)
+            if src.suppressed(line, PASS):
+                continue
+            findings.append(
+                Finding(
+                    PASS, "blocking-under-lock", src.rel, line, fn.name,
+                    f"{fn.name}:{m.group(1)}",
+                    f"blocking call {m.group(1)}() while a mutex is "
+                    "held — contending threads convoy behind the "
+                    "kernel; move it outside the lock or suppress "
+                    "with the bounding argument",
+                )
+            )
+
+
+# -- atomics / memory order --------------------------------------------
+
+
+def _check_atomics(src: CSourceFile, findings: List[Finding]) -> None:
+    for m in _ATOMIC_ORDER_RE.finditer(src.code):
+        line = src.line_of(m.start())
+        if src.suppressed(line, PASS):
+            continue
+        findings.append(
+            Finding(
+                PASS, "atomic-order", src.rel, line, "<module>",
+                f"memory_order_{m.group(1)}:{line}",
+                f"explicit memory_order_{m.group(1)}: non-seq_cst "
+                "orders need a reasoned suppression citing the "
+                "happens-before edge they rely on",
+            )
+        )
+
+
+# -- GIL discipline ----------------------------------------------------
+
+
+def _function_table(srcs: List[CSourceFile]) -> Dict[str, Tuple[CSourceFile, CFunction]]:
+    table: Dict[str, Tuple[CSourceFile, CFunction]] = {}
+    for src in srcs:
+        for fn in src.functions:
+            prev = table.get(fn.name)
+            # Prefer the longest body: a real definition over a
+            # forward-declared stub parsed from another file.
+            if prev is None or (
+                (fn.body_end - fn.body_start)
+                > (prev[1].body_end - prev[1].body_start)
+            ):
+                table[fn.name] = (src, fn)
+    return table
+
+
+def _check_gil(
+    srcs: List[CSourceFile],
+    table: Dict[str, Tuple[CSourceFile, CFunction]],
+    findings: List[Finding],
+) -> None:
+    for src in srcs:
+        for root in src.functions:
+            if not src.gil_free(root):
+                continue
+            # BFS through the in-scan call graph.
+            seen: Set[str] = {root.name}
+            emitted: Set[str] = set()
+            frontier: List[Tuple[CSourceFile, CFunction, str]] = [
+                (src, root, root.name)
+            ]
+            while frontier:
+                fsrc, fn, path = frontier.pop()
+                body = fsrc.code[fn.body_start:fn.body_end]
+                for m in _PY_API_RE.finditer(body):
+                    line = fsrc.line_of(fn.body_start + m.start())
+                    if fsrc.suppressed(line, PASS):
+                        continue
+                    findings.append(
+                        Finding(
+                            PASS, "gil-call", src.rel, root.name_line,
+                            root.name,
+                            f"{root.name}->{m.group(0).rstrip('(').strip()}",
+                            f"gil-free {root.name} reaches Python C-API "
+                            f"call {m.group(0).rstrip('(').strip()} via "
+                            f"{path} ({fsrc.rel}:{line})",
+                        )
+                    )
+                for m in _CALL_RE.finditer(body):
+                    callee = m.group(1)
+                    if callee in NATIVE_GIL_CALLS:
+                        # Suppression lives at the offending CALL SITE
+                        # (same contract as the Py-API branch above).
+                        line = fsrc.line_of(fn.body_start + m.start())
+                        if fsrc.suppressed(line, PASS):
+                            continue
+                        if f"{root.name}->{callee}" in emitted:
+                            continue
+                        emitted.add(f"{root.name}->{callee}")
+                        findings.append(
+                            Finding(
+                                PASS, "gil-call", src.rel,
+                                root.name_line, root.name,
+                                f"{root.name}->{callee}",
+                                f"gil-free {root.name} reaches the "
+                                f"GIL-acquiring trampoline {callee!r} "
+                                f"via {path} ({fsrc.rel}:{line})",
+                            )
+                        )
+                        continue
+                    if callee in seen or callee not in table:
+                        continue
+                    seen.add(callee)
+                    nsrc, nfn = table[callee]
+                    frontier.append((nsrc, nfn, f"{path}->{callee}"))

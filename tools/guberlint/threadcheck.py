@@ -46,17 +46,32 @@ def _is_daemon(node: ast.Call) -> bool:
 
 def _class_joins(cls: ast.ClassDef) -> Set[str]:
     """Attribute names X for which `self.X.join(...)` (or
-    `<anything>.join(...)` over an iteration of self.X) appears in the
-    class."""
+    `<anything>.join(...)` over an iteration of self.X, or a join of a
+    local alias `y = self.X; y.join()` — the snapshot-under-lock
+    shape the lock pass encourages for guarded thread handles)
+    appears in the class."""
     joined: Set[str] = set()
     iterated: Set[str] = set()
+    # local alias name -> self attr it snapshots (per class; aliases
+    # are method-local in practice and attr names don't collide).
+    aliases: dict = {}
     has_bare_join = False
     for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, path = node.targets[0], attr_path(node.value)
+            if (
+                isinstance(tgt, ast.Name)
+                and path and path.startswith("self.")
+                and path.count(".") == 1
+            ):
+                aliases[tgt.id] = path.split(".")[1]
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
             if node.func.attr == "join":
                 path = attr_path(node.func.value)
                 if path and path.startswith("self."):
                     joined.add(path.split(".")[1])
+                elif path and path in aliases:
+                    joined.add(aliases[path])
                 else:
                     has_bare_join = True
         if isinstance(node, ast.For):
@@ -206,6 +221,25 @@ def check_file(src: SourceFile) -> List[Finding]:
                     elif isinstance(tgt, ast.Name):
                         target_local = tgt.id
                 break
+        if target_local and target_attr is None and fn is not None \
+                and cls is not None:
+            # Publish pattern: `t = Thread(...); t.start();
+            # self.X = t` (start-before-publish, so a concurrent
+            # close() never joins an unstarted thread) — the thread is
+            # self.X-owned and the class join path applies.
+            for stmt in ast.walk(fn):
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Name)
+                    and stmt.value.id == target_local
+                ):
+                    for tgt in stmt.targets:
+                        path = attr_path(tgt)
+                        if (
+                            path and path.startswith("self.")
+                            and path.count(".") == 1
+                        ):
+                            target_attr = path.split(".")[1]
         if target_attr and cls is not None:
             ok = target_attr in _class_joins(cls)
         elif target_local and fn is not None:
