@@ -99,6 +99,16 @@ class GlobalManager:
         import itertools
 
         self._update_seq = itertools.count(1)
+        # Trace seeds: the window flushes aggregate MANY decisions, so
+        # a window span adopts the context of the FIRST decision that
+        # queued into it since the last flush — that is what stitches
+        # forwarder → owner → broadcast into one cross-process trace
+        # (OBSERVABILITY.md).  Benign-race Optionals: a lost store
+        # means one window anchors to a different (equally valid)
+        # decision; tracing-off pays one global check at the enqueue
+        # sites and nothing else.
+        self._hits_seed = None
+        self._updates_seed = None
         # reference: guber_async_durations / guber_broadcast_durations
         # (global.go:41-57).
         self.hits_duration = DurationStat()
@@ -172,21 +182,39 @@ class GlobalManager:
             age_stat=self.broadcast_age,
         )
 
+    def _seed_hits_trace(self) -> None:
+        """Adopt the enqueuing decision's span context for the next
+        hits window (first-in wins; one global check when off)."""
+        from gubernator_tpu.utils import tracing
+
+        if tracing.active() and self._hits_seed is None:
+            self._hits_seed = tracing.current_context()
+
+    def _seed_updates_trace(self) -> None:
+        from gubernator_tpu.utils import tracing
+
+        if tracing.active() and self._updates_seed is None:
+            self._updates_seed = tracing.current_context()
+
     def queue_hit(self, r: RateLimitReq) -> None:
         """Queue hits observed by a non-owner. reference: global.go:68-70."""
+        self._seed_hits_trace()
         self._hits.add(r.hash_key(), r)
 
     def queue_hits_many(self, reqs) -> None:
         """Batch variant of queue_hit: one batcher lock per wire batch."""
+        self._seed_hits_trace()
         self._hits.add_many((r.hash_key(), r) for r in reqs)
 
     def queue_update(self, r: RateLimitReq) -> None:
         """Mark a key the owner must re-broadcast. reference: global.go:72-74."""
+        self._seed_updates_trace()
         self._updates.add(r.hash_key(), r)
 
     def queue_updates_many(self, reqs) -> None:
         """Batch enqueue under one lock (wire batches are ≤1000 items;
         a lock per item contends with the flush thread)."""
+        self._seed_updates_trace()
         self._updates.add_many((r.hash_key(), r) for r in reqs)
 
     # -- columnar enqueue (the wire fast path: O(1) per batch) ---------
@@ -194,6 +222,7 @@ class GlobalManager:
     def queue_hits_chunk(self, dec, idx) -> None:
         """Queue (DecodedBatch, index array) — no per-item Python on
         the serving thread; the flush aggregates vectorized."""
+        self._seed_hits_trace()
         self._hits.add_chunk((dec, idx), len(idx))
 
     def next_update_seq(self) -> int:
@@ -216,6 +245,7 @@ class GlobalManager:
         (latest occurrence in apply order wins), so the flush does no
         engine re-read and no per-key Python — the owner's serve
         already was the authoritative read of exactly these keys."""
+        self._seed_updates_trace()
         self._updates.add_chunk(
             (dec, idx, status, limit, remaining, reset, seq), len(idx)
         )
@@ -386,6 +416,23 @@ class GlobalManager:
 
     # -- flush paths (run on batcher threads) --------------------------
 
+    @staticmethod
+    def _traced_task(name: str, ctx, fn, **attrs):
+        """Wrap a fan-out task so its span re-anchors to the window's
+        context on the rpc pool thread (tracing.current_context is
+        thread-local).  ctx=None (tracing off) returns fn unwrapped —
+        the disabled path pays nothing."""
+        if ctx is None:
+            return fn
+
+        def run(*args):
+            from gubernator_tpu.utils.tracing import span
+
+            with span(name, parent_ctx=ctx, **attrs):
+                return fn(*args)
+
+        return run
+
     def _send_hits(self, hits: Dict[str, RateLimitReq], chunks=None) -> None:
         """Group aggregated hits per owner and forward.
 
@@ -395,12 +442,15 @@ class GlobalManager:
 
         from gubernator_tpu.utils.tracing import span
 
+        # Adopt (and clear) the first enqueuer's span context for this
+        # window — the forwarder half of the cross-process stitch.
+        ctx, self._hits_seed = self._hits_seed, None
         if not hits and chunks:
             # Hot case (all traffic arrived via the wire fast path):
             # aggregate, route, encode and send entirely columnar —
             # zero request objects per key (VERDICT r3 #2).
             t0 = time.monotonic()
-            if self._send_hits_columnar(chunks):
+            if self._send_hits_columnar(chunks, ctx):
                 self.hits_duration.observe(time.monotonic() - t0)
                 return
         for k, r in self._aggregate_chunks(chunks or [], sum_hits=True).items():
@@ -408,11 +458,11 @@ class GlobalManager:
         if not hits:
             return
         t0 = time.monotonic()
-        with span("global.hits_window", keys=len(hits)):
+        with span("global.hits_window", keys=len(hits), parent_ctx=ctx):
             self._send_hits_traced(hits)
         self.hits_duration.observe(time.monotonic() - t0)
 
-    def _send_hits_columnar(self, chunks) -> bool:
+    def _send_hits_columnar(self, chunks, ctx=None) -> bool:
         """Columnar hits fan-out: returns False to use the dataclass
         fallback (codec unavailable / empty picker)."""
         import numpy as np
@@ -431,7 +481,10 @@ class GlobalManager:
         if owners is None:
             return False
         n = len(algo)
-        with span("global.hits_window", keys=n):
+        with span("global.hits_window_columnar", keys=n, parent_ctx=ctx):
+            from gubernator_tpu.utils import tracing
+
+            wctx = tracing.current_context()
             by_addr: Dict[str, list] = {}
             clients = {}
             for i, peer in enumerate(owners):
@@ -521,7 +574,13 @@ class GlobalManager:
             # bounds the flush (a sync send would stall the whole
             # cycle for the per-RPC timeout when that owner is dead).
             futs = [
-                self._rpc_pool.submit(_send_one_owner, addr, idx_list)
+                self._rpc_pool.submit(
+                    self._traced_task(
+                        "global.owner_rpc", wctx, _send_one_owner,
+                        peer=addr,
+                    ),
+                    addr, idx_list,
+                )
                 for addr, idx_list in by_addr.items()
             ]
             self._await_all(futs)
@@ -635,6 +694,9 @@ class GlobalManager:
         )
 
     def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
+        from gubernator_tpu.utils import tracing
+
+        wctx = tracing.current_context()
         by_peer: Dict[str, List[RateLimitReq]] = {}
         clients = {}
         keys = list(hits.keys())
@@ -692,7 +754,12 @@ class GlobalManager:
         # Single owners ride the pool too — the fan-out deadline must
         # bound the flush cycle whatever the per-RPC timeout is.
         futs = [
-            self._rpc_pool.submit(_send_one, addr, reqs)
+            self._rpc_pool.submit(
+                self._traced_task(
+                    "global.owner_rpc_pb", wctx, _send_one, peer=addr
+                ),
+                addr, reqs,
+            )
             for addr, reqs in by_peer.items()
         ]
         self._await_all(futs)
@@ -716,8 +783,12 @@ class GlobalManager:
         n_keys = len(updates) + sum(len(c[1]) for c in chunks)
         if n_keys == 0:
             return
+        # Adopt the first enqueuer's span context — on an owner that
+        # is the serving RPC's handler span, so the broadcast joins
+        # the decision's cross-process trace.
+        ctx, self._updates_seed = self._updates_seed, None
         t0 = time.monotonic()
-        with span("global.broadcast", keys=n_keys):
+        with span("global.broadcast", keys=n_keys, parent_ctx=ctx):
             if chunks:
                 payloads = self._broadcast_chunks_encoded(chunks)
                 if payloads is None:
@@ -873,6 +944,9 @@ class GlobalManager:
         the same peer while an older one runs could deliver a stale
         status LAST — per-peer delivery order is the invariant the
         no-flush-pool design of `_updates` exists for."""
+        from gubernator_tpu.utils import tracing
+
+        wctx = tracing.current_context()
         skipped_circuit = 0
         skipped_inflight = 0
         peers = []
@@ -909,7 +983,13 @@ class GlobalManager:
         # until its circuit opens).
         futs = []
         for p in peers:
-            f = self._rpc_pool.submit(push, p)
+            f = self._rpc_pool.submit(
+                self._traced_task(
+                    "global.broadcast_push", wctx, push,
+                    peer=p.info.grpc_address,
+                ),
+                p,
+            )
             inflight[p.info.grpc_address] = f
             futs.append(f)
         # Broadcast pushes are supersedable → queued tasks may be

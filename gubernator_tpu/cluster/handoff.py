@@ -96,17 +96,30 @@ def encode_transfer(
                 [it.key, _LEAKY, it.expire_at, it.invalid_at, v.limit,
                  v.duration, v.burst, v.updated_at, hi, lo]
             )
-    return json.dumps(
-        {"epoch": epoch, "src": src_addr, "boot": boot, "rows": rows},
-        separators=(",", ":"),
-    ).encode()
+    doc = {"epoch": epoch, "src": src_addr, "boot": boot, "rows": rows}
+    # Wire-propagated trace context (OBSERVABILITY.md): the sender's
+    # active span rides the window as a W3C traceparent string, so a
+    # handoff's receive restores under the transition's trace even
+    # when the transport metadata is absent (tests calling
+    # receive_transfer directly).  Absent when tracing is off.
+    from gubernator_tpu.utils import tracing
+
+    ctx = tracing.current_context()
+    if ctx is not None:
+        doc["traceparent"] = tracing.format_traceparent(ctx)
+    return json.dumps(doc, separators=(",", ":")).encode()
 
 
 def decode_transfer(raw: bytes) -> Tuple[int, str, str, List[CacheItem]]:
     """Inverse of encode_transfer — (epoch, src, boot, items); raises
     ValueError on malformed payloads (the RPC adapter maps that to
     INVALID_ARGUMENT)."""
-    obj = json.loads(raw)
+    return decode_transfer_obj(json.loads(raw))
+
+
+def decode_transfer_obj(obj) -> Tuple[int, str, str, List[CacheItem]]:
+    """decode_transfer over an already-parsed document (the receiver
+    parses once for the traceparent AND the rows)."""
     items: List[CacheItem] = []
     for row in obj["rows"]:
         key, algo, expire_at, invalid_at = row[0], row[1], row[2], row[3]
@@ -170,7 +183,28 @@ def receive_transfer(instance, raw: bytes) -> int:
     never mistaken for staleness).  The check-then-update on the seen
     map is unlocked: the benign race admits at worst one stale
     window, the pre-guard behavior."""
-    epoch, src, boot, items = decode_transfer(raw)
+    from gubernator_tpu.utils import tracing
+
+    obj = json.loads(raw)
+    if tracing.active():
+        # Join the sender's trace via the window's embedded
+        # traceparent (skipped when the RPC adapter's metadata span is
+        # already open — nesting wins then).  One parse serves both
+        # the traceparent and the rows.
+        remote = None
+        if tracing.current_context() is None:
+            tp = obj.get("traceparent", "") if isinstance(obj, dict) else ""
+            remote = tracing.parse_traceparent(tp) if tp else None
+        with tracing.span("handoff.receive", remote_parent=remote) as s:
+            n = _receive_transfer(instance, obj)
+            if s is not None:
+                s.set_attribute("rows", n)
+            return n
+    return _receive_transfer(instance, obj)
+
+
+def _receive_transfer(instance, obj) -> int:
+    epoch, src, boot, items = decode_transfer_obj(obj)
     if src:
         seen = instance.handoff_epoch_seen
         last = seen.get(src)
@@ -245,6 +279,18 @@ class HandoffSender:
         {"shipped": n, "forfeited": n}.  Blocking — the membership
         manager runs it on its transition thread, drain runs it
         inline."""
+        from gubernator_tpu.utils.tracing import span
+
+        with span(
+            "handoff.ship", epoch=self.epoch, targets=len(targets)
+        ):
+            return self._ship_traced(targets, deadline)
+
+    def _ship_traced(
+        self,
+        targets: Dict[str, Tuple[object, List[CacheItem]]],
+        deadline: float,
+    ) -> Dict[str, int]:
         from gubernator_tpu.cluster.health import backoff_delay
         from gubernator_tpu.cluster.peer_client import PeerError
 

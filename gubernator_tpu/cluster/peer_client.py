@@ -40,6 +40,7 @@ from gubernator_tpu.cluster import faults
 from gubernator_tpu.cluster.health import PeerHealth
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.net import serde
+from gubernator_tpu.utils import tracing
 from gubernator_tpu.net.grpc_service import PeersV1Stub, dial
 from gubernator_tpu.net.pb import peers_pb2 as peers_pb
 from gubernator_tpu.types import (
@@ -198,6 +199,9 @@ class PeerClient:
         Injected faults are recorded as real transport failures — the
         chaos tests exercise the same bookkeeping production does."""
         if not self.health.allow():
+            tracing.add_event(
+                "circuit_open", peer=self.info.grpc_address
+            )
             raise PeerError(
                 f"circuit open to {self.info.grpc_address} "
                 f"(probe in {self.health.retry_after():.2f}s)",
@@ -283,7 +287,8 @@ class PeerClient:
             self._inflight += 1
         try:
             resp = stub.GetPeerRateLimits(
-                msg, timeout=timeout or self.behaviors.batch_timeout
+                msg, timeout=timeout or self.behaviors.batch_timeout,
+                metadata=tracing.grpc_metadata(),
             )
             self.health.record_success()
         except grpc.RpcError as e:
@@ -333,6 +338,7 @@ class PeerClient:
             raw(
                 payload,
                 timeout=timeout or self.behaviors.global_timeout,
+                metadata=tracing.grpc_metadata(),
             )
             self.health.record_success()
         except grpc.RpcError as e:
@@ -365,7 +371,8 @@ class PeerClient:
             self._inflight += 1
         try:
             stub.UpdatePeerGlobals(
-                msg, timeout=timeout or self.behaviors.global_timeout
+                msg, timeout=timeout or self.behaviors.global_timeout,
+                metadata=tracing.grpc_metadata(),
             )
             self.health.record_success()
         except grpc.RpcError as e:
@@ -394,7 +401,10 @@ class PeerClient:
             raw = self._raw_update_globals
             self._inflight += 1
         try:
-            raw(payload, timeout=timeout or self.behaviors.global_timeout)
+            raw(
+                payload, timeout=timeout or self.behaviors.global_timeout,
+                metadata=tracing.grpc_metadata(),
+            )
             self.health.record_success()
         except grpc.RpcError as e:
             err = f"UpdatePeerGlobals to {self.info.grpc_address}: {e.code().name}: {e.details()}"
@@ -424,7 +434,10 @@ class PeerClient:
             raw = self._raw_transfer
             self._inflight += 1
         try:
-            raw(payload, timeout=timeout or self.behaviors.batch_timeout)
+            raw(
+                payload, timeout=timeout or self.behaviors.batch_timeout,
+                metadata=tracing.grpc_metadata(),
+            )
             self.health.record_success()
         except grpc.RpcError as e:
             err = f"TransferBuckets to {self.info.grpc_address}: {e.code().name}: {e.details()}"
@@ -542,7 +555,8 @@ class PeerClient:
             )
             assert self._stub is not None
             resp = self._stub.GetPeerRateLimits(
-                msg, timeout=self.behaviors.batch_timeout
+                msg, timeout=self.behaviors.batch_timeout,
+                metadata=tracing.grpc_metadata(),
             )
             self.health.record_success()
             if len(resp.rate_limits) != len(batch):

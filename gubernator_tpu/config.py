@@ -180,6 +180,15 @@ KNOWN_ENV_KNOBS = (
     "GUBER_LOG_LEVEL",        # utils/logging_setup.py
     "GUBER_LOG_FORMAT",       # utils/logging_setup.py ("json" | "text")
     "GUBER_TRACING",          # utils/tracing.py ("memory" recorder)
+    # Observability plane (OBSERVABILITY.md) — read at point of use.
+    "GUBER_TRACE_TAIL_FACTOR",   # utils/flight_recorder.py: p99 multiple
+    "GUBER_TRACE_TAIL_MIN_MS",   # utils/flight_recorder.py: floor, ms
+    "GUBER_TRACE_TAIL_CAP",      # utils/flight_recorder.py: ring size
+    "GUBER_HOTKEYS",             # utils/hotkeys.py: top-K sketch on/off
+    "GUBER_HOTKEYS_K",           # utils/hotkeys.py: counter capacity
+    "GUBER_NATIVE_EVENTS",       # net/h2_fast.py: C event ring on/off
+    "GUBER_NATIVE_EVENTS_CAP",   # net/h2_fast.py: ring record capacity
+    "GUBER_NATIVE_EVENTS_INTERVAL",  # utils/native_events.py: drain period
     # Discovery plane (read by the k8s watcher, not the daemon config).
     "GUBER_K8S_NAMESPACE",    # discovery/kubernetes.py
     "GUBER_K8S_POD_SELECTOR",  # discovery/kubernetes.py

@@ -460,6 +460,12 @@ class DecisionLedger:
         # ledger lock → plane mutex (guberlint's cycle pass sees only
         # the Python side; the C mutex never calls back out).
         self._native = None  # guberlint: guarded-by _lock
+        # Optional hot-key sketch (utils/hotkeys.py, attached by the
+        # service): native-plane drains are credited here at pull time
+        # — the only moment the C tier's per-key counts surface — so
+        # /debug/hotkeys sees natively-answered keys too.  Leaf lock:
+        # the sketch never calls back into the ledger.
+        self.hotkeys = None
         self._stop = threading.Event()
         self._flusher = None
         if settle_interval > 0:
@@ -510,6 +516,8 @@ class DecisionLedger:
         next (engine lane, revoke, settle)."""
         res = self._native.pull(e.key)
         if res is not None and res[0] == 2:
+            if self.hotkeys is not None and res[1] > e.consumed:
+                self.hotkeys.offer(e.key, res[1] - e.consumed)
             e.consumed = res[1]
         e.kind = _K_LEASE
 

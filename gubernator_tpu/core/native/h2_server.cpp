@@ -52,6 +52,17 @@ extern "C" int64_t dp_try_serve(void* handle, const uint8_t* body,
                                 int64_t len, int64_t max_items,
                                 int64_t now_ms, uint8_t* out,
                                 int64_t out_cap);
+// Event ring (event_ring.cpp, same .so): lock-free per-stage latency
+// tap the conn/dispatch threads publish into — zero mutex, zero
+// allocation, zero Py* (reachable from the conn_loop gil-free root).
+extern "C" int64_t evr_record(void* handle, int64_t kind, int64_t t_end_ns,
+                              int64_t dur_ns, int64_t items);
+extern "C" int64_t evr_now_ns();
+
+// Event kinds (utils/native_events.py mirrors these names).
+constexpr int64_t kEvNativeServe = 1;  // conn thread: decode→probe→send
+constexpr int64_t kEvWindowWait = 2;   // enqueue → dispatch pickup
+constexpr int64_t kEvWindowServe = 3;  // window callback (Python) wall
 
 namespace {
 
@@ -168,6 +179,7 @@ struct PendingRpc {
   uint32_t stream;
   std::string body;       // grpc-deframed protobuf payload
   int64_t items;
+  int64_t t_enq_ns;       // event-ring window-wait anchor (0 = no ring)
 };
 
 struct Server {
@@ -198,6 +210,10 @@ struct Server {
   // side attaches/detaches it; conn threads load it per RPC, so a
   // detach takes effect at the next request.
   std::atomic<void*> plane{nullptr};
+  // Optional event ring (event_ring.cpp), attached like the plane;
+  // nullptr = observability off, and the serve paths skip even the
+  // clock reads.
+  std::atomic<void*> ring{nullptr};
   // Stats.
   std::atomic<int64_t> rpcs{0}, windows{0}, errors{0};
   std::atomic<int64_t> native_rpcs{0}, native_items{0};
@@ -658,6 +674,8 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                   // behavior) takes the window path unchanged.
                   bool served_native = false;
                   void* plane = srv->plane.load();
+                  void* ring = srv->ring.load();
+                  const int64_t t0 = ring ? evr_now_ns() : 0;
                   if (plane != nullptr && items > 0) {
                     std::string resp;
                     resp.resize(static_cast<size_t>(items) * 48 + 16);
@@ -680,12 +698,17 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                       srv->native_rpcs.fetch_add(1);
                       srv->native_items.fetch_add(items);
                       served_native = true;
+                      if (ring) {
+                        const int64_t t1 = evr_now_ns();
+                        evr_record(ring, kEvNativeServe, t1, t1 - t0,
+                                   items);
+                      }
                     }
                   }
                   if (!served_native) {
                     std::lock_guard<std::mutex> lock(srv->q_mu);
                     srv->queue.push_back(PendingRpc{
-                        conn, stream, std::move(body), items});
+                        conn, stream, std::move(body), items, t0});
                     srv->queued_items += items;
                     srv->q_cv.notify_one();
                   }
@@ -795,11 +818,26 @@ void dispatch_loop(Server* srv) {
     body_lens.reserve(batch.size());
     for (auto& rpc : batch)
       body_lens.push_back(static_cast<int64_t>(rpc.body.size()));
+    void* ring = srv->ring.load();
+    const int64_t t_cb = ring ? evr_now_ns() : 0;
+    if (ring) {
+      // One window-wait event per RPC: enqueue → dispatch pickup is
+      // the group-commit wait a fall-through decision pays — the
+      // stage the lease-TTL-churn tail hides in (PERF.md §20).
+      for (auto& rpc : batch)
+        if (rpc.t_enq_ns)
+          evr_record(ring, kEvWindowWait, t_cb, t_cb - rpc.t_enq_ns,
+                     rpc.items);
+    }
     const int64_t rc = srv->callback(
         reinterpret_cast<const uint8_t*>(concat.data()),
         static_cast<int64_t>(concat.size()), counts.data(),
         body_lens.data(), static_cast<int64_t>(batch.size()), total,
         cols.data(), rpc_status.data());
+    if (ring) {
+      const int64_t t1 = evr_now_ns();
+      evr_record(ring, kEvWindowServe, t1, t1 - t_cb, total);
+    }
     srv->windows.fetch_add(1);
     int64_t offset = 0;
     size_t ridx = 0;
@@ -943,6 +981,14 @@ void h2s_attach_plane(void* handle, void* plane) {
   static_cast<Server*>(handle)->plane.store(plane);
 }
 
+// Attach (or detach with nullptr) an event ring created by
+// evr_create.  Same lifetime contract as the plane: the ring must
+// outlive the server's threads; the Python side detaches before
+// h2s_stop and frees after it.
+void h2s_attach_ring(void* handle, void* ring) {
+  static_cast<Server*>(handle)->ring.store(ring);
+}
+
 int32_t h2s_lanes(void* handle) {
   return static_cast<int32_t>(
       static_cast<Server*>(handle)->listen_fds.size());
@@ -967,6 +1013,7 @@ void h2s_stop(void* handle) {
   auto* srv = static_cast<Server*>(handle);
   srv->closing.store(true);
   srv->plane.store(nullptr);
+  srv->ring.store(nullptr);
   for (int fd : srv->listen_fds) {
     ::shutdown(fd, SHUT_RDWR);
     ::close(fd);

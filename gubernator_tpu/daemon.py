@@ -201,6 +201,27 @@ class Daemon:
             drain_deadline=conf.drain_deadline,
         )
         self.instance.membership = self.membership
+        # Tail flight recorder (utils/flight_recorder.py): when the
+        # in-memory tracer is live (GUBER_TRACING=memory or a harness
+        # set_tracer), retain full span trees of tail decisions for
+        # /debug/trace.  OTel backends do their own tail sampling
+        # upstream; disabled tracing costs nothing here.
+        from gubernator_tpu.utils import tracing as _tracing
+        from gubernator_tpu.utils.tracing import InMemoryTracer
+
+        tracer = _tracing.current_tracer()
+        if isinstance(tracer, InMemoryTracer):
+            from gubernator_tpu.utils.flight_recorder import FlightRecorder
+
+            # One recorder per tracer: in-process multi-daemon
+            # harnesses share the global tracer, and each daemon
+            # re-hooking on_root_finish would orphan its siblings'
+            # recorders.
+            fr = getattr(tracer, "_flight_recorder", None)
+            if fr is None:
+                fr = FlightRecorder.from_env(tracer)
+                tracer._flight_recorder = fr
+            self.instance.flight_recorder = fr
         self.registry = build_registry(
             self.instance, metric_flags=conf.metric_flags
         )
@@ -285,6 +306,17 @@ class Daemon:
                 native_ledger=conf.native_ledger,
             )
             self.h2_fast_address = self.h2_fast.address
+            # Native event collector: drain the C front's event ring
+            # into histograms/metrics/span stubs (utils/native_events;
+            # GUBER_NATIVE_EVENTS=0 disables the ring entirely).
+            if self.h2_fast._ring is not None:
+                from gubernator_tpu.utils.native_events import (
+                    NativeEventCollector,
+                )
+
+                self.instance.native_events = NativeEventCollector.from_env(
+                    self.h2_fast
+                )
 
         # Optional plain-HTTP status listener for probes when mTLS
         # would block them (reference: daemon.go:279-307).
@@ -481,21 +513,21 @@ class Daemon:
         return self.membership.drain(deadline)
 
     def stage_budget(self) -> dict:
-        """The measured GLOBAL-path p50 budget on this node: per-stage
-        {count, mean_ms, max_ms} for the five pipeline stages (client
-        window wait, engine serve, hit-window wait, owner RPC,
-        broadcast age).  The same numbers /metrics exports as
-        gubernator_stage_duration — this is the operator/bench entry
-        (scripts/stage_budget.py commits it as an artifact)."""
+        """The measured GLOBAL-path latency budget on this node:
+        per-stage {count, mean_ms, p50_ms, p99_ms, max_ms} for the
+        five pipeline stages (client window wait, engine serve,
+        hit-window wait, owner RPC, broadcast age).  p50/p99 are REAL
+        streaming quantiles from DurationStat's histogram — earlier
+        rounds advertised a "p50 budget" while reporting means, which
+        is exactly how the lease-TTL-churn tail stayed hidden
+        (PERF.md §23).  The same numbers /metrics exports as
+        gubernator_stage_duration + gubernator_stage_quantile_seconds;
+        /debug/vars serves them live."""
         assert self.instance is not None
-        out = {}
-        for stage, stat in self.instance.stage_timers.items():
-            out[stage] = {
-                "count": stat.count,
-                "mean_ms": round(stat.mean() * 1e3, 3),
-                "max_ms": round(stat.max * 1e3, 3),
-            }
-        return out
+        return {
+            stage: stat.snapshot_ms()
+            for stage, stat in self.instance.stage_timers.items()
+        }
 
     def close(self) -> None:
         """Graceful stop. reference: daemon.go:342-367 (Close)."""
@@ -513,6 +545,14 @@ class Daemon:
             # Join any in-flight epoch transition before tearing the
             # engine down under its snapshot/ship pass.
             self.membership.close()
+        if self.instance is not None and self.instance.native_events is not None:
+            # Stop the drain thread BEFORE the front frees the ring
+            # (single-consumer contract; a drain into a freed ring is
+            # a use-after-free).  If the thread outlived the join,
+            # leak the ring instead of freeing it.
+            if not self.instance.native_events.close():
+                if getattr(self, "h2_fast", None) is not None:
+                    self.h2_fast.abandon_ring()
         if getattr(self, "h2_fast", None) is not None:
             self.h2_fast.close()
         if self.gateway is not None:

@@ -72,8 +72,87 @@ class _Handler(BaseHTTPRequestHandler):
                 generate_latest(self.registry),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        elif path == "/debug/trace":
+            self._reply(200, json.dumps(self._debug_trace()).encode())
+        elif path == "/debug/hotkeys":
+            self._reply(200, json.dumps(self._debug_hotkeys()).encode())
+        elif path == "/debug/vars":
+            self._reply(200, json.dumps(self._debug_vars()).encode())
         else:
             self._reply_error(404, 5, "not found")
+
+    # -- /debug introspection surface (OBSERVABILITY.md) ---------------
+
+    def _debug_trace(self) -> dict:
+        """Tail flight recorder dump: the retained span trees of
+        decisions that exceeded the adaptive threshold."""
+        fr = getattr(self.instance, "flight_recorder", None)
+        if fr is None:
+            return {"enabled": False, "traces": []}
+        out = fr.dump()
+        out["enabled"] = True
+        return out
+
+    def _debug_hotkeys(self) -> dict:
+        hk = getattr(self.instance, "hotkeys", None)
+        if hk is None:
+            return {"enabled": False, "top": []}
+        out = hk.stats()
+        out["enabled"] = True
+        out["top"] = [
+            {
+                "key": key.decode(errors="replace"),
+                "count": count,
+                "err": err,
+            }
+            for key, count, err in hk.top(50)
+        ]
+        return out
+
+    def _debug_vars(self) -> dict:
+        """One JSON snapshot of the node's live internals: counters,
+        stage budget (real quantiles), ledger/native/ring stats, peer
+        health, membership, and queue depths — the flight recorder's
+        companion when attributing a tail."""
+        inst = self.instance
+        out: dict = {"counters": dict(inst.counters)}
+        out["stage_budget"] = {
+            stage: stat.snapshot_ms()
+            for stage, stat in inst.stage_timers.items()
+        }
+        led = getattr(inst, "ledger", None)
+        if led is not None:
+            try:
+                out["ledger"] = led.stats()
+            except Exception:  # noqa: BLE001 — snapshot best-effort
+                out["ledger"] = None
+        ev = getattr(inst, "native_events", None)
+        if ev is not None:
+            out["native_events"] = ev.stats()
+        out["peer_health"] = {}
+        for p in inst.get_peer_list():
+            if p.info.is_owner:
+                continue
+            out["peer_health"][p.info.grpc_address] = {
+                "state": p.health.state(),
+                "transitions": p.health.transition_counts(),
+                "queue_length": p.queue_length(),
+            }
+        mem = getattr(inst, "membership", None)
+        if mem is not None:
+            try:
+                out["membership"] = mem.stats()
+            except Exception:  # noqa: BLE001 — snapshot best-effort
+                out["membership"] = None
+        out["handoff"] = dict(inst.handoff_counters)
+        out["global"] = {
+            "hits_pending": inst.global_mgr._hits.pending(),
+            "broadcasts_pending": inst.global_mgr._updates.pending(),
+            "async_sends": inst.global_mgr.async_sends,
+            "broadcasts": inst.global_mgr.broadcasts,
+        }
+        out["cache_size"] = inst.engine.cache_size()
+        return out
 
     def _read_json(self, msg):
         length = int(self.headers.get("Content-Length", "0"))

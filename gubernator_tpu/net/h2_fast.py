@@ -81,9 +81,40 @@ def load() -> Optional[ctypes.CDLL]:
     lib.h2s_lanes.argtypes = [ctypes.c_void_p]
     lib.h2s_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_attach_plane.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.h2s_attach_ring.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_stop.argtypes = [ctypes.c_void_p]
+    # Event ring (core/native/event_ring.cpp, same .so).
+    lib.evr_create.restype = ctypes.c_void_p
+    lib.evr_create.argtypes = [ctypes.c_int64]
+    lib.evr_free.argtypes = [ctypes.c_void_p]
+    lib.evr_drain.restype = ctypes.c_int64
+    lib.evr_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.evr_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.evr_record.restype = ctypes.c_int64
+    lib.evr_record.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64,
+    ]
     _lib = lib
     return _lib
+
+
+def native_events_capacity() -> int:
+    """GUBER_NATIVE_EVENTS / GUBER_NATIVE_EVENTS_CAP: 0 disables the
+    event ring; otherwise the ring's record capacity (rounded up to a
+    power of two by the C side; default 65536)."""
+    if os.environ.get("GUBER_NATIVE_EVENTS", "1").strip().lower() in (
+        "0", "false", "no", "off"
+    ):
+        return 0
+    v = os.environ.get("GUBER_NATIVE_EVENTS_CAP", "").strip()
+    try:
+        return int(v) if v else 65536
+    except ValueError:
+        log.warning("GUBER_NATIVE_EVENTS_CAP=%r not an integer", v)
+        return 65536
 
 
 def default_lanes() -> int:
@@ -143,6 +174,18 @@ class H2FastFront:
         self.lanes = int(lib.h2s_lanes(self._handle))
         self.plane = None
         self._attach_plane(native_ledger)
+        # Event ring: the C threads publish per-stage latency events
+        # (utils/native_events.py drains them).  Created unless
+        # GUBER_NATIVE_EVENTS=0 — an unattached front pays nothing,
+        # an attached one pays two clock reads + one lock-free write
+        # per event.
+        self._ring = None
+        cap = native_events_capacity()
+        if cap > 0:
+            ring = lib.evr_create(cap)
+            if ring:
+                self._ring = ctypes.c_void_p(ring)
+                lib.h2s_attach_ring(self._handle, self._ring)
 
     def _attach_plane(self, native_ledger: Optional[bool]) -> None:
         """Create and attach the native decision plane when the ledger
@@ -295,6 +338,12 @@ class H2FastFront:
         # listener's forward path.
         if not inst.all_locally_owned(dec):
             return None
+        hk = getattr(inst, "hotkeys", None)
+        if hk is not None:
+            hk.offer_columns(
+                dec.key_buf, dec.key_offsets, dec.hits,
+                hashes=dec.fnv1a,
+            )
         ledger = getattr(inst, "ledger", None)
         if ledger is not None:
             return self._serve_ledger(ledger, engine, dec)
@@ -343,6 +392,45 @@ class H2FastFront:
             return out
         return plan.merge_outputs(st, rem, rst)
 
+    # -- event ring (core/native/event_ring.cpp) ------------------------
+
+    def drain_events(self, out) -> int:
+        """Drain ring records into `out` (int64 numpy array, 4 slots
+        per record: kind, t_end_ns, dur_ns, items); returns records
+        read.  SINGLE consumer by contract — only the
+        NativeEventCollector thread calls this."""
+        if self._ring is None:
+            return 0
+        return int(
+            self._lib.evr_drain(
+                self._ring, out.ctypes.data_as(ctypes.c_void_p),
+                len(out) // 4,
+            )
+        )
+
+    def ring_stats(self) -> dict:
+        if self._ring is None:
+            return {"written": 0, "dropped": 0, "enabled": False}
+        out = np.zeros(2, dtype=np.int64)
+        self._lib.evr_stats(
+            self._ring, out.ctypes.data_as(ctypes.c_void_p)
+        )
+        return {
+            "written": int(out[0]),
+            "dropped": int(out[1]),
+            "enabled": True,
+        }
+
+    def abandon_ring(self) -> None:
+        """Detach the ring and forget it WITHOUT freeing: the
+        collector's drain thread outlived its join, and a freed ring
+        under a live consumer is a native use-after-free — leak over
+        UAF (same rule as h2s_stop's conn-thread bound)."""
+        if self._ring is not None:
+            if self._handle:
+                self._lib.h2s_attach_ring(self._handle, None)
+            self._ring = None
+
     # -- lifecycle ------------------------------------------------------
 
     def stats(self) -> dict:
@@ -370,6 +458,10 @@ class H2FastFront:
                 # then joins/drains them before the ledger pulls its
                 # credit back and the table is freed.
                 self._lib.h2s_attach_plane(self._handle, None)
+            if self._ring is not None:
+                # Same contract as the plane: detach first, free only
+                # after h2s_stop joined/drained the writer threads.
+                self._lib.h2s_attach_ring(self._handle, None)
             self._lib.h2s_stop(self._handle)
             self._handle = None
             if self.plane is not None:
@@ -378,3 +470,6 @@ class H2FastFront:
                     ledger.detach_native()
                 self.plane.close()
                 self.plane = None
+            if self._ring is not None:
+                self._lib.evr_free(self._ring)
+                self._ring = None

@@ -8,6 +8,8 @@ contract allows — oversized batches; reference: gubernator.go:212-216).
 
 from __future__ import annotations
 
+import contextlib
+
 from typing import Optional, Tuple
 
 import grpc
@@ -79,6 +81,24 @@ def _fill_rate_limit_resps(field, cols) -> None:
         field.add(status=st, limit=li, remaining=rem, reset_time=rt)
 
 
+
+def _handler_span(name: str, context):
+    """Span for one inbound RPC, joined to the caller's trace via the
+    ``traceparent`` metadata pair (utils/tracing) — a contextmanager
+    that is free when tracing is off (one global check, no metadata
+    read)."""
+    from gubernator_tpu.utils import tracing
+
+    if not tracing.active():
+        return contextlib.nullcontext()
+    return tracing.span(
+        name,
+        remote_parent=tracing.remote_parent_from_metadata(
+            context.invocation_metadata()
+        ),
+    )
+
+
 class GrpcV1Adapter:
     """Public service (reference: proto/gubernator.proto:27-45)."""
 
@@ -86,6 +106,10 @@ class GrpcV1Adapter:
         self.instance = instance
 
     def GetRateLimits(self, request, context):
+        with _handler_span("rpc.get_rate_limits", context):
+            return self._get_rate_limits(request, context)
+
+    def _get_rate_limits(self, request, context):
         # The method handler passes RAW request bytes (grpc_service
         # _unary_raw): the native codec path serves the whole RPC in
         # compiled code when it can.
@@ -126,6 +150,10 @@ class GrpcPeersV1Adapter:
         self.instance = instance
 
     def GetPeerRateLimits(self, request, context):
+        with _handler_span("rpc.get_peer_rate_limits", context):
+            return self._get_peer_rate_limits(request, context)
+
+    def _get_peer_rate_limits(self, request, context):
         # Owner side of a forwarded batch: answered authoritatively
         # (never re-forwarded), so no ownership check is needed.
         if isinstance(request, (bytes, memoryview)):
@@ -158,6 +186,10 @@ class GrpcPeersV1Adapter:
         return serde.peer_rate_limits_resp_to_pb(resps)
 
     def UpdatePeerGlobals(self, request, context):
+        with _handler_span("rpc.update_peer_globals", context):
+            return self._update_peer_globals(request, context)
+
+    def _update_peer_globals(self, request, context):
         # Raw-bytes fast path: the broadcast plane is the cluster
         # tier's highest-rate message; decode straight into status-
         # cache columns (net/wire_codec.decode_globals).
@@ -186,6 +218,10 @@ class GrpcPeersV1Adapter:
         return peers_pb.UpdatePeerGlobalsResp()
 
     def TransferBuckets(self, request, context):
+        with _handler_span("rpc.transfer_buckets", context):
+            return self._transfer_buckets(request, context)
+
+    def _transfer_buckets(self, request, context):
         # Ownership handoff (cluster/handoff.py): restore a shipped
         # window of bucket rows into the local engine.  Raw JSON in,
         # empty response out.

@@ -512,6 +512,9 @@ class ShardedDecisionEngine:
         from gubernator_tpu.utils.tracing import span
 
         expire_of: Dict[int, int] = {}
+        # guberlint: ok drift — sharded twin of engine.py's
+        # engine.batch site; same stage name keeps the tracing
+        # oracle backend-agnostic (tests/test_tracing.py)
         with span("engine.batch", batch=len(valid), rounds=len(rounds)):
             if (
                 self.store is None
@@ -532,6 +535,8 @@ class ShardedDecisionEngine:
                     chunk = [m[offset : offset + self.max_kernel_width] for m in members]
                     if not any(chunk) and offset > 0:
                         break
+                    # guberlint: ok drift — sharded twin of
+                    # engine.py's engine.round site
                     with span(
                         "engine.round",
                         round=k,
@@ -900,6 +905,8 @@ class ShardedDecisionEngine:
 
         from gubernator_tpu.utils.tracing import span
 
+        # guberlint: ok drift — sharded twin of engine.py's
+        # engine.columnar site
         with self._lock, span("engine.columnar", batch=n):
             pending = self._apply_columnar_locked(
                 keys, algo, behavior, hits, limit, duration, burst,
@@ -1233,6 +1240,8 @@ class ShardedDecisionEngine:
                 np.asarray([s for _, s in items], dtype=_I32)
             )
 
+        # guberlint: ok drift — sharded twin of engine.py's
+        # engine.collapsed site
         with span("engine.collapsed", width=nv):
             pieces = self._try_collapse_sharded(
                 shard_idx, shard_slots, clear_rounds,

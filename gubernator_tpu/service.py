@@ -408,6 +408,25 @@ class V1Instance:
         # created lazily on first flagged request (GUBER_SKETCH_*).
         self._sketch = None
         self._sketch_lock = threading.Lock()
+        # Hot-key attribution: space-saving top-K over decision keys
+        # (utils/hotkeys.py; GUBER_HOTKEYS / GUBER_HOTKEYS_K — None
+        # when disabled, costing one attribute check per batch).
+        # Served by /debug/hotkeys and gubernator_hotkeys.
+        from gubernator_tpu.utils import hotkeys as _hotkeys
+
+        self.hotkeys = _hotkeys.from_env()
+        if self.ledger is not None and self.hotkeys is not None:
+            # Native-plane drains surface per-key counts only at pull
+            # time (core/ledger._undelegate_locked) — credit them so
+            # natively-answered keys appear in /debug/hotkeys too.
+            self.ledger.hotkeys = self.hotkeys
+        # Tail flight recorder (utils/flight_recorder.py), attached by
+        # the daemon when in-memory tracing is active; /debug/trace
+        # serves its dump.
+        self.flight_recorder = None
+        # Native event collector (utils/native_events.py), attached by
+        # the daemon when the h2 fast front runs with its event ring.
+        self.native_events = None
 
     def sketch(self):
         if self._sketch is None:
@@ -453,7 +472,7 @@ class V1Instance:
         """reference: gubernator.go:197-317 (GetRateLimits)."""
         from gubernator_tpu.utils.tracing import span
 
-        with span("V1Instance.get_rate_limits", batch=len(requests)):
+        with span("service.get_rate_limits", batch=len(requests)):
             return self._get_rate_limits(requests)
 
     def _get_rate_limits(
@@ -487,6 +506,11 @@ class V1Instance:
 
         # 2. one vectorized owner lookup for the batch
         keys = [requests[i].hash_key() for i in candidates]
+        if self.hotkeys is not None and keys:
+            self.hotkeys.offer_many(
+                (k.encode(), max(requests[i].hits, 1))
+                for k, i in zip(keys, candidates)
+            )
         with self._peer_lock:
             if self.local_picker.size() == 0:
                 owners: List[Optional[PeerClient]] = [None] * len(candidates)
@@ -568,14 +592,20 @@ class V1Instance:
             for i, owner in global_miss:
                 responses[i].metadata = {"owner": owner.info.grpc_address}
 
-        # 5. forward the rest (async per peer, 5-retry loop)
+        # 5. forward the rest (async per peer, 5-retry loop).  The
+        # forward pool is another thread, so the caller's span context
+        # travels explicitly (tracing.current_context is thread-local).
         if forward:
+            from gubernator_tpu.utils import tracing
+
+            fwd_ctx = tracing.current_context()
             futures = []
             for addr, (peer, idxs) in forward.items():
                 self.counters["forward"] += len(idxs)
                 futures.append(
                     self._forward_pool.submit(
-                        self._forward_group, peer, idxs, requests, responses
+                        self._forward_group, peer, idxs, requests,
+                        responses, fwd_ctx,
                     )
                 )
             for f in futures:
@@ -598,6 +628,11 @@ class V1Instance:
         (architecture.md:5-11): worst case each partition side admits
         up to `limit` independently — N_partitions × limit total, the
         same shape as the GLOBAL broadcast-lag bound (RESILIENCE.md)."""
+        from gubernator_tpu.utils import tracing
+
+        tracing.add_event(
+            "degraded_answer", owner=owner_addr, items=len(ids)
+        )
         resps = self.apply_local_batch([requests[i] for i in ids])
         self.counters["degraded_answers"] += len(ids)
         for i, resp in zip(ids, resps):
@@ -608,6 +643,25 @@ class V1Instance:
             responses[i] = resp
 
     def _forward_group(
+        self,
+        peer: PeerClient,
+        idxs: List[int],
+        requests: Sequence[RateLimitReq],
+        responses: List[Optional[RateLimitResp]],
+        parent_ctx=None,
+    ) -> None:
+        """Span shim re-anchoring the forward-pool thread to the
+        caller's trace (tracing.current_context is thread-local); the
+        ownership-migration loop lives in _forward_group_traced."""
+        from gubernator_tpu.utils.tracing import span
+
+        with span(
+            "forward.group", parent_ctx=parent_ctx,
+            peer=peer.info.grpc_address, batch=len(idxs),
+        ):
+            self._forward_group_traced(peer, idxs, requests, responses)
+
+    def _forward_group_traced(
         self,
         peer: PeerClient,
         idxs: List[int],
@@ -685,6 +739,13 @@ class V1Instance:
                             timeout=behaviors.batch_timeout,
                         )
                 except PeerError as e:
+                    if e.circuit_open:
+                        from gubernator_tpu.utils import tracing
+
+                        tracing.add_event(
+                            "circuit_open", peer=p.info.grpc_address,
+                            items=len(ids),
+                        )
                     if e.circuit_open and degraded_on:
                         # Broken owner, no probe due: a re-pick hands
                         # back the same peer, so answer locally NOW —
@@ -804,6 +865,11 @@ class V1Instance:
                 self.engine.clock.now_ms(), key_hashes=dec.fnv1a,
             )
             self.counters["columnar"] += dec.n
+            if self.hotkeys is not None:
+                self.hotkeys.offer_columns(
+                    dec.key_buf, dec.key_offsets, dec.hits,
+                    hashes=dec.fnv1a,
+                )
             return wire_codec.encode_resps(st, lim, rem, rst)
         g_mask = (dec.behavior & _GLOBAL_I) != 0
         if g_mask.any():
@@ -815,6 +881,11 @@ class V1Instance:
                 return None
             self.counters["local"] += dec.n
         self.counters["columnar"] += dec.n
+        if self.hotkeys is not None:
+            self.hotkeys.offer_columns(
+                dec.key_buf, dec.key_offsets, dec.hits,
+                hashes=dec.fnv1a,
+            )
 
         if self.ledger is not None:
             return self._serve_columnar_ledger(dec)
@@ -1128,6 +1199,11 @@ class V1Instance:
                 seq=apply_seq,
             )
         self.counters["columnar"] += n
+        if self.hotkeys is not None:
+            self.hotkeys.offer_columns(
+                dec.key_buf, dec.key_offsets, dec.hits,
+                hashes=dec.fnv1a,
+            )
         if owner_strs:
             return wire_codec.encode_resps_owner(
                 status, limit, remaining, reset, owner_meta_idx, owner_strs
@@ -1217,7 +1293,7 @@ class V1Instance:
             raise ServiceError(
                 f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'"
             )
-        with span("V1Instance.get_peer_rate_limits", batch=len(requests)):
+        with span("service.get_peer_rate_limits", batch=len(requests)):
             return self.apply_local_batch(list(requests))
 
     def update_peer_globals(self, globals_: Sequence[UpdatePeerGlobal]) -> None:

@@ -1,0 +1,167 @@
+"""Hot-key attribution: a space-saving top-K sketch over decision keys.
+
+Metwally's space-saving algorithm with a fixed capacity of counters:
+every offered (key, hits) either bumps its existing counter or evicts
+the minimum counter, inheriting its count as the new entry's error
+bound.  Guarantees: any key with true count > count_min is IN the
+table, and each reported count over-estimates by at most its recorded
+`err`.  That is exactly the contract /debug/hotkeys needs — "which
+keys are the load" with an honest error bar — in O(capacity) memory
+regardless of key cardinality.
+
+Batch entry points pre-aggregate with numpy on the decoded wire
+columns (one np.unique per batch, dict work only per UNIQUE key), so
+the serving paths pay O(batch log batch) numpy + O(unique) Python —
+the same amortization shape as the GLOBAL window aggregation.  The
+whole surface is gated by GUBER_HOTKEYS; disabled costs one attribute
+check per batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class SpaceSaving:
+    """Fixed-capacity top-K counter table (thread-safe).
+
+    Eviction uses a LAZY MIN-HEAP of (count-at-push, key) entries
+    instead of an O(capacity) min() scan: counts only grow, so a heap
+    entry is either current (evictable) or stale (its key was bumped
+    or already evicted — pop and, if live, re-push at the current
+    count).  Amortized O(log K) per eviction; the table is on
+    default-enabled serve paths where a full scan per new key would
+    be a per-batch tax on high-cardinality workloads."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self.capacity = max(1, capacity)
+        # key -> [count, err]
+        self._items: Dict[bytes, List[int]] = {}
+        # guberlint: guard _heap by _lock
+        self._heap: list = []  # lazy (count_at_push, key) min-heap
+        self._lock = threading.Lock()  # guberlint: guards _items
+        self.offered = 0  # guberlint: guarded-by _lock
+
+    def _pop_min_locked(self) -> tuple:
+        """(min_key, min_count) via the lazy heap; stale entries are
+        dropped or refreshed on the way down."""
+        import heapq
+
+        while True:
+            count, key = heapq.heappop(self._heap)
+            it = self._items.get(key)
+            if it is None:
+                continue  # evicted earlier; stale entry
+            if it[0] != count:
+                # Bumped since pushed: refresh at the current count.
+                heapq.heappush(self._heap, (it[0], key))
+                continue
+            return key, count
+
+    def _offer_locked(self, key: bytes, n: int) -> None:
+        import heapq
+
+        it = self._items.get(key)
+        if it is not None:
+            it[0] += n  # heap entry goes stale; refreshed lazily
+            return
+        if len(self._items) < self.capacity:
+            self._items[key] = [n, 0]
+            heapq.heappush(self._heap, (n, key))
+            return
+        # Evict the minimum counter; the newcomer inherits its count
+        # as the over-estimate bound (Metwally et al. 2005).
+        min_key, min_count = self._pop_min_locked()
+        del self._items[min_key]
+        self._items[key] = [min_count + n, min_count]
+        heapq.heappush(self._heap, (min_count + n, key))
+
+    def offer(self, key: bytes, n: int = 1) -> None:
+        with self._lock:
+            self.offered += n
+            self._offer_locked(key, n)
+
+    def offer_many(self, pairs) -> None:
+        """(key bytes, hits) iterable under ONE lock acquisition."""
+        with self._lock:
+            for key, n in pairs:
+                self.offered += n
+                self._offer_locked(key, n)
+
+    def offer_columns(
+        self, key_buf, key_offsets, hits, idx=None, hashes=None
+    ) -> None:
+        """Decoded-wire-batch entry: with `hashes` (the decode's
+        per-row fnv1a), rows group by hash in ONE np.unique pass and
+        key bytes materialize only per UNIQUE key — a 1000-occurrence
+        hot-key batch costs one slice, which is what lets the
+        zero-per-key-Python serve paths afford this hook.  (Hash
+        identity: a 64-bit collision merges two keys' counts — noise
+        far below the sketch's own error bound.)  Without hashes the
+        per-row fallback runs.  `idx` restricts to a subset of rows
+        (the GLOBAL serve route's owned/non-owned splits reuse the
+        same decode)."""
+        import numpy as np
+
+        offs = np.asarray(key_offsets)
+        h = np.asarray(hits, dtype=np.int64)
+        starts = offs[:-1]
+        lens = offs[1:] - starts
+        if idx is not None:
+            starts, lens, h = starts[idx], lens[idx], h[idx]
+        if len(starts) == 0:
+            return
+        # Decisions with hits=0 are status reads; count them as one
+        # observation each so read-hot keys still surface.
+        weight = np.maximum(h, 1)
+        if hashes is not None:
+            hh = np.asarray(hashes)
+            if idx is not None:
+                hh = hh[idx]
+            _u, first, inv = np.unique(
+                hh, return_index=True, return_inverse=True
+            )
+            weight = np.bincount(inv, weights=weight).astype(np.int64)
+            starts, lens = starts[first], lens[first]
+        buf = np.asarray(key_buf)
+        self.offer_many(
+            (buf[a:a + l].tobytes(), w)
+            for a, l, w in zip(
+                starts.tolist(), lens.tolist(), weight.tolist()
+            )
+        )
+
+    def top(self, n: int = 20) -> List[Tuple[bytes, int, int]]:
+        """[(key, estimated count, error bound)] sorted descending."""
+        with self._lock:
+            rows = sorted(
+                ((k, v[0], v[1]) for k, v in self._items.items()),
+                key=lambda r: r[1],
+                reverse=True,
+            )
+        return rows[:n]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "tracked": len(self._items),
+                "offered": self.offered,
+            }
+
+
+def from_env() -> Optional[SpaceSaving]:
+    """Build the instance-level sketch from GUBER_HOTKEYS /
+    GUBER_HOTKEYS_K (None when disabled)."""
+    import os
+
+    if os.environ.get("GUBER_HOTKEYS", "1").strip().lower() in (
+        "0", "false", "no", "off"
+    ):
+        return None
+    try:
+        k = int(os.environ.get("GUBER_HOTKEYS_K", "1024"))
+    except ValueError:
+        k = 1024
+    return SpaceSaving(capacity=k)

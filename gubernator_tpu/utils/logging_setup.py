@@ -21,6 +21,16 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        # Structured logs join the trace that emitted them: a log line
+        # inside an active span carries its trace_id, so the flight
+        # recorder's tail trees and the logs correlate on one id
+        # (utils/tracing.current_trace_id; '' when tracing is off —
+        # one global check).
+        from gubernator_tpu.utils.tracing import current_trace_id
+
+        trace_id = current_trace_id()
+        if trace_id:
+            out["trace_id"] = trace_id
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out)

@@ -53,33 +53,126 @@ def swallowed_counts() -> dict:
 
 
 class DurationStat:
-    """Cheap duration summary (count + sum seconds), exported as a
-    prometheus summary.  Observations happen on flush/round boundaries
-    (ms-scale work), so a tiny lock is fine; the per-decision hot path
-    never touches one."""
+    """Duration summary (count + sum + max seconds) PLUS a streaming
+    fixed-bucket histogram for real quantiles — a mean-only stat let
+    call sites advertise a "p50 budget" while reporting means, which
+    hides exactly the tail the flight recorder exists to attribute.
+    Buckets are log2-spaced from 1µs: bucket i covers
+    [2^i µs, 2^(i+1) µs), 36 buckets reaching ~19h, so one observe is
+    a frexp + an increment.  Observations happen on flush/round
+    boundaries (ms-scale work), so a tiny lock is fine; the
+    per-decision hot path never touches one."""
 
-    __slots__ = ("count", "total", "max", "_lock")
+    __slots__ = ("count", "total", "max", "buckets", "_lock")
 
-    # guberlint: guard count, total, max by _lock
+    N_BUCKETS = 36
+    _BASE = 1e-6  # bucket 0 lower bound: 1µs
+
+    # guberlint: guard count, total, max, buckets by _lock
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.max = 0.0
+        self.buckets = [0] * self.N_BUCKETS
         self._lock = threading.Lock()
 
+    @classmethod
+    def bucket_of(cls, seconds: float) -> int:
+        import math
+
+        if seconds <= cls._BASE:
+            return 0
+        # frexp is exact and ~3x cheaper than log2 here: for
+        # m * 2^e with m in [0.5, 1), floor(log2(x)) == e - 1.
+        _m, e = math.frexp(seconds / cls._BASE)
+        return min(cls.N_BUCKETS - 1, max(0, e - 1))
+
+    @classmethod
+    def bucket_bounds(cls, i: int) -> tuple:
+        return (cls._BASE * (1 << i), cls._BASE * (1 << (i + 1)))
+
     def observe(self, seconds: float) -> None:
+        b = self.bucket_of(seconds)
         with self._lock:
             self.count += 1
             self.total += seconds
             if seconds > self.max:
                 self.max = seconds
+            self.buckets[b] += 1
+
+    def observe_bucket_counts(self, counts) -> None:
+        """Merge pre-bucketed counts (index-aligned with N_BUCKETS) —
+        the native event collector drains per-stage C histograms this
+        way, one lock per drain instead of one per event."""
+        n = total = 0.0
+        top = 0.0
+        for i, c in enumerate(counts):
+            if c:
+                n += c
+                lo, hi = self.bucket_bounds(i)
+                total += c * (lo + hi) / 2.0
+                top = (lo * hi) ** 0.5
+        if not n:
+            return
+        with self._lock:
+            self.count += int(n)
+            self.total += total
+            # Max at bucket resolution (the geometric midpoint of the
+            # highest occupied bucket) — pre-bucketed merges lose the
+            # exact extremum by construction.
+            if top > self.max:
+                self.max = top
+            for i, c in enumerate(counts):
+                if c:
+                    self.buckets[i] += int(c)
 
     def mean(self) -> float:
         # Under the lock so count/total come from the same observation
         # (a torn pair between two observes skews the scrape).
         with self._lock:
             return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Streaming quantile from the histogram (geometric bucket
+        midpoint; resolution is a factor of 2 — honest for budget
+        attribution, not for micro-benchmarks)."""
+        with self._lock:
+            n = self.count
+            if not n:
+                return 0.0
+            rank = q * (n - 1)
+            seen = 0
+            for i, c in enumerate(self.buckets):
+                seen += c
+                if seen > rank:
+                    lo, hi = self.bucket_bounds(i)
+                    return (lo * hi) ** 0.5
+            return self.max
+
+    def p50(self) -> float:
+        return self.quantile(0.50)
+
+    def p99(self) -> float:
+        return self.quantile(0.99)
+
+    def snapshot_ms(self, digits: int = 3) -> dict:
+        """The canonical {count, mean_ms, p50_ms, p99_ms, max_ms}
+        rendering — shared by stage_budget(), /debug/vars, and the
+        bench artifacts so the shape cannot drift between them."""
+        with self._lock:
+            count = self.count
+            mean_s = self.total / count if count else 0.0
+            max_s = self.max
+        # The quantiles take the lock themselves; an observation
+        # landing between the reads skews one scrape by one event.
+        return {
+            "count": count,
+            "mean_ms": round(mean_s * 1e3, digits),
+            "p50_ms": round(self.p50() * 1e3, digits),
+            "p99_ms": round(self.p99() * 1e3, digits),
+            "max_ms": round(max_s * 1e3, digits),
+        }
 
 
 class InstanceCollector(Collector):
@@ -398,6 +491,83 @@ class InstanceCollector(Collector):
         for stage, stat in inst.stage_timers.items():
             s.add_metric([stage], count_value=stat.count, sum_value=stat.total)
         yield s
+
+        # Streaming stage quantiles (DurationStat's fixed-bucket
+        # histogram): the p50/p99 the budget tables used to fake with
+        # means.  One series per (stage, quantile); native stages (the
+        # event-ring histograms) join under a native_ prefix.
+        g = GaugeMetricFamily(
+            "gubernator_stage_quantile_seconds",
+            "Streaming per-stage latency quantiles (log2-bucket "
+            "histogram; resolution one octave).  Stages: the pipeline "
+            "stage timers plus the event-ring stages under their own "
+            "names (native_serve / window_wait / window_serve).",
+            labels=["stage", "quantile"],
+        )
+        quantile_stats = dict(inst.stage_timers)
+        ev = getattr(inst, "native_events", None)
+        if ev is not None:
+            # The collector's stage names (native_serve / window_wait /
+            # window_serve) are already distinct from the stage-timer
+            # keys and must match gubernator_native_events' labels —
+            # joins on the stage label depend on it.
+            quantile_stats.update(ev.histograms())
+        for stage, stat in quantile_stats.items():
+            g.add_metric([stage, "0.5"], stat.p50())
+            g.add_metric([stage, "0.99"], stat.p99())
+        yield g
+
+        # Native event ring (core/native/event_ring.cpp, drained by
+        # utils/native_events.py): per-stage C-front latency events and
+        # the ring's overflow drops — the first per-decision visibility
+        # inside the native plane.
+        if ev is not None:
+            c = CounterMetricFamily(
+                "gubernator_native_events",
+                "Event-ring records drained from the C front, by "
+                "stage (native_serve | window_wait | window_serve).",
+                labels=["stage"],
+            )
+            for stage, n in sorted(ev.event_counts().items()):
+                c.add_metric([stage], n)
+            yield c
+            rs = ev.ring_stats()
+            c = CounterMetricFamily(
+                "gubernator_native_ring_dropped",
+                "Event-ring writes dropped because the ring was full "
+                "(the C front never blocks on observability).",
+            )
+            c.add_metric([], rs.get("dropped", 0))
+            yield c
+            s = SummaryMetricFamily(
+                "gubernator_native_stage_duration",
+                "Seconds per native-front stage, from the event ring.",
+                labels=["stage"],
+            )
+            for stage, stat in ev.histograms().items():
+                s.add_metric(
+                    [stage], count_value=stat.count, sum_value=stat.total
+                )
+            yield s
+
+        # Hot-key attribution (utils/hotkeys.py space-saving sketch):
+        # the top-K decision keys by estimated hit count, so load and
+        # the p99 tail can be attributed to specific keys
+        # (/debug/hotkeys serves the same table with error bounds).
+        hk = getattr(inst, "hotkeys", None)
+        if hk is not None:
+            g = GaugeMetricFamily(
+                "gubernator_hotkeys",
+                "Estimated hits for the top-K decision keys "
+                "(space-saving sketch; over-estimate bounded by the "
+                "reported error).",
+                labels=["key"],
+            )
+            for key, count, _err in hk.top(10):
+                g.add_metric(
+                    [key.decode(errors="replace")], float(count)
+                )
+            yield g
 
         # Decision-ledger counters (core/ledger.py): decisions answered
         # on the host without a device dispatch, rows that fell through

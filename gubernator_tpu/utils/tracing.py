@@ -13,12 +13,25 @@ Three backends, selected by `init_tracing()`:
 - in-memory recorder (`InMemoryTracer`, or
   GUBER_TRACING=memory): dependency-free span capture with parent
   links, attributes, and events — the test oracle
-  (tests/test_tracing.py) and a flight-recorder for debugging.
+  (tests/test_tracing.py) and the tail flight recorder's feed
+  (utils/flight_recorder.py).
 
-Span sites (matching the reference's observability depth):
-service entry points, engine batches/rounds/sweeps, peer batch
-flushes, GLOBAL hit/broadcast windows — each with batch-size/round
-attributes.
+Cross-tier context (OBSERVABILITY.md):
+
+Every span carries a W3C-traceparent-shaped context — (trace_id,
+span_id, sampled) — and spans can be parented three ways:
+
+- nesting (same thread, like OTel's implicit context);
+- ``parent_ctx=`` — an explicit LOCAL parent, for work handed to
+  another thread (forward pool, flush workers, fan-out pools);
+- ``remote_parent=`` — a context extracted from an incoming RPC's
+  ``traceparent`` metadata: the span joins the caller's trace across
+  the process boundary (``remote=True`` on the recorded span).
+
+`grpc_metadata()` injects the current context into outgoing gRPC
+metadata; `remote_parent_from_metadata()` extracts it server-side.
+Both are None/no-op while tracing is disabled, so the wire paths pay
+one global check and nothing else.
 """
 
 from __future__ import annotations
@@ -29,12 +42,56 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 log = logging.getLogger("gubernator_tpu.tracing")
 
 _tracer = None
 _initialized = False
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """W3C-traceparent-shaped span identity: 32-hex trace_id, 16-hex
+    span_id, sampled flag — what travels on the wire."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    """``00-<trace_id>-<span_id>-<flags>`` (W3C Trace Context)."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+
+
+def parse_traceparent(value: str) -> Optional[TraceContext]:
+    """Inverse of format_traceparent; None on anything malformed (a
+    bad header must never fail the RPC carrying it)."""
+    try:
+        parts = value.strip().split("-")
+        if len(parts) != 4:
+            return None
+        version, trace_id, span_id, flags = parts
+        if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+            return None
+        int(trace_id, 16)
+        int(span_id, 16)
+        return TraceContext(
+            trace_id=trace_id,
+            span_id=span_id,
+            sampled=bool(int(flags, 16) & 1),
+        )
+    except (ValueError, AttributeError):
+        return None
 
 
 @dataclass
@@ -47,6 +104,17 @@ class RecordedSpan:
     parent: Optional[str] = None  # parent span name (None = root)
     start_ns: int = 0
     end_ns: int = 0
+    # Cross-tier identity (TraceContext-shaped).
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: Optional[str] = None
+    # True when the parent lives in another process (the context came
+    # in via RPC metadata).
+    remote: bool = False
+
+    @property
+    def context(self) -> TraceContext:
+        return TraceContext(trace_id=self.trace_id, span_id=self.span_id)
 
     def set_attribute(self, key: str, value) -> None:
         self.attributes[key] = value
@@ -57,12 +125,20 @@ class RecordedSpan:
 
 class InMemoryTracer:
     """Thread-safe span recorder with a per-thread active-span stack
-    (parent links come from nesting, like OTel's context)."""
+    (parent links come from nesting, like OTel's context) plus
+    explicit local/remote parenting for cross-thread and cross-process
+    stitching.  Bounded: the oldest finished spans are shed past
+    `max_spans` (a long-lived daemon must not grow without bound)."""
 
-    def __init__(self) -> None:
-        self.finished: List[RecordedSpan] = []
+    def __init__(self, max_spans: int = 100_000) -> None:
+        from collections import deque
+
+        self.finished = deque(maxlen=max(1, max_spans))
         self._lock = threading.Lock()
         self._local = threading.local()
+        # Root-finish hook (utils/flight_recorder.py): called with the
+        # outermost span of a thread's stack right after it finishes.
+        self.on_root_finish = None
 
     def _stack(self) -> List[RecordedSpan]:
         st = getattr(self._local, "stack", None)
@@ -70,14 +146,48 @@ class InMemoryTracer:
             st = self._local.stack = []
         return st
 
+    def current_context(self) -> Optional[TraceContext]:
+        st = getattr(self._local, "stack", None)
+        return st[-1].context if st else None
+
     @contextlib.contextmanager
-    def start_span(self, name: str, **attributes) -> Iterator[RecordedSpan]:
+    def start_span(
+        self,
+        name: str,
+        remote_parent: Optional[TraceContext] = None,
+        parent_ctx: Optional[TraceContext] = None,
+        **attributes,
+    ) -> Iterator[RecordedSpan]:
         stack = self._stack()
+        if remote_parent is not None:
+            trace_id = remote_parent.trace_id
+            parent_span_id: Optional[str] = remote_parent.span_id
+            remote = True
+            parent_name = None
+        elif parent_ctx is not None:
+            trace_id = parent_ctx.trace_id
+            parent_span_id = parent_ctx.span_id
+            remote = False
+            parent_name = None
+        elif stack:
+            trace_id = stack[-1].trace_id
+            parent_span_id = stack[-1].span_id
+            remote = False
+            parent_name = stack[-1].name
+        else:
+            trace_id = _new_trace_id()
+            parent_span_id = None
+            remote = False
+            parent_name = None
         s = RecordedSpan(
             name=name,
             attributes=dict(attributes),
-            parent=stack[-1].name if stack else None,
+            parent=parent_name,
             start_ns=time.monotonic_ns(),
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_span_id=parent_span_id,
+            remote=remote,
         )
         stack.append(s)
         try:
@@ -87,6 +197,61 @@ class InMemoryTracer:
             s.end_ns = time.monotonic_ns()
             with self._lock:
                 self.finished.append(s)
+            # Fire for this PROCESS's trace roots: spans with no
+            # parent anywhere, plus remote-parented handler spans —
+            # on an owner node every root is rpc.* with a remote
+            # parent, and excluding those would leave its flight
+            # recorder permanently empty.  Locally re-anchored pool
+            # spans (parent_ctx: global.owner_rpc, forward.group,
+            # broadcast pushes) stay excluded — they belong to a
+            # local decision's trace, and feeding them would inflate
+            # the rolling-p99 threshold with RPC-timeout-scale
+            # durations and duplicate their trace's trees.
+            if (
+                not stack
+                and (s.parent_span_id is None or s.remote)
+                and self.on_root_finish is not None
+            ):
+                try:
+                    self.on_root_finish(s)
+                except Exception:  # noqa: BLE001 — recording must not
+                    # fail the traced operation.
+                    log.exception("root-finish hook failed")
+
+    def record_span(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        parent_ctx: Optional[TraceContext] = None,
+        **attributes,
+    ) -> RecordedSpan:
+        """Record an already-finished span from externally measured
+        timestamps (monotonic ns) — the native event collector's span
+        stubs (utils/native_events.py)."""
+        if parent_ctx is not None:
+            trace_id, parent_span_id = parent_ctx.trace_id, parent_ctx.span_id
+        else:
+            trace_id, parent_span_id = _new_trace_id(), None
+        s = RecordedSpan(
+            name=name,
+            attributes=dict(attributes),
+            start_ns=start_ns,
+            end_ns=end_ns,
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_span_id=parent_span_id,
+        )
+        with self._lock:
+            self.finished.append(s)
+        return s
+
+    def add_event(self, name: str, **attrs) -> None:
+        """Attach an event to this thread's current span (no-op when
+        none is open)."""
+        st = getattr(self._local, "stack", None)
+        if st:
+            st[-1].add_event(name, **attrs)
 
     # Test helpers -----------------------------------------------------
 
@@ -95,23 +260,93 @@ class InMemoryTracer:
             out = list(self.finished)
         return [s for s in out if name is None or s.name == name]
 
+    def trace(
+        self, trace_id: str, max_scan: Optional[int] = None
+    ) -> List[RecordedSpan]:
+        """Finished spans of one trace.  `max_scan` bounds the walk to
+        the NEWEST that many spans (the flight recorder captures at
+        root finish, when the trace's spans are by construction the
+        most recent — an unbounded filter of a 100k-span deque under
+        this lock would stall every concurrent span finish)."""
+        import itertools
+
+        with self._lock:
+            if max_scan is None or len(self.finished) <= max_scan:
+                return [s for s in self.finished if s.trace_id == trace_id]
+            # islice actually STOPS the walk at max_scan (a filtering
+            # comprehension over the whole deque would still iterate
+            # every element under this lock).
+            out = [
+                s
+                for s in itertools.islice(
+                    reversed(self.finished), max_scan
+                )
+                if s.trace_id == trace_id
+            ]
+            out.reverse()
+            return out
+
     def clear(self) -> None:
         with self._lock:
             self.finished.clear()
 
 
 class _OtelTracer:
-    """Adapter presenting the start_span interface over an OTel tracer."""
+    """Adapter presenting the start_span interface over an OTel tracer
+    (remote parents become OTel remote SpanContexts)."""
 
     def __init__(self, tracer) -> None:
         self._tracer = tracer
 
     @contextlib.contextmanager
-    def start_span(self, name: str, **attributes) -> Iterator[object]:
-        with self._tracer.start_as_current_span(name) as s:
+    def start_span(
+        self,
+        name: str,
+        remote_parent: Optional[TraceContext] = None,
+        parent_ctx: Optional[TraceContext] = None,
+        **attributes,
+    ) -> Iterator[object]:
+        from opentelemetry import context as otel_context
+        from opentelemetry import trace as otel_trace
+
+        ctx = None
+        parent = remote_parent or parent_ctx
+        if parent is not None:
+            span_ctx = otel_trace.SpanContext(
+                trace_id=int(parent.trace_id, 16),
+                span_id=int(parent.span_id, 16),
+                is_remote=remote_parent is not None,
+                trace_flags=otel_trace.TraceFlags(
+                    otel_trace.TraceFlags.SAMPLED if parent.sampled else 0
+                ),
+            )
+            ctx = otel_trace.set_span_in_context(
+                otel_trace.NonRecordingSpan(span_ctx),
+                otel_context.get_current(),
+            )
+        with self._tracer.start_as_current_span(name, context=ctx) as s:
             for k, v in attributes.items():
                 s.set_attribute(k, v)
             yield s
+
+    def current_context(self) -> Optional[TraceContext]:
+        from opentelemetry import trace as otel_trace
+
+        sc = otel_trace.get_current_span().get_span_context()
+        if not sc.is_valid:
+            return None
+        return TraceContext(
+            trace_id=format(sc.trace_id, "032x"),
+            span_id=format(sc.span_id, "016x"),
+            sampled=bool(sc.trace_flags & 1),
+        )
+
+    def add_event(self, name: str, **attrs) -> None:
+        from opentelemetry import trace as otel_trace
+
+        s = otel_trace.get_current_span()
+        if s.get_span_context().is_valid:
+            s.add_event(name, attributes=attrs)
 
 
 def init_tracing(service_name: str = "gubernator_tpu") -> bool:
@@ -164,14 +399,84 @@ def current_tracer():
     return _tracer
 
 
+def active() -> bool:
+    """One global check — what the disabled hot path pays."""
+    return _tracer is not None
+
+
+def current_context() -> Optional[TraceContext]:
+    """The active span's context on THIS thread (None when tracing is
+    off or no span is open) — capture it before handing work to
+    another thread, then re-anchor with span(..., parent_ctx=ctx)."""
+    if _tracer is None:
+        return None
+    try:
+        return _tracer.current_context()
+    except Exception:  # noqa: BLE001 — a custom tracer without contexts
+        return None
+
+
+def current_trace_id() -> str:
+    """Hex trace id of the active span ('' when none) — what the
+    structured log lines carry (utils/logging_setup.py)."""
+    ctx = current_context()
+    return ctx.trace_id if ctx is not None else ""
+
+
+def grpc_metadata() -> Optional[Tuple[Tuple[str, str], ...]]:
+    """Outgoing gRPC metadata carrying the current trace context as a
+    W3C ``traceparent`` pair, or None when tracing is off / no span is
+    active (grpc accepts metadata=None)."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    return (("traceparent", format_traceparent(ctx)),)
+
+
+def remote_parent_from_metadata(metadata) -> Optional[TraceContext]:
+    """Extract a ``traceparent`` context from incoming RPC metadata
+    (server side).  None when tracing is off or no valid header is
+    present."""
+    if _tracer is None or metadata is None:
+        return None
+    for k, v in metadata:
+        if k == "traceparent":
+            return parse_traceparent(v)
+    return None
+
+
 @contextlib.contextmanager
-def span(name: str, **attributes) -> Iterator[Optional[object]]:
-    """Start a span when tracing is active, else a no-op context."""
+def span(
+    name: str,
+    remote_parent: Optional[TraceContext] = None,
+    parent_ctx: Optional[TraceContext] = None,
+    **attributes,
+) -> Iterator[Optional[object]]:
+    """Start a span when tracing is active, else a no-op context.
+    `remote_parent` joins an RPC caller's trace; `parent_ctx` anchors
+    to a local span on another thread."""
     if _tracer is None:
         yield None
         return
-    with _tracer.start_span(name, **attributes) as s:
+    with _tracer.start_span(
+        name, remote_parent=remote_parent, parent_ctx=parent_ctx,
+        **attributes,
+    ) as s:
         yield s
+
+
+def add_event(name: str, **attrs) -> None:
+    """Attach an event to the current span (no-op when tracing is off
+    or no span is open) — degraded answers and circuit-open refusals
+    mark themselves this way so the flight recorder can show WHY a
+    tail request took the path it took.  Delegates to the backend
+    (both the in-memory recorder and the OTel adapter implement
+    add_event), so the events reach real exporters, not just tests."""
+    if _tracer is None:
+        return
+    hook = getattr(_tracer, "add_event", None)
+    if hook is not None:
+        hook(name, **attrs)
 
 
 def shutdown_tracing() -> None:

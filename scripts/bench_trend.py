@@ -94,6 +94,20 @@ def _extract(data: dict) -> dict | None:
             out["error_rate"] = round(
                 data["errors"] / data["requests"], 4
             )
+    # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
+    # the delta (the < 2% acceptance bar), and the event-ring drop
+    # count so the trend shows observability's cost alongside its
+    # coverage.
+    if data.get("tracing_delta_pct") is not None:
+        out["tracing_off_value"] = data.get("tracing_off_value")
+        out["tracing_delta_pct"] = data["tracing_delta_pct"]
+    ev = data.get("native_events")
+    if isinstance(ev, dict):
+        ring = ev.get("ring") or {}
+        if ring.get("dropped") is not None:
+            out["ring_dropped"] = ring["dropped"]
+        if ring.get("written") is not None:
+            out["ring_written"] = ring["written"]
     return out or None
 
 

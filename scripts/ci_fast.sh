@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly three things:
+# Runs exactly four things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -13,13 +13,17 @@
 #      stage is held to a 10 s wall budget so it stays cheap enough to
 #      run first; the passes' seeded bad fixtures run inside the
 #      tier-1 pytest below (tests/test_guberlint.py);
-#   2. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   2. the trace smoke (scripts/trace_smoke.py): one in-memory-traced
+#      decision end-to-end through the real router, asserting a
+#      non-empty stitched span tree (root + engine child sharing one
+#      trace id) — jax-free, same 10 s wall budget as guberlint;
+#   3. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants; the
 #      multi-cycle soaks are @slow);
-#   3. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#   4. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -46,6 +50,21 @@ echo "guberlint: ${LINT_MS} ms (budget 10000 ms)" >&2
 if [ "${LINT_MS}" -gt 10000 ]; then
   echo "guberlint: blew its 10 s budget — it must stay cheap enough" >&2
   echo "to run as ci_fast stage one; profile the new pass" >&2
+  exit 1
+fi
+
+echo "=== trace smoke (in-memory stitched tree) ===" >&2
+SMOKE_T0=$(date +%s%N)
+if ! python scripts/trace_smoke.py; then
+  echo "trace smoke: a traced decision no longer yields a stitched" >&2
+  echo "span tree (scripts/trace_smoke.py; OBSERVABILITY.md)" >&2
+  exit 1
+fi
+SMOKE_MS=$(( ($(date +%s%N) - SMOKE_T0) / 1000000 ))
+echo "trace smoke: ${SMOKE_MS} ms (budget 10000 ms)" >&2
+if [ "${SMOKE_MS}" -gt 10000 ]; then
+  echo "trace smoke blew its 10 s budget — it must stay jax-free and" >&2
+  echo "cheap enough to run before the tier-1 suite" >&2
   exit 1
 fi
 

@@ -1,0 +1,103 @@
+"""Trace smoke: one in-memory-traced decision end-to-end, fast.
+
+ci_fast.sh stage (mirroring the guberlint stage pattern, same 10 s
+wall budget): run a single decision through the REAL service router
+with the in-memory tracer installed and assert a non-empty STITCHED
+tree — a root `service.get_rate_limits` span with a child engine span
+sharing its trace id and parented to its span id.  Catches the two
+regressions that would silently blind the observability plane: the
+tracer no longer recording, or parent/trace ids no longer linking.
+
+Deliberately jax-free (a stub engine): the smoke budget is spent on
+the tracing plumbing, not on XLA warmup — the full cross-process
+stitching (forwarder → owner → broadcast with remote parents) is
+pinned by tests/test_trace_stitch.py in the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    from gubernator_tpu.utils import tracing
+
+    tracer = tracing.InMemoryTracer()
+    tracing.set_tracer(tracer)
+
+    from gubernator_tpu.clock import SYSTEM_CLOCK
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.service import V1Instance
+    from gubernator_tpu.types import (
+        RateLimitReq,
+        RateLimitResp,
+        Status,
+    )
+
+    class SmokeEngine:
+        """Minimal engine: answers UNDER_LIMIT and traces the batch
+        so the smoke asserts a parent→child link, not just a root."""
+
+        clock = SYSTEM_CLOCK
+        store = None
+
+        def get_rate_limits(self, reqs, now_ms=None):
+            with tracing.span("smoke.engine", batch=len(reqs)):
+                return [
+                    RateLimitResp(
+                        status=Status.UNDER_LIMIT,
+                        limit=r.limit,
+                        remaining=max(0, r.limit - r.hits),
+                        reset_time=0,
+                    )
+                    for r in reqs
+                ]
+
+        def cache_size(self) -> int:
+            return 0
+
+        def close(self) -> None:
+            pass
+
+    inst = V1Instance(Config(global_serve_window=0.0), SmokeEngine())
+    try:
+        resps = inst.get_rate_limits(
+            [
+                RateLimitReq(
+                    name="smoke", unique_key="k", hits=1, limit=10,
+                    duration=60_000,
+                )
+            ]
+        )
+        assert resps[0].error == "", resps[0].error
+        assert resps[0].remaining == 9
+    finally:
+        inst.close()
+
+    roots = tracer.spans("service.get_rate_limits")
+    assert len(roots) == 1, f"expected one root span, got {len(roots)}"
+    root = roots[0]
+    children = tracer.spans("smoke.engine")
+    assert children, "engine child span missing — tree is empty"
+    child = children[0]
+    assert child.trace_id == root.trace_id, "trace ids diverged"
+    assert child.parent_span_id == root.span_id, "parent link broken"
+    assert root.span_id and len(root.trace_id) == 32
+    tracing.set_tracer(None)
+    elapsed_ms = (time.monotonic() - t0) * 1e3
+    print(
+        f"trace smoke OK: stitched tree of {len(tracer.spans())} spans "
+        f"(trace {root.trace_id[:8]}…) in {elapsed_ms:.0f} ms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1324,3 +1324,140 @@ def test_suite_stays_inside_the_ci_budget():
     t0 = _time.monotonic()
     run([REPO_ROOT / r for r in LINT_ROOTS])
     assert _time.monotonic() - t0 < 10.0
+
+
+# -------------------------------------------------- drift: trace sub-rule
+# (ISSUE 9 satellite: span-name catalog discipline — every span(...)
+# site unique + snake_case; deliberate twins need reasoned
+# suppressions.)
+
+
+def test_drift_span_name_style_and_duplicate(tmp_path):
+    from tools.guberlint import driftcheck
+
+    root = _drift_repo(tmp_path)
+    (root / "gubernator_tpu" / "spans.py").write_text(
+        textwrap.dedent(
+            """
+            from gubernator_tpu.utils.tracing import span
+
+            def a():
+                with span("BadName.CamelCase"):
+                    pass
+
+            def b():
+                with span("dup.site"):
+                    pass
+
+            def c():
+                with span("dup.site"):
+                    pass
+
+            def ok():
+                with span("fine.snake_case"):
+                    pass
+            """
+        )
+    )
+    findings = driftcheck.check(root, [])
+    rules = {(f.rule, f.detail) for f in findings}
+    assert ("span-name-style", "BadName.CamelCase") in rules
+    assert ("span-name-duplicate", "dup.site") in rules
+    assert not any(
+        d == "fine.snake_case" for _r, d in rules
+    )
+    # Exactly one duplicate finding (the twin, not the first site).
+    assert (
+        sum(1 for f in findings if f.rule == "span-name-duplicate") == 1
+    )
+
+
+def test_drift_span_twin_suppression_respected(tmp_path):
+    from tools.guberlint import driftcheck
+
+    root = _drift_repo(tmp_path)
+    (root / "gubernator_tpu" / "spans.py").write_text(
+        textwrap.dedent(
+            """
+            from gubernator_tpu.utils.tracing import span
+
+            def a():
+                with span("twin.site"):
+                    pass
+
+            def b():
+                # guberlint: ok drift — deliberate sharded twin
+                with span("twin.site"):
+                    pass
+            """
+        )
+    )
+    findings = driftcheck.check(root, [])
+    assert not any(f.rule.startswith("span-name") for f in findings)
+
+
+def test_drift_span_variable_name_not_scanned(tmp_path):
+    """Helper-routed spans (variable name argument) are outside the
+    literal catalog — no style/duplicate findings for them."""
+    from tools.guberlint import driftcheck
+
+    root = _drift_repo(tmp_path)
+    (root / "gubernator_tpu" / "spans.py").write_text(
+        textwrap.dedent(
+            """
+            from gubernator_tpu.utils.tracing import span
+
+            def helper(name):
+                with span(name):
+                    pass
+            """
+        )
+    )
+    findings = driftcheck.check(root, [])
+    assert not any(f.rule.startswith("span-name") for f in findings)
+
+
+# -------------------------------------------------- native: event ring
+# (ISSUE 9 satellite: an event-ring write that calls a Py* API must
+# trip the gil-free check — the ring is reachable from conn_loop.)
+
+
+def test_native_event_ring_write_calling_py_api_trips_gil_check(tmp_path):
+    from tools.guberlint import nativecheck
+
+    code = """
+    // guberlint: gil-free
+    long evr_record(void* ring, long kind, long dur) {
+      PyGILState_Ensure();
+      return 1;
+    }
+
+    // guberlint: gil-free
+    void conn_loop(void* srv, void* ring) {
+      evr_record(ring, 1, 42);
+    }
+    """
+    findings = nativecheck.check_files([_csrc(tmp_path, code)])
+    gil = [f for f in findings if f.rule == "gil-call"]
+    # Both the write itself and the conn_loop root reach the Py* call.
+    roots = {f.scope for f in gil}
+    assert "evr_record" in roots and "conn_loop" in roots
+
+
+def test_native_event_ring_clean_write_passes(tmp_path):
+    from tools.guberlint import nativecheck
+
+    code = """
+    #include <atomic>
+
+    // guberlint: gil-free
+    long evr_record(void* ring, long kind, long dur) {
+      return kind + dur;
+    }
+
+    // guberlint: gil-free
+    void conn_loop(void* srv, void* ring) {
+      evr_record(ring, 1, 42);
+    }
+    """
+    assert nativecheck.check_files([_csrc(tmp_path, code)]) == []

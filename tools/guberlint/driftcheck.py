@@ -17,12 +17,22 @@ The operator contract, enforced (STATIC_ANALYSIS.md):
   PERF/RESILIENCE/STATIC_ANALYSIS or the bench-trend columns).
 - ``drift-metric-stale`` — a doc names a ``gubernator_*`` metric the
   registry no longer exports.
+- ``drift-span-name-style`` / ``drift-span-name-duplicate`` — the
+  trace sub-rule: every literal ``span("name", ...)`` site must be
+  dot-separated snake_case (span names are an operator-facing query
+  surface: /debug/trace, the OTel backend, OBSERVABILITY.md's
+  catalog), and each name must identify ONE site — two sites sharing
+  a name make "where did this span come from" unanswerable.
+  Deliberate twins (the sharded engine mirrors engine.py's stages
+  under the same names so the tests/oracles stay backend-agnostic)
+  carry reasoned suppressions at the twin site.
 
 Knob reads are collected from the AST (string literals used as call
 arguments), so prose/docstrings never count as reads; metric
 registrations are the first-argument literals of ``*MetricFamily``
-constructors.  Suppression uses the normal grammar at the read /
-registration site.
+constructors; span sites are calls to a function named ``span`` with
+a literal first argument.  Suppression uses the normal grammar at the
+read / registration / span site.
 """
 
 from __future__ import annotations
@@ -57,6 +67,7 @@ def check(repo_root: Path, csrcs: List[CSourceFile]) -> List[Finding]:
     reads = _knob_reads(repo_root, csrcs)
     _check_knobs(repo_root, reads, findings)
     _check_metrics(repo_root, findings)
+    _check_spans(repo_root, findings)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 
@@ -153,6 +164,77 @@ def _check_knobs(
                 "it — drop the row or re-wire the knob",
             )
         )
+
+
+# -- span-site surface (the trace sub-rule) ----------------------------
+
+# Dot-separated snake_case: "global.hits_window", "engine.batch".
+_SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)*$")
+
+
+def _span_sites(
+    repo_root: Path,
+) -> List[Tuple[str, SourceFile, int]]:
+    """(name, source, line) for every literal span("name", ...) call
+    under KNOB_SCAN_ROOTS.  Helper-routed spans (a variable name
+    argument) are invisible here by design — the rule governs the
+    literal catalog OBSERVABILITY.md indexes."""
+    out: List[Tuple[str, SourceFile, int]] = []
+    roots = [repo_root / r for r in KNOB_SCAN_ROOTS]
+    for src in iter_py_files(roots, repo_root, exclude=EXCLUDE):
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            if name != "span":
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((arg.value, src, node.lineno))
+    return out
+
+
+def _check_spans(repo_root: Path, findings: List[Finding]) -> None:
+    sites = _span_sites(repo_root)
+    by_name: Dict[str, List[Tuple[SourceFile, int]]] = {}
+    for name, src, line in sites:
+        if not _SPAN_NAME_RE.match(name):
+            if not src.suppressed(line, PASS):
+                findings.append(
+                    Finding(
+                        PASS, "span-name-style", src.rel, line,
+                        "<module>", name,
+                        f"span name {name!r} is not dot-separated "
+                        "snake_case — span names are the /debug/trace "
+                        "+ OTel query surface (OBSERVABILITY.md)",
+                    )
+                )
+        by_name.setdefault(name, []).append((src, line))
+    for name, where in sorted(by_name.items()):
+        if len(where) < 2:
+            continue
+        first_src, first_line = where[0]
+        for src, line in where[1:]:
+            if src.suppressed(line, PASS):
+                continue
+            findings.append(
+                Finding(
+                    PASS, "span-name-duplicate", src.rel, line,
+                    "<module>", name,
+                    f"span name {name!r} is also emitted at "
+                    f"{first_src.rel}:{first_line} — a span name must "
+                    "identify one site; rename, or suppress the "
+                    "deliberate twin with its reason",
+                )
+            )
 
 
 # -- metric surface ----------------------------------------------------
