@@ -214,6 +214,14 @@ def main() -> int:
             # artifact (herdfast is the same front at the window path;
             # GUBER_NATIVE_LEDGER=0 gives the same-session A/B pair).
             result = _run_herd(np, platform, force_fast=True)
+        elif MODE == "devfused":
+            # Same-session fused/unfused device-path A/B: the fused
+            # single-dispatch decision step (GUBER_FUSED default) vs
+            # the unfused compute+scatter chain (GUBER_FUSED=split),
+            # alternating pairs with the median-of-pair-deltas
+            # treatment from herdtrace.  On CPU this run IS the CPU
+            # line the TPU recapture is compared against (PERF.md §24).
+            result = _run_devfused(np, platform)
         elif MODE == "herdtrace":
             # Same-session tracing A/B: the herdfast workload once with
             # tracing disabled and once with the in-memory recorder +
@@ -1000,6 +1008,137 @@ def _run_herdtrace(np, platform: str) -> dict:
         "native_events_off": off.get("native_events"),
         "native_events": on.get("native_events"),
         "ledger": on.get("ledger"),
+        "platform": platform,
+    }
+
+
+def _run_devfused(np, platform: str) -> dict:
+    """Device-path fused/unfused A/B in one session.
+
+    Arms alternate per pair so each pair shares its minute of machine
+    drift (the herdtrace treatment — single-pair deltas swing ±9% on
+    this box): arm A forces GUBER_FUSED=split (the old multi-dispatch
+    gather/scatter chain: compute + scatter programs per round, no
+    step pump), arm B runs the default fused single-kernel step.  The
+    artifact carries both arm medians, every draw, the median of
+    per-pair deltas, and each arm's measured device dispatches/batch —
+    the steady-state fused number must be 1.0 (pinned by
+    tests/test_fused_parity.py)."""
+    from gubernator_tpu.core.engine import DecisionEngine
+
+    pairs = max(1, int(os.environ.get("BENCH_DEVFUSED_PAIRS", "3")))
+    n_batches = max(1, min((N_KEYS + BATCH - 1) // BATCH, 64))
+    batches = []
+    for idx in _key_indices(np, n_batches):
+        batches.append(
+            dict(
+                keys=[b"bench_k%d" % i for i in idx.tolist()],
+                algo=_algo_column(np, idx),
+                behavior=np.zeros(BATCH, dtype=np.int32),
+                hits=np.ones(BATCH, dtype=np.int64),
+                limit=np.full(BATCH, 1_000_000, dtype=np.int64),
+                duration=np.full(BATCH, 3_600_000, dtype=np.int64),
+                burst=np.full(BATCH, 1_000_000, dtype=np.int64),
+            )
+        )
+
+    def measure(engine) -> dict:
+        from collections import deque
+
+        for i in range(WARMUP_BATCHES):
+            engine.apply_columnar(**batches[i % len(batches)])
+        lat_n = min(LATENCY_BATCHES, 50)
+        lat = np.empty(lat_n, dtype=np.float64)
+        for i in range(lat_n):
+            t0 = time.perf_counter()
+            engine.apply_columnar(**batches[i % len(batches)])
+            lat[i] = time.perf_counter() - t0
+        d0, b0 = engine.dispatches_total, engine.batches_total
+        pending = deque()
+        n_done = 0
+        start = time.perf_counter()
+        i = 0
+        while True:
+            pending.append(
+                engine.apply_columnar(
+                    **batches[i % len(batches)], want_async=True
+                )
+            )
+            i += 1
+            if len(pending) > PIPELINE_DEPTH:
+                pending.popleft().get()
+                n_done += BATCH
+            if time.perf_counter() - start >= MEASURE_SECONDS:
+                break
+        while pending:
+            pending.popleft().get()
+            n_done += BATCH
+        elapsed = time.perf_counter() - start
+        d_batches = engine.batches_total - b0
+        return {
+            "rate": n_done / elapsed,
+            "p50_ms": float(np.percentile(lat, 50) * 1e3),
+            "p99_ms": float(np.percentile(lat, 99) * 1e3),
+            "dispatches_per_batch": (
+                round((engine.dispatches_total - d0) / d_batches, 4)
+                if d_batches
+                else 0.0
+            ),
+            "fused_mode": engine.fused_mode,
+        }
+
+    def build(mode: str) -> "DecisionEngine":
+        saved = os.environ.get("GUBER_FUSED")
+        os.environ["GUBER_FUSED"] = mode
+        try:
+            return DecisionEngine(
+                capacity=CAPACITY, max_kernel_width=max(8192, BATCH)
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("GUBER_FUSED", None)
+            else:
+                os.environ["GUBER_FUSED"] = saved
+
+    unfused_runs, fused_runs = [], []
+    unfused_last = fused_last = None
+    for _ in range(pairs):
+        unfused_last = measure(build("split"))
+        unfused_runs.append(unfused_last["rate"])
+        fused_last = measure(build(os.environ.get("GUBER_FUSED", "auto")))
+        fused_runs.append(fused_last["rate"])
+    pair_deltas = [
+        round((b - a) / a * 100, 2)
+        for a, b in zip(unfused_runs, fused_runs)
+        if a
+    ]
+    delta_pct = (
+        round(float(np.median(pair_deltas)), 2) if pair_deltas else None
+    )
+    fused_v = float(np.median(fused_runs))
+    unfused_v = float(np.median(unfused_runs))
+    return {
+        "metric": "rate-limit decisions/sec, device decision plane "
+        f"fused/unfused A/B (batch={BATCH}, median of {pairs} "
+        "alternating pairs: GUBER_FUSED=split vs fused)",
+        "value": round(fused_v, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(fused_v / BASELINE_DECISIONS_PER_SEC, 2),
+        "unfused_value": round(unfused_v, 1),
+        "fused_delta_pct": delta_pct,
+        "pair_deltas_pct": pair_deltas,
+        "unfused_runs": [round(v, 1) for v in unfused_runs],
+        "fused_runs": [round(v, 1) for v in fused_runs],
+        "p50_ms": round(fused_last["p50_ms"], 3),
+        "p99_ms": round(fused_last["p99_ms"], 3),
+        "p50_ms_unfused": round(unfused_last["p50_ms"], 3),
+        "p99_ms_unfused": round(unfused_last["p99_ms"], 3),
+        "dispatches_per_batch": fused_last["dispatches_per_batch"],
+        "dispatches_per_batch_unfused": unfused_last[
+            "dispatches_per_batch"
+        ],
+        "fused_mode": fused_last["fused_mode"],
+        "unfused_mode": unfused_last["fused_mode"],
         "platform": platform,
     }
 

@@ -171,6 +171,12 @@ KNOWN_ENV_KNOBS = (
     "GUBER_BACKEND_PROBE_TIMEOUT",  # daemon.py: probe wall budget, seconds
     "GUBER_PUMP",             # core/engine.py: step-pump mode override
     "GUBER_PUMP_SCAN",        # core/pump.py: fused-scan round loop toggle
+    "GUBER_FUSED",            # core/engine.py: fused-step impl select
+                              # (auto|pallas|interpret|xla|split)
+    "GUBER_WINDOW_DEPTH",     # core/pump.py + core/readback.py:
+                              # double-buffered h2d/d2h window depth
+    "GUBER_PSUM_MERGE",       # parallel/sharded_engine.py: psum column
+                              # merge over the mesh (0 disables)
     "GUBER_MULTI_THREADS",    # core/native.py: native scheduler threads
     "GUBER_SHARDS_SINGLE_PROGRAM",  # parallel/sharded_engine.py: one
                               # pjit program across shards vs per-shard
@@ -193,6 +199,17 @@ KNOWN_ENV_KNOBS = (
     "GUBER_K8S_NAMESPACE",    # discovery/kubernetes.py
     "GUBER_K8S_POD_SELECTOR",  # discovery/kubernetes.py
 )
+
+
+def env_window_depth(default: int = 2) -> int:
+    """The GUBER_WINDOW_DEPTH knob, shared by the step pump's h2d
+    pre-staging and the readback combiner's d2h window prefetch
+    (core/pump.py / core/readback.py) — one parser so the two sides
+    cannot drift."""
+    try:
+        return int(os.environ.get("GUBER_WINDOW_DEPTH", "") or default)
+    except ValueError:
+        return default
 
 
 def _env(d: Dict[str, str], key: str, default: str = "") -> str:

@@ -350,19 +350,65 @@ class DecisionEngine:
         self._lock = threading.RLock()
         # Next window start for incremental sweep.
         self._sweep_cursor = 0  # guberlint: guarded-by _lock
-        # ONE device op per round when XLA compiles the donated
-        # gather→update→scatter in place; otherwise the split pair
-        # (packed_compute + scatter_store, two ops) — probed once per
-        # capacity via XLA's memory analysis (see fused_step_ok).
-        self._fused = fused_step_ok(capacity)
+        # Fused-step implementation select (PERF.md §24).  GUBER_FUSED:
+        #   auto (default) — the Pallas kernel when the backend lowers
+        #     it (pallas_step_ok), else the fused XLA program when the
+        #     donated RMW stays in place (fused_step_ok), else split;
+        #   pallas — force the Pallas kernel (compiled where it lowers,
+        #     interpret mode on backends where it does not);
+        #   interpret — force Pallas interpret mode (CI parity: the
+        #     kernel body runs as traced ops on any backend);
+        #   xla — the fused XLA program, no Pallas attempt;
+        #   split — the UNFUSED compute+scatter pair, multiple device
+        #     dispatches per round (the devfused bench A/B control).
+        import os as _os
+
+        fused_env = (
+            _os.environ.get("GUBER_FUSED", "auto").strip().lower()
+            or "auto"
+        )
+        # _pallas_interpret: None = Pallas off; False = compiled
+        # kernel; True = interpret mode.
+        self._pallas_interpret: Optional[bool] = None
+        if fused_env == "split":
+            self._fused = False
+            self.fused_mode = "split"
+        elif fused_env == "xla":
+            self._fused = fused_step_ok(capacity)
+            self.fused_mode = "xla" if self._fused else "split"
+        elif fused_env in ("pallas", "interpret", "auto"):
+            from gubernator_tpu.ops.pallas_step import pallas_step_ok
+
+            self._fused = fused_step_ok(capacity)
+            want_compiled = (
+                fused_env != "interpret"
+                and jax.default_backend() != "cpu"
+                and pallas_step_ok(capacity)
+            )
+            if want_compiled:
+                self._pallas_interpret = False
+                self.fused_mode = "pallas"
+            elif fused_env == "auto":
+                # CPU (and backends the kernel does not lower on)
+                # serve the fused XLA program — same single-dispatch
+                # shape, same shared lane math.
+                self.fused_mode = "xla" if self._fused else "split"
+            else:
+                # pallas/interpret forced without a compiled path:
+                # interpret mode (correct everywhere; the parity tier).
+                self._pallas_interpret = True
+                self.fused_mode = "pallas-interpret"
+        else:
+            raise ValueError(
+                f"GUBER_FUSED={fused_env!r}: expected "
+                "auto|pallas|interpret|xla|split"
+            )
         # Cross-call dispatch batching (core/pump.py): queue packed
         # rounds, run ≤16 of them per execute RPC via lax.scan.  Only
         # when the scanned program keeps the donated state in place,
         # and only on accelerator backends — the pump amortizes
         # per-RPC transfer/execute overhead that the in-process CPU
         # backend does not have (GUBER_PUMP=1/0 overrides).
-        import os as _os
-
         from gubernator_tpu.ops.bucket_kernel import multi_step_ok
 
         pump_env = _os.environ.get("GUBER_PUMP", "")
@@ -370,6 +416,13 @@ class DecisionEngine:
             pump_env == "1"
             or (pump_env != "0" and jax.default_backend() != "cpu")
         )
+        # The pump's grouped dispatch is the XLA scan family
+        # (multi_fused_step) — grouped rounds would silently bypass a
+        # selected Pallas kernel and misattribute fused_mode, so
+        # Pallas modes run per-round dispatch until a scanned Pallas
+        # family exists (PERF.md §24a).
+        if self._pallas_interpret is not None:
+            want_pump = False
         if want_pump and self._fused and multi_step_ok(capacity):
             from gubernator_tpu.core.pump import StepPump
 
@@ -382,6 +435,12 @@ class DecisionEngine:
         self.over_limit_total = 0  # guberlint: guarded-by _lock
         self.batches_total = 0  # guberlint: guarded-by _lock
         self.rounds_total = 0  # guberlint: guarded-by _lock
+        # Decision-plane DEVICE DISPATCH counter: every device program
+        # the serving path launches (apply step, clears, restores,
+        # collapsed/uniform steps, pump scan groups and their device
+        # stacks) — the numerator of the dispatches-per-batch gauge the
+        # fused plane pins to 1 in steady state (PERF.md §24).
+        self.dispatches_total = 0  # guberlint: guarded-by _lock
         from gubernator_tpu.utils.metrics import DurationStat
 
         self.round_duration = DurationStat()
@@ -554,9 +613,11 @@ class DecisionEngine:
         pin = jnp.asarray(buf)
         if self._fused:
             self._state, pout = fused_fn(self._state, pin)
+            self.dispatches_total += 1
         else:
             slot_dev, vals, pout = compute_fn(self._state, pin)
             self._state = scatter_store(self._state, slot_dev, vals)
+            self.dispatches_total += 2
         self.round_duration.observe(_time.monotonic() - t0)
         return pout
 
@@ -576,11 +637,31 @@ class DecisionEngine:
         t0 = _time.monotonic()
         pin = jnp.asarray(buf)
         self._state, pout = uniform_step(self._state, pin)
+        self.dispatches_total += 1
         self.round_duration.observe(_time.monotonic() - t0)
         return pout
 
     def _dispatch_packed(self, buf: np.ndarray):
+        if self._pallas_interpret is not None:
+            return self._dispatch_pallas(buf)
         return self._dispatch(buf, fused_step, packed_compute)
+
+    def _dispatch_pallas(self, buf: np.ndarray):  # guberlint: holds _lock
+        """The Pallas single-kernel step (ops/pallas_step.py): the
+        whole gather→update→scatter→pack round as ONE device program
+        over the in-place-aliased state columns."""
+        import time as _time
+
+        from gubernator_tpu.ops.pallas_step import pallas_fused_step
+
+        t0 = _time.monotonic()
+        pin = jnp.asarray(buf)
+        self._state, pout = pallas_fused_step(
+            self._state, pin, interpret=self._pallas_interpret
+        )
+        self.dispatches_total += 1
+        self.round_duration.observe(_time.monotonic() - t0)
+        return pout
 
     def _flush_pump(self) -> None:
         """Apply queued pump rounds before any OTHER state access (see
@@ -600,6 +681,7 @@ class DecisionEngine:
         self._state = self._state._replace(
             meta=clear_occupied(self._state.meta, jnp.asarray(c))
         )
+        self.dispatches_total += 1
 
     def _apply_restores(self, restores: List[tuple]) -> None:  # guberlint: holds _lock
         """Hydrate store-provided bucket values into fresh slots —
@@ -610,6 +692,7 @@ class DecisionEngine:
             self._state,
             SlotRecord(**{k: jnp.asarray(a) for k, a in rec.items()}),
         )
+        self.dispatches_total += 1
 
     def _write_through(
         self,
@@ -1281,6 +1364,7 @@ class DecisionEngine:
                 self.requests_total,
                 self.batches_total,
                 self.rounds_total,
+                self.dispatches_total,
                 self.table.hits,
                 self.table.misses,
             )
@@ -1366,6 +1450,7 @@ class DecisionEngine:
                     self.requests_total,
                     self.batches_total,
                     self.rounds_total,
+                    self.dispatches_total,
                     saved_hits,
                     saved_misses,
                 ) = saved

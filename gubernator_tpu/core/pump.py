@@ -64,11 +64,16 @@ class _Group:
 class PumpTicket:
     """One queued packed round.  `fetch()` → host [rows, W] output."""
 
-    __slots__ = ("pump", "buf", "group", "index", "error")
+    __slots__ = ("pump", "buf", "dev", "t_submit", "group", "index", "error")
 
     def __init__(self, pump: "StepPump", buf: np.ndarray) -> None:
         self.pump = pump
         self.buf: Optional[np.ndarray] = buf  # until dispatched
+        # Double-buffered window (GUBER_WINDOW_DEPTH): the h2d upload
+        # of this round, started AT SUBMIT so it overlaps the device
+        # compute of the group currently executing.
+        self.dev = None
+        self.t_submit: float = 0.0
         self.group: Optional[_Group] = None
         self.index: Optional[int] = None
         self.error: Optional[BaseException] = None
@@ -89,7 +94,7 @@ class StepPump:
     order is exactly the engine's serialization):
     """
 
-    # guberlint: guard _queue, _noop, submitted, flushes, fused_rounds by engine._lock
+    # guberlint: guard _queue, _noop, _noop_dev, _dev_stack_cache, submitted, flushes, fused_rounds, prestaged by engine._lock
 
     def __init__(self, engine, max_group: int = MAX_GROUP) -> None:
         import jax
@@ -111,17 +116,49 @@ class StepPump:
             jax.default_backend() != "cpu"
             or os.environ.get("GUBER_PUMP_SCAN") == "1"
         )
+        # Double-buffered host→device windows (PERF.md §24): while
+        # batch N computes on device, batch N+1's packed buffer is
+        # already transferring — submit() starts the h2d immediately
+        # for up to GUBER_WINDOW_DEPTH × max_group outstanding rounds
+        # (0 restores upload-at-flush).  The flush then stacks the
+        # already-device-resident buffers with one tiny cached program
+        # instead of paying a synchronous h2d on the critical path.
+        from gubernator_tpu.config import env_window_depth
+
+        self.window_depth = env_window_depth()
+        self._dev_stack_cache: Dict[tuple, object] = {}
+        self._noop_dev: Dict[tuple, object] = {}  # shape → device buf
         # Telemetry (PERF.md).
         self.submitted = 0
         self.flushes = 0
         self.fused_rounds = 0
+        self.prestaged = 0
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        # Queue wait: submit → flush dispatch (the device plane's
+        # window-wait stage in the §10b/§24 budget).
+        self.window_wait = DurationStat()
 
     # -- engine-lock-held API ------------------------------------------
 
     def submit(self, buf: np.ndarray) -> PumpTicket:  # guberlint: holds engine._lock
         """Queue one packed [PACKED_IN_ROWS, W] round.  Caller holds
         the engine lock (dispatch order = queue order)."""
+        import time as _time
+
         t = PumpTicket(self, buf)
+        t.t_submit = _time.monotonic()
+        if (
+            self.window_depth > 0
+            and len(self._queue) < self.window_depth * self.max_group
+        ):
+            # Start the h2d NOW: the transfer rides the device queue
+            # behind the currently executing group, so upload(N+1)
+            # overlaps compute(N) instead of serializing at flush.
+            import jax
+
+            t.dev = jax.device_put(buf)
+            self.prestaged += 1
         self._queue.append(t)
         self.submitted += 1
         if len(self._queue) >= self.max_group:
@@ -184,6 +221,29 @@ class StepPump:
             self._noop[shape] = buf
         return buf
 
+    def _noop_dev_buf(self, shape):  # guberlint: holds engine._lock
+        import jax
+
+        buf = self._noop_dev.get(shape)
+        if buf is None:
+            buf = jax.device_put(self._noop_buf(shape))
+            self._noop_dev[shape] = buf
+        return buf
+
+    def _dev_stack(self, count: int, shape):  # guberlint: holds engine._lock
+        """Cached device-side stack program: R pre-staged [rows, W]
+        buffers → one [R, rows, W] scan input without a flush-time h2d
+        (the double-buffered-window counterpart of np.stack)."""
+        import jax
+
+        key = (count, shape)
+        prog = self._dev_stack_cache.get(key)
+        if prog is None:
+            # guberlint: shapes fan-in/shape pinned by the cache key; universe {widths} x {2,4,8,16}, precompiled in warmup
+            prog = jax.jit(lambda *xs: jnp.stack(xs))
+            self._dev_stack_cache[key] = prog
+        return prog
+
     def _flush_group(self, group: List[PumpTicket]) -> None:  # guberlint: holds engine._lock
         from gubernator_tpu.ops.bucket_kernel import (
             UNIFORM_IN_ROWS,
@@ -193,31 +253,48 @@ class StepPump:
 
         eng = self.engine
         self.flushes += 1
+        import time as _time
+
+        now_mono = _time.monotonic()
+        for t in group:
+            self.window_wait.observe(max(now_mono - t.t_submit, 0.0))
         shape = group[0].buf.shape
         is_uniform = shape[0] == UNIFORM_IN_ROWS
         if len(group) == 1 or not self._scan_ok:
             for t in group:
+                src = t.dev if t.dev is not None else t.buf
                 pout = (
-                    eng._dispatch_uniform(t.buf) if is_uniform
-                    else eng._dispatch_packed(t.buf)
+                    eng._dispatch_uniform(src) if is_uniform
+                    else eng._dispatch_packed(src)
                 )
                 pout.copy_to_host_async()
                 t.index = None
                 t.buf = None
+                t.dev = None
                 t.group = _Group(pout)
             return
         k = len(group)
         r = 2
         while r < k:
             r *= 2
-        bufs = [t.buf for t in group]
-        bufs += [self._noop_buf(shape)] * (r - k)
-        import time as _time
-
         t0 = _time.monotonic()
-        pins = jnp.asarray(np.stack(bufs))
+        if all(t.dev is not None for t in group):
+            # Every round is already on device (pre-staged at submit):
+            # stack there — no h2d on the flush critical path at all.
+            devs = [t.dev for t in group]
+            devs += [self._noop_dev_buf(shape)] * (r - k)
+            pins = self._dev_stack(r, shape)(*devs)
+            eng.dispatches_total += 1  # the stack program
+        else:
+            # Mixed staging (some rounds past the pre-stage depth):
+            # one host stack + h2d; a ticket's host buf is always
+            # retained until its flush, so no d2h round trip here.
+            bufs = [t.buf for t in group]
+            bufs += [self._noop_buf(shape)] * (r - k)
+            pins = jnp.asarray(np.stack(bufs))
         step = multi_uniform_step if is_uniform else multi_fused_step
         eng._state, pouts = step(eng._state, pins)
+        eng.dispatches_total += 1
         eng.round_duration.observe(_time.monotonic() - t0)
         pouts.copy_to_host_async()  # background transfer starts now
         self.fused_rounds += k
@@ -227,6 +304,7 @@ class StepPump:
             # `group is not None`, so group must be the LAST field set.
             t.index = i
             t.buf = None
+            t.dev = None
             t.group = g
 
     # -- lock-free API -------------------------------------------------
@@ -280,4 +358,9 @@ class StepPump:
                 )
                 eng._state, pouts = step(eng._state, pins)
                 np.asarray(pouts)
+                if self.window_depth > 0:
+                    # Device-stack family for the pre-staged window
+                    # path (same {2,4,8,16} universe as the scans).
+                    dev = self._noop_dev_buf((rows, width))
+                    np.asarray(self._dev_stack(r, (rows, width))(*([dev] * r)))
                 r *= 2

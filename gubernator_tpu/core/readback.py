@@ -69,11 +69,24 @@ class ReadbackCombiner:
         # atomic and last-wins costs one duplicate compile (warmup
         # precompiles the whole universe anyway).
         self._stack_cache: Dict[Tuple, object] = {}
+        # Double-buffered device→host windows (GUBER_WINDOW_DEPTH ≥ 2,
+        # shared knob with core/pump.py): a leader that drains a group
+        # also stacks the NEXT full group and starts its async copy
+        # before distributing the first, so window N+1's transfer
+        # overlaps window N's host-side distribution (PERF.md §24).
+        from gubernator_tpu.config import env_window_depth
+
+        self.window_depth = env_window_depth()
         # Telemetry (PERF.md): transfer RPCs saved = registered -
         # transfers.
         self.registered = 0  # guberlint: guarded-by _lock
         self.transfers = 0  # guberlint: guarded-by _lock
         self.stacked = 0  # guberlint: guarded-by _lock
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        # Wall time of the blocking d2h materialization (the
+        # device.readback stage of the §24 device budget).
+        self.transfer_duration = DurationStat()
 
     def register(self, handle) -> Ticket:
         """Called at dispatch time (engine lock held is fine — this
@@ -144,6 +157,20 @@ class ReadbackCombiner:
         self._queue = [t for t in self._queue if id(t) not in taken]
         return group
 
+    def _take_same_shape_locked(self, shape, dtype) -> List[Ticket]:
+        """Claim up to MAX_GROUP queued tickets of exactly this shape
+        class (the window-prefetch path: a leader must NOT steal other
+        shape classes — concurrent leaders materialize those in
+        parallel).  Caller holds the lock."""
+        group = [
+            t for t in self._queue
+            if t.handle.shape == shape and t.handle.dtype == dtype
+        ][:MAX_GROUP]
+        if group:
+            taken = set(map(id, group))
+            self._queue = [t for t in self._queue if id(t) not in taken]
+        return group
+
     def _fetch(self, ticket: Ticket) -> None:
         while ticket.host is None and ticket.error is None:
             with self._lock:
@@ -151,13 +178,27 @@ class ReadbackCombiner:
                     return
                 in_queue = ticket in self._queue
                 group = self._take_group_locked(ticket) if in_queue else None
+                extra: List[List[Ticket]] = []
+                if group is not None and self.window_depth >= 2:
+                    # Window prefetch: claim up to depth-1 FURTHER
+                    # windows of the SAME shape class so their
+                    # transfers start before this one distributes.
+                    # Other shape classes stay queued for their own
+                    # leaders (concurrent materialization preserved).
+                    shape = group[0].handle.shape
+                    dtype = group[0].handle.dtype
+                    while len(extra) < self.window_depth - 1:
+                        nxt = self._take_same_shape_locked(shape, dtype)
+                        if not nxt:
+                            break
+                        extra.append(nxt)
             if group is None:
                 # Another leader holds this ticket in its group: its
                 # materialize ALWAYS sets host or error, then the
                 # event.  Wait outside the lock.
                 ticket.event.wait()
                 continue
-            self._materialize(group)
+            self._materialize_windows([group] + extra)
             # Our group may not have included `ticket` only if shapes
             # raced; loop re-checks.
 
@@ -168,18 +209,33 @@ class ReadbackCombiner:
             self._materialize(group)
 
     def _materialize(self, group: List[Ticket]) -> None:
+        self._materialize_windows([group])
+
+    def _materialize_windows(self, groups: List[List[Ticket]]) -> None:
+        """Stack every claimed window and start ALL their async device→
+        host copies first, then distribute in order: window N+1's
+        transfer overlaps window N's host-side slicing.  Any failure
+        fails every unfulfilled ticket of every claimed window (they
+        are already off the queue; conservative, matches the old
+        single-group contract)."""
         try:
-            self._materialize_inner(group)
+            staged = [self._stack_async(g) for g in groups]
+            for g, stacked in zip(groups, staged):
+                self._distribute(g, stacked)
         except BaseException as e:  # noqa: BLE001
-            for t in group:
-                if t.host is None:
-                    t.error = e
+            for g in groups:
+                for t in g:
+                    if t.host is None and t.error is None:
+                        t.error = e
             raise
         finally:
-            for t in group:
-                t.event.set()
+            for g in groups:
+                for t in g:
+                    t.event.set()
 
-    def _materialize_inner(self, group: List[Ticket]) -> None:
+    def _stack_async(self, group: List[Ticket]):
+        """Stack one group on device (singletons pass through) and
+        start its async copy; returns the handle to materialize."""
         k = len(group)
         with self._lock:
             # Concurrent leaders (different shape groups) materialize
@@ -187,23 +243,37 @@ class ReadbackCombiner:
             # under-reported the RPC savings PERF.md is based on.
             self.transfers += 1
         if k == 1:
-            group[0].host = np.asarray(group[0].handle)
+            stacked = group[0].handle
+        else:
+            # Round the stack fan-in up to a power of two by repeating
+            # the last handle — bounded program universe (module doc).
+            size = 2
+            while size < k:
+                size *= 2
+            handles = [t.handle for t in group]
+            handles += [handles[-1]] * (size - k)
+            prog = self._stack_program(
+                size, handles[0].shape, handles[0].dtype
+            )
+            stacked = prog(*handles)
+            with self._lock:
+                self.stacked += k
+        try:
+            stacked.copy_to_host_async()
+        except AttributeError:
+            pass  # non-jax handle (tests stub arrays)
+        return stacked
+
+    def _distribute(self, group: List[Ticket], stacked) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
+        host = np.asarray(stacked)  # ONE transfer for the whole group
+        self.transfer_duration.observe(_time.monotonic() - t0)
+        if len(group) == 1:
+            group[0].host = host
             group[0].handle = None
             return
-        # Round the stack fan-in up to a power of two by repeating the
-        # last handle — bounded program universe (see module doc).
-        size = 2
-        while size < k:
-            size *= 2
-        handles = [t.handle for t in group]
-        handles += [handles[-1]] * (size - k)
-        prog = self._stack_program(
-            size, handles[0].shape, handles[0].dtype
-        )
-        stacked = prog(*handles)
-        host = np.asarray(stacked)  # ONE transfer for the whole group
-        with self._lock:
-            self.stacked += k
         for i, t in enumerate(group):
             t.host = host[i]
             t.handle = None

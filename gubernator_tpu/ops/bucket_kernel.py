@@ -37,8 +37,11 @@ _I64 = jnp.int64
 _I32 = jnp.int32
 _F64 = jnp.float64
 
-_OVER = jnp.int32(int(Status.OVER_LIMIT))
-_UNDER = jnp.int32(int(Status.UNDER_LIMIT))
+# numpy scalars (not jnp): they inline as jaxpr literals, which keeps
+# the shared lane math embeddable in a Pallas kernel body — a kernel
+# may not close over materialized device constants (ops/pallas_step.py).
+_OVER = np.int32(int(Status.OVER_LIMIT))
+_UNDER = np.int32(int(Status.UNDER_LIMIT))
 
 
 class BucketState(NamedTuple):
@@ -436,6 +439,52 @@ def _apply_core(
     return new_state, resp_status, resp_rem, resp_reset
 
 
+class GatheredSlots(NamedTuple):
+    """Raw per-lane state words after the gather — the packed column
+    values for each request lane's slot, still encoded (meta/hi2 bit
+    packings, hi/lo word pairs).  Shape [B] per field.
+
+    This is the seam between the two halves of the decision step: the
+    XLA path produces it with `gather_slots` (one sorted/unique gather
+    per column) and the Pallas kernel produces it with its in-kernel
+    gather loop (ops/pallas_step.py) — both feed the SAME
+    `update_lanes` math, so the two backends cannot drift."""
+
+    meta: jax.Array  # int32 (possibly clear-updated meta array)
+    hi2: jax.Array  # int32
+    t0_lo: jax.Array  # uint32
+    expire_lo: jax.Array  # uint32
+    invalid_lo: jax.Array  # uint32
+    duration_lo: jax.Array  # uint32
+    limit_hi: jax.Array  # int32
+    limit_lo: jax.Array  # uint32
+    rem_hi: jax.Array  # int32
+    rem_lo: jax.Array  # uint32
+    burst_hi: jax.Array  # int32
+    burst_lo: jax.Array  # uint32
+
+
+def gather_slots(
+    state: BucketState, occupied: jax.Array, slot: jax.Array
+) -> GatheredSlots:
+    """Gather the raw state words for slot-sorted lanes (fill 0 for
+    out-of-range padding lanes).  `occupied` is the meta array to read
+    occupancy from (it may carry this round's eviction clears).
+    Field order tracks BucketState (the gather zips the two)."""
+
+    def g(arr):
+        return arr.at[slot].get(
+            mode="fill",
+            fill_value=0,
+            indices_are_sorted=True,
+            unique_indices=True,
+        )
+
+    return GatheredSlots(
+        *(g(arr) for arr in state._replace(meta=occupied))
+    )
+
+
 def _compute_update(
     state: BucketState,
     occupied: jax.Array,
@@ -455,34 +504,45 @@ def _compute_update(
     remaining, reset_time) with everything in the SORTED lane order."""
     cap = state.meta.shape[0]
     mask = slot < cap
+    g = gather_slots(state, occupied, slot)
+    return update_lanes(
+        g, mask, r_algo, r_beh, r_hits, r_limit, r_dur, r_burst,
+        r_gdur, r_gexp, now,
+    )
 
-    def g(arr):
-        return arr.at[slot].get(
-            mode="fill",
-            fill_value=0,
-            indices_are_sorted=True,
-            unique_indices=True,
-        )
 
-    def g64(hi, lo):
-        return combine_i64(g(hi), g(lo))
-
-    s_meta = g(occupied)  # the (possibly clear-updated) meta array
+def update_lanes(
+    g: GatheredSlots,
+    mask: jax.Array,  # bool [B]: lane in range (padding lanes False)
+    r_algo: jax.Array,
+    r_beh: jax.Array,
+    r_hits: jax.Array,
+    r_limit: jax.Array,
+    r_dur: jax.Array,
+    r_burst: jax.Array,
+    r_gdur: jax.Array,
+    r_gexp: jax.Array,
+    now: jax.Array,
+):
+    """The branch-free bucket update over already-gathered lanes: the
+    pure vector math between gather and scatter, shared verbatim by the
+    XLA programs and the Pallas kernel (see GatheredSlots)."""
+    s_meta = g.meta
     s_occ = meta_occupied(s_meta) & mask
     s_algo = meta_algo(s_meta)
     s_status = meta_status(s_meta)
-    s_t0 = meta_t0(s_meta, g(state.t0_lo))
-    s_inv = meta_invalid(s_meta, g(state.invalid_lo))
-    s_hi2 = g(state.hi2)
-    s_exp = hi2_expire(s_hi2, g(state.expire_lo))
-    s_dur = hi2_duration(s_hi2, g(state.duration_lo))
-    s_limit = g64(state.limit_hi, state.limit_lo)
+    s_t0 = meta_t0(s_meta, g.t0_lo)
+    s_inv = meta_invalid(s_meta, g.invalid_lo)
+    s_hi2 = g.hi2
+    s_exp = hi2_expire(s_hi2, g.expire_lo)
+    s_dur = hi2_duration(s_hi2, g.duration_lo)
+    s_limit = combine_i64(g.limit_hi, g.limit_lo)
     # The merged remaining words: int64 for token slots, 32.32 fixed
     # point for leaky — both views computed, the algo paths pick.
-    _rem_hi, _rem_lo = g(state.rem_hi), g(state.rem_lo)
+    _rem_hi, _rem_lo = g.rem_hi, g.rem_lo
     s_rem = combine_i64(_rem_hi, _rem_lo)
     s_rem_f = combine_remf(_rem_hi, _rem_lo)
-    s_burst = g64(state.burst_hi, state.burst_lo)
+    s_burst = combine_i64(g.burst_hi, g.burst_lo)
 
     # Normalize the request algorithm to the stored 1-bit domain (see
     # BucketState docstring).
@@ -698,6 +758,59 @@ class SlotValues(NamedTuple):
     burst: jax.Array  # int64
 
 
+class StoredWords(NamedTuple):
+    """Per-lane encoded column words to store — field-for-field aligned
+    with BucketState so a scatter (XLA) or an in-kernel store loop
+    (Pallas) can zip the two.  Shape [B] per field; dtypes are the
+    logical pre-cast ones (the store casts to each column's dtype)."""
+
+    meta: jax.Array
+    hi2: jax.Array
+    t0_lo: jax.Array
+    expire_lo: jax.Array
+    invalid_lo: jax.Array
+    duration_lo: jax.Array
+    limit_hi: jax.Array
+    limit_lo: jax.Array
+    rem_hi: jax.Array
+    rem_lo: jax.Array
+    burst_hi: jax.Array
+    burst_lo: jax.Array
+
+
+def encode_slot_values(vals: SlotValues) -> StoredWords:
+    """Encode computed slot values into the packed column words — the
+    pure half of the write path, shared by `_scatter_values` and the
+    Pallas kernel's store loop (update always clears invalid_at)."""
+    algo_norm = (vals.algo != 0).astype(_I32)
+    t0c = clamp_ts(vals.t0)
+    invc = jnp.zeros_like(t0c)  # updates always clear invalid_at
+    expc = clamp_ts(vals.expire)
+    durc = clamp_ts(vals.duration)
+    meta_v = pack_meta(vals.occ, algo_norm, vals.status, t0c, invc)
+    hi2_v = pack_hi2(expc, durc)
+    # Merged remaining: token int64 words vs leaky 32.32 words.
+    tok_hi, tok_lo = split_i64(vals.remaining)
+    remf_hi_v, remf_lo_v = split_remf(vals.rem_f)
+    leaky = algo_norm == 1
+    limit_hi, limit_lo = split_i64(vals.limit)
+    burst_hi, burst_lo = split_i64(vals.burst)
+    return StoredWords(
+        meta=meta_v,
+        hi2=hi2_v,
+        t0_lo=t0c & 0xFFFFFFFF,
+        expire_lo=expc & 0xFFFFFFFF,
+        invalid_lo=jnp.zeros_like(meta_v),
+        duration_lo=durc & 0xFFFFFFFF,
+        limit_hi=limit_hi,
+        limit_lo=limit_lo,
+        rem_hi=jnp.where(leaky, remf_hi_v, tok_hi),
+        rem_lo=jnp.where(leaky, remf_lo_v, tok_lo),
+        burst_hi=burst_hi,
+        burst_lo=burst_lo,
+    )
+
+
 # guberlint: shapes state fixed at capacity; slot/vals [W] on the same pow2 width ladder as the compute step
 def _scatter_values(
     state: BucketState, slot: jax.Array, vals: SlotValues
@@ -722,38 +835,9 @@ def _scatter_values(
             unique_indices=True,
         )
 
-    def sc64(hi_arr, lo_arr, v):
-        hi, lo = split_i64(v)
-        return sc(hi_arr, hi), sc(lo_arr, lo)
-
-    algo_norm = (vals.algo != 0).astype(_I32)
-    t0c = clamp_ts(vals.t0)
-    invc = jnp.zeros_like(t0c)  # updates always clear invalid_at
-    expc = clamp_ts(vals.expire)
-    durc = clamp_ts(vals.duration)
-    meta_v = pack_meta(vals.occ, algo_norm, vals.status, t0c, invc)
-    hi2_v = pack_hi2(expc, durc)
-    # Merged remaining: token int64 words vs leaky 32.32 words.
-    tok_hi, tok_lo = split_i64(vals.remaining)
-    remf_hi_v, remf_lo_v = split_remf(vals.rem_f)
-    leaky = algo_norm == 1
-    rem_hi_v = jnp.where(leaky, remf_hi_v, tok_hi)
-    rem_lo_v = jnp.where(leaky, remf_lo_v, tok_lo)
-    n_limit_hi, n_limit_lo = sc64(state.limit_hi, state.limit_lo, vals.limit)
-    n_burst_hi, n_burst_lo = sc64(state.burst_hi, state.burst_lo, vals.burst)
+    words = encode_slot_values(vals)
     return BucketState(
-        meta=sc(state.meta, meta_v),
-        hi2=sc(state.hi2, hi2_v),
-        t0_lo=sc(state.t0_lo, (t0c & 0xFFFFFFFF)),
-        expire_lo=sc(state.expire_lo, (expc & 0xFFFFFFFF)),
-        invalid_lo=sc(state.invalid_lo, jnp.zeros_like(slot)),
-        duration_lo=sc(state.duration_lo, (durc & 0xFFFFFFFF)),
-        limit_hi=n_limit_hi,
-        limit_lo=n_limit_lo,
-        rem_hi=sc(state.rem_hi, rem_hi_v),
-        rem_lo=sc(state.rem_lo, rem_lo_v),
-        burst_hi=n_burst_hi,
-        burst_lo=n_burst_lo,
+        *(sc(arr, w) for arr, w in zip(state, words))
     )
 
 

@@ -16,6 +16,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 KEYS_AXIS = "keys"
 
+# jax.shard_map stabilized out of jax.experimental between minor jax
+# releases; resolve whichever this jax ships so the mesh tier works on
+# both (the CI image carries the experimental-only version).
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - depends on jax version
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated placement (the psum-merged GLOBAL columns are
+    identical on every device)."""
+    return NamedSharding(mesh, PartitionSpec())
+
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """A 1-D mesh over `devices` (default: all local devices)."""

@@ -38,7 +38,12 @@ from gubernator_tpu.ops.bucket_kernel import (
     make_state,
 )
 from gubernator_tpu.core.native import make_intern_table
-from gubernator_tpu.parallel.mesh import KEYS_AXIS, keys_sharding, make_mesh
+from gubernator_tpu.parallel.mesh import (
+    KEYS_AXIS,
+    keys_sharding,
+    make_mesh,
+    shard_map as _shard_map,
+)
 from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp, Status
 
 _I32 = np.int32
@@ -126,6 +131,22 @@ class ShardedDecisionEngine:
         self.over_limit_total = 0
         self.batches_total = 0
         self.rounds_total = 0
+        # Decision-plane device dispatch counter (see DecisionEngine).
+        self.dispatches_total = 0
+        # GLOBAL column merge as a psum over the mesh (ROADMAP item 1 /
+        # PERF.md §24): a whole-batch round's per-shard packed outputs
+        # are scattered to their request positions ON DEVICE and
+        # `lax.psum`'d across the keys axis, so the host reads ONE
+        # request-ordered [PACKED_OUT_ROWS, n] buffer instead of
+        # unpermuting n_shards row sets — this is the ICI-level
+        # aggregation the GLOBAL broadcast's owner re-read rides
+        # (cluster/global_manager.py).  GUBER_PSUM_MERGE=0 disables.
+        self._use_psum_merge = (
+            not self._single_program
+            and self.n_shards > 1
+            and _os.environ.get("GUBER_PSUM_MERGE", "1") != "0"
+        )
+        self._merge_progs: Dict[Tuple[int, int], object] = {}
         from gubernator_tpu.utils.metrics import DurationStat
 
         self.round_duration = DurationStat()
@@ -180,7 +201,7 @@ class ShardedDecisionEngine:
             return _clear_occupied_impl(occupied[0], slots[0])[None]
 
         self._clear_step = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_clear,
                 mesh=mesh,
                 in_specs=(pspec, pspec),
@@ -232,7 +253,7 @@ class ShardedDecisionEngine:
             lambda _: pspec, SlotValues(*(0,) * len(SlotValues._fields))
         )
         self._packed_fused = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_packed_fused,
                 mesh=mesh,
                 in_specs=(state_specs2, pspec),
@@ -241,7 +262,7 @@ class ShardedDecisionEngine:
             donate_argnums=(0,),
         )
         self._packed_compute = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_packed_compute,
                 mesh=mesh,
                 in_specs=(state_specs2, pspec),
@@ -249,7 +270,7 @@ class ShardedDecisionEngine:
             )
         )
         self._step_scatter = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_scatter,
                 mesh=mesh,
                 in_specs=(state_specs2, pspec, vals_specs),
@@ -258,7 +279,7 @@ class ShardedDecisionEngine:
             donate_argnums=(0,),
         )
         self._collapsed_fused = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_collapsed_fused,
                 mesh=mesh,
                 in_specs=(state_specs2, pspec),
@@ -267,7 +288,7 @@ class ShardedDecisionEngine:
             donate_argnums=(0,),
         )
         self._collapsed_compute = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_collapsed_compute,
                 mesh=mesh,
                 in_specs=(state_specs2, pspec),
@@ -285,7 +306,7 @@ class ShardedDecisionEngine:
             lambda _: pspec, SlotRecord(*(0,) * len(SlotRecord._fields))
         )
         self._load_step = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_load,
                 mesh=mesh,
                 in_specs=(state_specs2, rec_specs),
@@ -412,6 +433,7 @@ class ShardedDecisionEngine:
         self._state = self._state._replace(
             meta=self._clear_step(self._state.meta, jnp.asarray(c))
         )
+        self.dispatches_total += 1
 
     def _apply_shard_restores(self, restores: List[List[tuple]]) -> None:
         """Hydrate store-provided bucket values into fresh slots on
@@ -435,6 +457,7 @@ class ShardedDecisionEngine:
             }
         )
         self._state = self._load_step(self._state, rec_stacked)
+        self.dispatches_total += 1
 
     def get_rate_limits(
         self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
@@ -664,9 +687,11 @@ class ShardedDecisionEngine:
         pin = jnp.asarray(buf)
         if self._fused:
             self._state, pout = self._packed_fused(self._state, pin)
+            self.dispatches_total += 1
         else:
             slot_dev, vals, pout = self._packed_compute(self._state, pin)
             self._state = self._step_scatter(self._state, slot_dev, vals)
+            self.dispatches_total += 2
         self.round_duration.observe(_time.monotonic() - t0)
 
         arr = self.readback.register(pout).fetch()
@@ -739,6 +764,7 @@ class ShardedDecisionEngine:
             self.requests_total,
             self.batches_total,
             self.rounds_total,
+            self.dispatches_total,
             [(t.hits, t.misses) for t in self.tables],
         )
         # Warmup traffic must not reach a write-through Store (it would
@@ -844,11 +870,48 @@ class ShardedDecisionEngine:
                     (self.n_shards, PACKED_OUT_ROWS, width), jnp.int32
                 )
                 width *= 2
+            if self._use_psum_merge:
+                # psum-merge ladder: the balanced warmup batches above
+                # only compile (n_pad, width) keys of the balanced
+                # form; real client batches produce ANY pow2 pair with
+                # width <= n_pad <= n_shards*width.  Compile the whole
+                # universe (<= log(widths) x log(n_shards) programs,
+                # each tiny) plus the merged replicated readback
+                # stacks, so no serve-time batch pays an XLA compile.
+                width = 64
+                while width <= max_width:
+                    n_pad = width
+                    # pow2 bound: non-pow2 mesh sizes still pad the
+                    # total batch to the next power of two.
+                    while n_pad <= _pad_size(width * self.n_shards):
+                        prog = self._merge_prog(n_pad, width)
+                        # The dummy pout must carry the SAME sharding
+                        # as the real step output (P(keys)) — the jit
+                        # cache keys on input shardings, and a host-
+                        # committed dummy would warm a program the
+                        # serve path never hits.
+                        pout = jax.device_put(
+                            np.zeros(
+                                (self.n_shards, PACKED_OUT_ROWS, width),
+                                dtype=np.int32,
+                            ),
+                            keys_sharding(self.mesh),
+                        )
+                        pos = np.full(
+                            (self.n_shards, width), n_pad, dtype=_I32
+                        )
+                        np.asarray(prog(pout, jnp.asarray(pos)))
+                        self.readback.warmup_stacks(
+                            (PACKED_OUT_ROWS, n_pad), jnp.int32
+                        )
+                        n_pad *= 2
+                    width *= 2
             self.sweep(now_ms=now + 2)
             (
                 self.requests_total,
                 self.batches_total,
                 self.rounds_total,
+                self.dispatches_total,
                 table_stats,
             ) = saved
             for t, (h, m) in zip(self.tables, table_stats):
@@ -1052,11 +1115,19 @@ class ShardedDecisionEngine:
                 ]
                 if offset > 0 and not any(len(m) for m in chunk_members):
                     break
+                whole_batch = (
+                    max_round == 0
+                    and offset == 0
+                    and all(
+                        len(m) <= self.max_kernel_width for m in members
+                    )
+                )
                 pieces.append(
                     self._dispatch_sorted_chunk(
                         chunk_members, chunk_slots,
                         algo, behavior, hits, limit, duration, burst,
                         greg_dur, greg_exp, now_ms,
+                        merge_n=n if whole_batch else None,
                     )
                 )
                 self.rounds_total += 1
@@ -1164,12 +1235,20 @@ class ShardedDecisionEngine:
                 ]
                 if offset > 0 and not any(len(m) for m in chunk_members):
                     break
+                whole_batch = (
+                    max_round == 0
+                    and offset == 0
+                    and all(
+                        len(m) <= self.max_kernel_width for m in members
+                    )
+                )
                 pieces.append(
                     self._dispatch_sorted_chunk(
                         chunk_members, chunk_slots,
                         algo, behavior, hits, limit, duration, burst,
                         greg_dur, greg_exp, now_ms, presorted=True,
                         flat=flat,
+                        merge_n=n if whole_batch else None,
                     )
                 )
                 self.rounds_total += 1
@@ -1406,6 +1485,7 @@ class ShardedDecisionEngine:
                     self._state, pout = self._flat_collapsed_fused(
                         self._state, pin
                     )
+                    self.dispatches_total += 1
                 else:
                     slot_dev, vals2, pout = self._flat_collapsed_compute(
                         self._state, pin
@@ -1413,13 +1493,16 @@ class ShardedDecisionEngine:
                     self._state = self._flat_scatter(
                         self._state, slot_dev, vals2
                     )
+                    self.dispatches_total += 2
             elif self._fused:
                 self._state, pout = self._collapsed_fused(self._state, pin)
+                self.dispatches_total += 1
             else:
                 slot_dev, vals2, pout = self._collapsed_compute(
                     self._state, pin
                 )
                 self._state = self._step_scatter(self._state, slot_dev, vals2)
+                self.dispatches_total += 2
             self.round_duration.observe(_time.monotonic() - t0)
             self.rounds_total += 1
             pieces.append(
@@ -1427,9 +1510,41 @@ class ShardedDecisionEngine:
             )
         return pieces
 
+    def _merge_prog(self, n_pad: int, width: int):
+        """Jitted psum column merge: per-shard packed outputs
+        [n_shards, PACKED_OUT_ROWS, width] + per-shard request
+        positions [n_shards, width] (padding = out-of-range, dropped)
+        → ONE replicated request-ordered [PACKED_OUT_ROWS, n_pad]
+        buffer.  Each request index appears on exactly one shard, so
+        the scatter-then-psum is an exact merge."""
+        key = (n_pad, width)
+        prog = self._merge_progs.get(key)
+        if prog is None:
+            from gubernator_tpu.ops.bucket_kernel import PACKED_OUT_ROWS
+
+            pspec = P(KEYS_AXIS)
+
+            def local_merge(pout, pos):
+                base = jnp.zeros((PACKED_OUT_ROWS, n_pad), dtype=jnp.int32)
+                own = base.at[:, pos[0]].set(pout[0], mode="drop")
+                return jax.lax.psum(own, KEYS_AXIS)
+
+            # guberlint: shapes pout [n_shards, PACKED_OUT_ROWS, W], pos [n_shards, W]; n_pad/W pinned by the cache key (pow2 ladders)
+            prog = jax.jit(
+                _shard_map(
+                    local_merge,
+                    mesh=self.mesh,
+                    in_specs=(pspec, pspec),
+                    out_specs=P(),
+                )
+            )
+            self._merge_progs[key] = prog
+        return prog
+
     def _dispatch_sorted_chunk(
         self, members, m_slots, algo, behavior, hits, limit, duration,
         burst, greg_dur, greg_exp, now_ms, presorted=False, flat=False,
+        merge_n=None,
     ):
         """Pack one presorted [n_sh, PACKED_IN_ROWS, width] round
         buffer, dispatch the packed mesh step (one h2d + one or two
@@ -1494,14 +1609,36 @@ class ShardedDecisionEngine:
         if flat:
             if self._fused:
                 self._state, pout = self._flat_fused(self._state, pin)
+                self.dispatches_total += 1
             else:
                 slot_dev, vals, pout = self._flat_compute(self._state, pin)
                 self._state = self._flat_scatter(self._state, slot_dev, vals)
+                self.dispatches_total += 2
         elif self._fused:
             self._state, pout = self._packed_fused(self._state, pin)
+            self.dispatches_total += 1
         else:
             slot_dev, vals, pout = self._packed_compute(self._state, pin)
             self._state = self._step_scatter(self._state, slot_dev, vals)
+            self.dispatches_total += 2
+        if merge_n is not None and self._use_psum_merge and not flat:
+            # psum GLOBAL merge: scatter every shard's lanes to their
+            # request positions on device and sum across the mesh —
+            # one replicated, already-request-ordered readback.
+            n_pad = _pad_size(merge_n)
+            pos = np.full((n_sh, width), n_pad, dtype=_I32)
+            for sh in range(n_sh):
+                if len(dst_rows[sh]):
+                    pos[sh, : len(dst_rows[sh])] = dst_rows[sh]
+            merged = self._merge_prog(n_pad, width)(pout, jnp.asarray(pos))
+            self.dispatches_total += 1
+            self.round_duration.observe(_time.monotonic() - t0)
+            return (
+                self.readback.register(merged),
+                np.arange(merge_n, dtype=np.int64),
+                merge_n,
+                n_pad,
+            )
         self.round_duration.observe(_time.monotonic() - t0)
         return (
             self.readback.register(pout), dst_rows,

@@ -372,6 +372,27 @@ class V1Instance:
             "owner_rpc": self.global_mgr.owner_rpc_duration,
             "broadcast_age": self.global_mgr.broadcast_age,
         }
+        # Device-plane budget (PERF.md §24, mirroring the §10b host
+        # stages): device.step is the per-dispatch wall time of the
+        # fused decision kernel, device.readback the blocking d2h
+        # materialization, device.window_wait the pump-queue wait of a
+        # packed round before its fused dispatch.  All three ride
+        # gubernator_stage_duration / gubernator_stage_quantile_seconds
+        # and Daemon.stage_budget() → /debug/vars, so "where do device
+        # milliseconds go" is answerable from a scrape.
+        # getattr-guarded: jax-free smoke/test stubs stand in for the
+        # engine without the device plane.
+        round_dur = getattr(engine, "round_duration", None)
+        if round_dur is not None:
+            self.stage_timers["device.step"] = round_dur
+        transfer = getattr(
+            getattr(engine, "readback", None), "transfer_duration", None
+        )
+        if transfer is not None:
+            self.stage_timers["device.readback"] = transfer
+        pump = getattr(engine, "_pump", None)
+        if pump is not None:
+            self.stage_timers["device.window_wait"] = pump.window_wait
         # Optional group-commit window for client wire batches
         # (net/wire_window.py; conf.local_batch_wait > 0 enables).
         self._wire_window = None

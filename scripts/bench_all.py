@@ -101,6 +101,15 @@ CONFIGS: dict[str, dict] = {
         # wire-max batch it only adds latency — run it near zero.
         "BENCH_LOCAL_BATCH_WAIT": "0.0002",
     },
+    # Device decision plane fused/unfused A/B (ISSUE 10): the fused
+    # single-dispatch step vs GUBER_FUSED=split, alternating pairs,
+    # median of per-pair deltas; carries dispatches/batch per arm.
+    "devfused": {
+        "BENCH_MODE": "devfused",
+        "BENCH_KEYS": "100000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_BATCH": "8192",
+    },
     # Thundering herd: 32 concurrent clients, one hot key, single-item
     # RPCs (reference: benchmark_test.go thundering-herd subtest).
     "herd": {

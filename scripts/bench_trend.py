@@ -94,6 +94,21 @@ def _extract(data: dict) -> dict | None:
             out["error_rate"] = round(
                 data["errors"] / data["requests"], 4
             )
+    # Device-plane fused A/B artifacts (devfused mode): fold the
+    # unfused arm, the median pair delta, and each arm's device
+    # dispatches/batch — the fused steady state must read 1.0.
+    if data.get("fused_delta_pct") is not None:
+        if data.get("unfused_value") is not None:
+            out["unfused_value"] = data["unfused_value"]
+        out["fused_delta_pct"] = data["fused_delta_pct"]
+        if data.get("fused_mode") is not None:
+            out["fused_mode"] = data["fused_mode"]
+    if data.get("dispatches_per_batch") is not None:
+        out["dispatches_per_batch"] = data["dispatches_per_batch"]
+    if data.get("dispatches_per_batch_unfused") is not None:
+        out["dispatches_per_batch_unfused"] = data[
+            "dispatches_per_batch_unfused"
+        ]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its
@@ -145,6 +160,8 @@ def _fmt(row: dict | None) -> str:
         parts.append(f"p50 {row['p50_ms']:g}")
     if row.get("dispatches_per_decision") is not None:
         parts.append(f"d/d {row['dispatches_per_decision']:g}")
+    if row.get("dispatches_per_batch") is not None:
+        parts.append(f"d/b {row['dispatches_per_batch']:g}")
     return " · ".join(parts)
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly four things:
+# Runs exactly five things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -17,13 +17,18 @@
 #      decision end-to-end through the real router, asserting a
 #      non-empty stitched span tree (root + engine child sharing one
 #      trace id) — jax-free, same 10 s wall budget as guberlint;
-#   3. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   3. the fused-kernel parity tier (tests/test_fused_parity.py,
+#      GUBER_FUSED=interpret, jax CPU only, 120 s wall budget): the
+#      Pallas decision kernel bit-equal to models/spec.py + the
+#      single-dispatch-per-batch invariant — the kernel stays
+#      CI-enforced without TPU hardware (PERF.md section 24);
+#   4. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants; the
 #      multi-cycle soaks are @slow);
-#   4. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#   5. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -65,6 +70,25 @@ echo "trace smoke: ${SMOKE_MS} ms (budget 10000 ms)" >&2
 if [ "${SMOKE_MS}" -gt 10000 ]; then
   echo "trace smoke blew its 10 s budget — it must stay jax-free and" >&2
   echo "cheap enough to run before the tier-1 suite" >&2
+  exit 1
+fi
+
+echo "=== fused-kernel parity (Pallas interpret mode, jax CPU) ===" >&2
+PAR_T0=$(date +%s%N)
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu GUBER_FUSED=interpret \
+  python -m pytest tests/test_fused_parity.py -q -m 'not slow' \
+  -p no:cacheprovider -p no:xdist -p no:randomly; then
+  echo "fused parity: the Pallas decision kernel diverged from" >&2
+  echo "models/spec.py or the single-dispatch invariant broke" >&2
+  echo "(tests/test_fused_parity.py; PERF.md section 24)" >&2
+  exit 1
+fi
+PAR_MS=$(( ($(date +%s%N) - PAR_T0) / 1000000 ))
+echo "fused parity: ${PAR_MS} ms (budget 120000 ms)" >&2
+if [ "${PAR_MS}" -gt 120000 ]; then
+  echo "fused parity blew its 120 s wall budget — the interpret-mode" >&2
+  echo "kernel must stay cheap enough to gate every commit without" >&2
+  echo "TPU hardware" >&2
   exit 1
 fi
 

@@ -135,10 +135,16 @@ STAGES = (
     "hits_window_wait",
     "owner_rpc",
     "broadcast_age",
+    # Device-plane stages (ISSUE 10 / PERF.md §24).  device.window_wait
+    # joins only when the step pump is live (conftest forces
+    # GUBER_PUMP=1, so in-process cluster nodes carry it).
+    "device.step",
+    "device.readback",
+    "device.window_wait",
 )
 
 
-def test_global_pipeline_reports_all_five_stage_timers():
+def test_global_pipeline_reports_all_stage_timers():
     from gubernator_tpu.cluster.harness import ClusterHarness
     from gubernator_tpu.net import wire_codec
     from gubernator_tpu.net.pb import gubernator_pb2 as pb

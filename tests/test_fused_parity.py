@@ -1,0 +1,365 @@
+"""Fused-kernel parity: the Pallas decision step (interpret mode on
+CPU) must be BIT-EQUAL to the scalar spec (models/spec.py), to the XLA
+fused program, and to the ledger-fronted serve partition — token and
+leaky buckets, duration-change renewal, and expiry boundaries included
+(the test_ledger.py harness shape).
+
+Also pins the ISSUE 10 acceptance invariant directly: a steady-state
+fused decision batch runs as a SINGLE device dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.core.engine import DecisionEngine, PackedKeys
+from gubernator_tpu.models.spec import SlotState, SpecInput, apply_spec
+from gubernator_tpu.ops import bucket_kernel as bk
+from gubernator_tpu.ops.pallas_step import pallas_fused_step
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+SECOND = 1000
+
+
+class PallasShadow:
+    """Drives the Pallas kernel (interpret mode) directly: key → slot
+    interning on the host, packed rounds through pallas_fused_step —
+    the exact serving layout, minus the engine plumbing."""
+
+    def __init__(self, capacity: int = 512, width: int = 64):
+        self.capacity = capacity
+        self.width = width
+        self.state = bk.make_state(capacity)
+        self.slots: dict[bytes, int] = {}
+
+    def _slot(self, key: bytes) -> int:
+        s = self.slots.get(key)
+        if s is None:
+            s = len(self.slots)
+            assert s < self.capacity
+            self.slots[key] = s
+        return s
+
+    def apply(self, rows, now_ms: int):
+        """rows: [(key, algo, behavior, hits, limit, duration, burst)]
+        with unique keys (callers split duplicate keys into rounds).
+        Returns [(status, limit, remaining, reset)] in row order."""
+        import jax.numpy as jnp
+
+        m = len(rows)
+        slot = np.asarray([self._slot(r[0]) for r in rows], np.int32)
+        order = np.argsort(slot, kind="stable")
+        cols = [np.asarray([r[j] for r in rows], np.int64) for j in range(1, 7)]
+        buf = bk.pack_batch_host(
+            self.width,
+            now_ms,
+            self.capacity,
+            np.ascontiguousarray(slot[order]),
+            *(c[order] for c in cols),
+            np.zeros(m, np.int64),
+            np.zeros(m, np.int64),
+        )
+        self.state, pout = pallas_fused_step(
+            self.state, jnp.asarray(buf), interpret=True
+        )
+        st, rem, rst = bk.unpack_out_host(np.asarray(pout), m)
+        inv = np.empty(m, np.int64)
+        inv[order] = np.arange(m)
+        limits = cols[3]
+        return [
+            (int(st[inv[i]]), int(limits[i]), int(rem[inv[i]]), int(rst[inv[i]]))
+            for i in range(m)
+        ]
+
+
+class SpecShadow:
+    def __init__(self):
+        self.states: dict[bytes, SlotState] = {}
+
+    def apply(self, rows, now_ms: int):
+        out = []
+        for key, algo, behavior, hits, limit, duration, burst in rows:
+            inp = SpecInput(
+                hits=int(hits), limit=int(limit), duration=int(duration),
+                burst=int(burst), algorithm=int(algo), behavior=int(behavior),
+            )
+            state, resp = apply_spec(self.states.get(key), inp, now_ms)
+            if state is None:
+                self.states.pop(key, None)
+            else:
+                self.states[key] = state
+            out.append(
+                (int(resp.status), int(resp.limit), int(resp.remaining),
+                 int(resp.reset_time))
+            )
+        return out
+
+
+def _rand_rows(rng, keys, n):
+    rows = []
+    for _ in range(n):
+        key = rng.choice(keys)
+        algo = int(rng.choice([0, 1]))
+        behavior = 0
+        if rng.random() < 0.1:
+            behavior |= int(Behavior.RESET_REMAINING)
+        rows.append(
+            (
+                key,
+                algo,
+                behavior,
+                int(rng.choice([-2, 0, 1, 1, 1, 2, 5, 11])),
+                int(rng.choice([0, 1, 3, 10, 50])),
+                int(rng.choice([1, 40, 200, 1000])),
+                int(rng.choice([0, 0, 0, 5, 20])),
+            )
+        )
+    # Unique keys per kernel round (the engine's rounds invariant).
+    seen, uniq = set(), []
+    for r in rows:
+        if r[0] in seen:
+            continue
+        seen.add(r[0])
+        uniq.append(r)
+    return uniq
+
+
+def test_pallas_interpret_bit_equal_to_spec_fuzz():
+    """Token + leaky fuzz across advancing time: every response field
+    of the Pallas kernel equals the scalar spec, including expiry
+    boundaries crossed by the clock advances."""
+    rng = np.random.default_rng(11)
+    shadow = PallasShadow()
+    oracle = SpecShadow()
+    keys = [b"fz_%d" % i for i in range(24)]
+    now = 1_000_000
+    for step in range(120):
+        now += int(rng.integers(0, 120))  # crosses 40/200/1000ms expiries
+        rows = _rand_rows(rng, keys, int(rng.integers(1, 16)))
+        got = shadow.apply(rows, now)
+        want = oracle.apply(rows, now)
+        assert got == want, f"step {step} now={now}: {rows}"
+
+
+def test_pallas_duration_change_renewal_boundary():
+    """The duration-change renewal quirk (stored remaining becomes
+    limit, response reports the pre-renewal snapshot — spec docstring)
+    must hold bit-for-bit through the Pallas kernel, on both sides of
+    the `new_expire <= now` boundary."""
+    shadow = PallasShadow()
+    oracle = SpecShadow()
+    now = 50_000
+    key = b"renew"
+    for rows, dt in [
+        ([(key, 0, 0, 3, 10, 100, 0)], 0),     # create, expire=now+100
+        ([(key, 0, 0, 1, 10, 100, 0)], 40),    # consume inside window
+        ([(key, 0, 0, 1, 10, 70, 0)], 0),      # dur change, not renewed
+        ([(key, 0, 0, 1, 10, 100, 0)], 65),    # back; still live
+        ([(key, 0, 0, 1, 10, 30, 0)], 0),      # dur change → renewal
+        ([(key, 0, 0, 0, 10, 30, 0)], 0),      # query the renewed bucket
+    ]:
+        now += dt
+        assert shadow.apply(rows, now) == oracle.apply(rows, now), (
+            rows, now,
+        )
+
+
+def test_pallas_expiry_boundary_exact():
+    """`expire_at < now` is a strict miss; equality still serves the
+    item (lrucache.go semantics) — pinned at the exact millisecond."""
+    shadow = PallasShadow()
+    oracle = SpecShadow()
+    key = b"edge"
+    base = 10_000
+    assert shadow.apply([(key, 0, 0, 2, 5, 100, 0)], base) == oracle.apply(
+        [(key, 0, 0, 2, 5, 100, 0)], base
+    )
+    for now in (base + 100, base + 101):  # at expiry, one past it
+        rows = [(key, 0, 0, 1, 5, 100, 0)]
+        assert shadow.apply(rows, now) == oracle.apply(rows, now), now
+
+
+def test_pallas_leaky_fractional_leak_parity():
+    """Leaky buckets accrue fractional leak by leaving t0 untouched
+    (the TestLeakyBucketDivBug quirk) — the 32.32 fixed-point path
+    through the kernel must track the spec's quantization exactly."""
+    shadow = PallasShadow()
+    oracle = SpecShadow()
+    key = b"leak"
+    now = 77_000
+    rows = [(key, 1, 0, 3, 7, 700, 0)]
+    assert shadow.apply(rows, now) == oracle.apply(rows, now)
+    for dt in (30, 30, 30, 110, 1, 49, 1000):
+        now += dt
+        rows = [(key, 1, 0, 1, 7, 700, 0)]
+        assert shadow.apply(rows, now) == oracle.apply(rows, now), now
+
+
+def _ledger_harness(clock):
+    from gubernator_tpu.core.ledger import DecisionLedger
+    from gubernator_tpu.hashing import fnv1a_64
+
+    class _Dec:
+        __slots__ = (
+            "n", "key_buf", "key_offsets", "algo", "behavior", "hits",
+            "limit", "duration", "burst", "fnv1a",
+        )
+
+    def make_dec(rows):
+        d = _Dec()
+        keys = [r[0] for r in rows]
+        d.n = len(rows)
+        d.key_buf = np.frombuffer(
+            b"".join(keys) or b"\0", dtype=np.uint8
+        )
+        off = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum([len(k) for k in keys], out=off[1:])
+        d.key_offsets = off
+        for j, name in enumerate(
+            ("algo", "behavior", "hits", "limit", "duration", "burst")
+        ):
+            setattr(
+                d, name,
+                np.asarray([r[j + 1] for r in rows],
+                           np.int32 if j < 2 else np.int64),
+            )
+        d.fnv1a = np.asarray([fnv1a_64(k) for k in keys], np.uint64)
+        return d
+
+    engine = DecisionEngine(capacity=2048, clock=clock)
+    ledger = DecisionLedger(engine, settle_interval=0, lease_size=4)
+
+    def serve(rows):
+        now = clock.now_ms()
+        plan = ledger.plan(make_dec(rows), now)
+        if plan.full:
+            st, lim, rem, rst = plan.dense_cols()
+        else:
+            lane = plan.build_engine_lane()
+            st, lim, rem, rst = engine.apply_columnar(
+                PackedKeys(lane.key_buf, lane.key_offsets, lane.n),
+                lane.algo, lane.behavior, lane.hits, lane.limit,
+                lane.duration, lane.burst, now_ms=now,
+            )
+            plan.learn(st, lim, rem, rst)
+            st, _lim, rem, rst = plan.merge_outputs(st, rem, rst)
+        return st, rem, rst
+
+    return engine, ledger, serve
+
+
+@pytest.mark.parametrize("seed", [3, 19])
+def test_pallas_vs_spec_vs_ledger_three_way(seed, monkeypatch):
+    """The three-tier pin the ISSUE asks for: the Pallas kernel
+    (interpret, forced via GUBER_FUSED for the ENGINE the ledger
+    fronts), the host ledger's answers through that engine, and the
+    scalar spec all agree row for row — token AND leaky, across
+    duration changes and expiries."""
+    monkeypatch.setenv("GUBER_FUSED", "interpret")
+    monkeypatch.setenv("GUBER_PUMP", "0")
+    rng = np.random.default_rng(seed)
+    clock = Clock().freeze()
+    engine, ledger, serve = _ledger_harness(clock)
+    assert engine.fused_mode == "pallas-interpret"
+    oracle = SpecShadow()
+    keys = [b"led_%d" % i for i in range(10)]
+    try:
+        for step in range(60):
+            clock.advance(ms=int(rng.integers(0, 60)))
+            rows = []
+            for _ in range(int(rng.integers(1, 8))):
+                key = keys[int(rng.integers(0, len(keys)))]
+                algo = int(key[-1] % 2)  # algo is a property of the key
+                rows.append(
+                    (
+                        key, algo, 0,
+                        int(rng.choice([0, 1, 1, 2, 4])),
+                        int(rng.choice([2, 5, 9])),
+                        int(rng.choice([40, 90, 400])),
+                        0,
+                    )
+                )
+            st, rem, rst = serve(rows)
+            now = clock.now_ms()
+            want = oracle.apply(rows, now)
+            for i, (es, _el, er, et) in enumerate(want):
+                got = (int(st[i]), int(rem[i]), int(rst[i]))
+                assert got == (es, er, et), (
+                    f"seed {seed} step {step} row {i} {rows[i]}: "
+                    f"ledger+pallas={got} spec={(es, er, et)}"
+                )
+    finally:
+        ledger.close()
+
+
+def test_fused_steady_state_is_single_dispatch(monkeypatch):
+    """ISSUE 10 acceptance: in steady state one batch = ONE device
+    dispatch (unique keys, no evictions, fused step), and the split
+    control dispatches more — the A/B the devfused bench measures."""
+    monkeypatch.setenv("GUBER_PUMP", "0")
+    clock = Clock().freeze()
+    engine = DecisionEngine(capacity=4096, clock=clock)
+    assert engine.fused_mode in ("xla", "pallas", "pallas-interpret")
+
+    def batch(engine, start, n=100):
+        return engine.apply_columnar(
+            [b"sd_%d" % i for i in range(start, start + n)],
+            np.zeros(n, np.int32), np.zeros(n, np.int32),
+            np.ones(n, np.int64), np.full(n, 10, np.int64),
+            np.full(n, 60_000, np.int64), np.zeros(n, np.int64),
+        )
+
+    batch(engine, 0)  # first contact interns + compiles
+    before = engine.dispatches_total
+    batch(engine, 0)  # steady state: same keys, no evictions
+    assert engine.dispatches_total - before == 1
+    before = engine.dispatches_total
+    batch(engine, 200)  # new keys, capacity ample: still one dispatch
+    assert engine.dispatches_total - before == 1
+
+    monkeypatch.setenv("GUBER_FUSED", "split")
+    unfused = DecisionEngine(capacity=4096, clock=clock)
+    assert unfused.fused_mode == "split"
+    batch(unfused, 0)
+    before = unfused.dispatches_total
+    batch(unfused, 0)
+    assert unfused.dispatches_total - before >= 2
+
+
+def test_guber_fused_knob_rejects_unknown(monkeypatch):
+    monkeypatch.setenv("GUBER_FUSED", "warp")
+    with pytest.raises(ValueError, match="GUBER_FUSED"):
+        DecisionEngine(capacity=256, clock=Clock().freeze())
+
+
+def test_pallas_interpret_engine_serves_wire_shapes(monkeypatch):
+    """An engine forced onto the Pallas step serves the ordinary
+    columnar + dataclass paths with responses equal to a default
+    engine (integration: packers, rounds, readback all route through
+    the kernel)."""
+    monkeypatch.setenv("GUBER_PUMP", "0")
+    clock = Clock().freeze()
+    monkeypatch.setenv("GUBER_FUSED", "interpret")
+    a = DecisionEngine(capacity=1024, clock=clock)
+    monkeypatch.setenv("GUBER_FUSED", "xla")
+    b = DecisionEngine(capacity=1024, clock=clock)
+    assert a.fused_mode == "pallas-interpret"
+    n = 150  # spans two pad widths vs the 64 floor
+    cols = dict(
+        algo=np.asarray([i % 2 for i in range(n)], np.int32),
+        behavior=np.zeros(n, np.int32),
+        hits=np.ones(n, np.int64),
+        limit=np.full(n, 7, np.int64),
+        duration=np.full(n, 2_000, np.int64),
+        burst=np.zeros(n, np.int64),
+    )
+    for step in range(4):
+        clock.advance(ms=700)
+        keys = [b"w_%d" % (i % 90) for i in range(n)]
+        keys = [k + b"!%d" % i for i, k in enumerate(keys)]
+        ra = a.apply_columnar(keys, **cols)
+        rb = b.apply_columnar(keys, **cols)
+        for x, y in zip(ra, rb):
+            np.testing.assert_array_equal(x, y)

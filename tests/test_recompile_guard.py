@@ -105,3 +105,91 @@ def test_recompile_metric_exported(frozen_clock, jit_recompile_guard):
         assert sample == jit_recompile_guard.count()
     finally:
         inst.close()
+
+
+def _algo_columns(n, algo, start=0, name="fz"):
+    return dict(
+        keys=[b"%s_%d_%d" % (name.encode(), algo, start + i) for i in range(n)],
+        algo=np.full(n, algo, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.ones(n, dtype=np.int64),
+        limit=np.full(n, 1000, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        burst=np.full(n, 1000, dtype=np.int64),
+    )
+
+
+def test_fused_step_soak_zero_recompiles_both_algorithms(
+    frozen_clock, jit_recompile_guard
+):
+    """ISSUE 10 satellite: the FUSED decision step (default mode,
+    single dispatch per batch) stays recompile-flat across every wire
+    width and BOTH algorithms — token and leaky exercise different
+    jnp.where arms of the same compiled program, so a flat count here
+    pins that the algorithm mix cannot fork the compile cache."""
+    engine = DecisionEngine(
+        capacity=8192, clock=frozen_clock, max_kernel_width=1024
+    )
+    assert engine.fused_mode in ("xla", "pallas", "pallas-interpret")
+    engine.warmup(max_width=1024)
+
+    jit_recompile_guard.snapshot()
+    for round_no in range(2):
+        for width in (1, 64, 65, 500, 1000, 1024):
+            for algo in (0, 1):
+                engine.apply_columnar(
+                    **_algo_columns(
+                        width, algo, start=round_no * 5_000 + width
+                    )
+                )
+    jit_recompile_guard.assert_flat("fused-step width x algorithm soak")
+
+
+def test_pallas_interpret_soak_zero_recompiles(
+    frozen_clock, jit_recompile_guard, monkeypatch
+):
+    """The Pallas step family (interpret mode — what CPU CI runs) is
+    warmed by the same pad ladder as every other program: steady-state
+    traffic through it must not compile."""
+    monkeypatch.setenv("GUBER_FUSED", "interpret")
+    monkeypatch.setenv("GUBER_PUMP", "0")
+    engine = DecisionEngine(
+        capacity=4096, clock=frozen_clock, max_kernel_width=512
+    )
+    assert engine.fused_mode == "pallas-interpret"
+    engine.warmup(max_width=512)
+
+    jit_recompile_guard.snapshot()
+    for width in (1, 63, 64, 200, 512):
+        for algo in (0, 1):
+            engine.apply_columnar(
+                **_algo_columns(width, algo, start=width * 11, name="pz")
+            )
+    jit_recompile_guard.assert_flat("pallas interpret-mode soak")
+
+
+def test_sharded_psum_merge_soak_zero_recompiles(
+    frozen_clock, jit_recompile_guard
+):
+    """Review regression (ISSUE 10): the psum-merge program universe
+    — every pow2 (n_pad, width) pair with width <= n_pad <=
+    pad(n_shards*width), WITH the serve path's input shardings — is
+    warmed by ShardedDecisionEngine.warmup; arbitrary whole-batch
+    sizes then serve with a flat compile count (a host-committed
+    warmup dummy used to warm a program the serve path never hit)."""
+    from gubernator_tpu.parallel.sharded_engine import ShardedDecisionEngine
+
+    engine = ShardedDecisionEngine(
+        shard_capacity=1024, clock=frozen_clock
+    )
+    if not engine._use_psum_merge:
+        pytest.skip("psum merge disabled on this mesh")
+    engine.warmup(max_width=256)
+    assert engine.dispatches_total == 0  # warmup restores the counter
+
+    jit_recompile_guard.snapshot()
+    for n in (1, 57, 100, 200, 250, 256):
+        engine.apply_columnar(
+            **_columns(n, start=n * 13, name="psmk")
+        )
+    jit_recompile_guard.assert_flat("sharded psum-merge width soak")

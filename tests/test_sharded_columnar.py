@@ -62,3 +62,56 @@ def test_sharded_columnar_async(frozen_clock):
     _, _, rem2, _ = p2.get()
     assert rem1.tolist() == [4] * 30
     assert rem2.tolist() == [3] * 30
+
+
+def test_psum_merge_matches_host_merge(frozen_clock, monkeypatch):
+    """The psum GLOBAL column merge (ISSUE 10): a whole-batch round's
+    per-shard outputs merged by one on-device psum must equal the
+    host-side per-shard unpermute, and the merged piece must be
+    request-ordered (dst rows = arange)."""
+    eng_psum = ShardedDecisionEngine(shard_capacity=128, clock=frozen_clock)
+    monkeypatch.setenv("GUBER_PSUM_MERGE", "0")
+    eng_host = ShardedDecisionEngine(shard_capacity=128, clock=frozen_clock)
+    assert eng_psum._use_psum_merge and not eng_host._use_psum_merge
+
+    rng = random.Random(5)
+    for step in range(4):
+        reqs = [
+            RateLimitReq(
+                name="psum",
+                unique_key=f"k{i}",
+                hits=rng.randint(0, 2),
+                limit=8,
+                duration=60_000,
+                algorithm=(
+                    Algorithm.TOKEN_BUCKET if i % 2 else Algorithm.LEAKY_BUCKET
+                ),
+                burst=8,
+            )
+            for i in range(57)  # unique keys: round 0, whole batch
+        ]
+        a = eng_psum.apply_columnar(*_columns(reqs))
+        b = eng_host.apply_columnar(*_columns(reqs))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+    # The merge actually engaged (one compiled program per pad pair)
+    # and the batch was a single merged dispatch + psum.
+    assert eng_psum._merge_progs, "psum merge never engaged"
+
+
+def test_psum_merge_skips_multi_round_batches(frozen_clock):
+    """Duplicate keys fall to the collapse/rounds paths — the merge
+    only claims whole-batch round-0 dispatches, and results stay
+    exact either way."""
+    eng = ShardedDecisionEngine(shard_capacity=128, clock=frozen_clock)
+    keys = [b"hot"] * 30 + [b"cold_%d" % i for i in range(10)]
+    n = len(keys)
+    st, lim, rem, rst = eng.apply_columnar(
+        keys,
+        np.zeros(n, np.int32), np.zeros(n, np.int32),
+        np.ones(n, np.int64), np.full(n, 100, np.int64),
+        np.full(n, 60_000, np.int64), np.zeros(n, np.int64),
+    )
+    # 30 sequential debits of the hot key: remaining walks 99..70.
+    hot_rem = rem[:30]
+    assert list(hot_rem) == list(range(99, 69, -1))

@@ -448,6 +448,11 @@ def test_debug_endpoints_serve_live_data(tracer, monkeypatch):
         assert {"count", "mean_ms", "p50_ms", "p99_ms", "max_ms"} <= set(
             vars_["stage_budget"]["engine_serve"]
         )
+        # Device-plane attribution (ISSUE 10): where device
+        # milliseconds go, in the same budget table.
+        assert "device.step" in vars_["stage_budget"]
+        assert vars_["stage_budget"]["device.step"]["count"] >= 1
+        assert "device.readback" in vars_["stage_budget"]
         hot = _get_json(addr, "/debug/hotkeys")
         assert hot["enabled"]
         assert any(r["key"].startswith("dbg_") for r in hot["top"])

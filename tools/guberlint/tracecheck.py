@@ -35,7 +35,13 @@ from tools.guberlint.common import Finding, SourceFile, attr_path
 
 PASS = "trace"
 
-_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap"}
+_JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+    # Pallas kernels are jit roots too: a pallas_call site pins its
+    # block/out shapes exactly like a jit signature pins arg shapes,
+    # so it carries the same `# guberlint: shapes` contract.
+    "pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call",
+}
 _STATIC_STRIP_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type"}
 _STATIC_CALLS = {"len", "isinstance", "range", "tuple", "type", "hasattr",
                  "getattr"}
