@@ -222,6 +222,13 @@ def main() -> int:
             # treatment from herdtrace.  On CPU this run IS the CPU
             # line the TPU recapture is compared against (PERF.md §24).
             result = _run_devfused(np, platform)
+        elif MODE == "feeder":
+            # Columnar feeder plane (PERF.md §25): the C wire→columns
+            # pack line (rows/s) vs the Python columnar decode line,
+            # plus a same-session GUBER_NATIVE_FEEDER=0 A/B of the
+            # herd front with the window_wait / feeder_ring_wait
+            # stage attribution embedded (the §23 tail surface).
+            result = _run_feeder(np, platform)
         elif MODE == "herdtrace":
             # Same-session tracing A/B: the herdfast workload once with
             # tracing disabled and once with the in-memory recorder +
@@ -899,6 +906,214 @@ def _observability_stats(daemon) -> dict:
     if ev is not None:
         out["native_events"] = ev.stats()
     return out
+
+
+def _run_feeder(np, platform: str) -> dict:
+    """Feeder microbench + same-session feeder on/off front A/B.
+
+    Part 1 — the pack line, measured in isolation: rows/s of the C
+    columnar feeder (wire bytes → device-ready columns: decode, FNV
+    hashes, column append into the lock-free ring; sink windows, C
+    producer threads — zero Python anywhere) against the Python
+    columnar line (wire_codec.decode_reqs per RPC with fresh numpy
+    columns — the pre-feeder per-window ingest work) on the SAME
+    payloads.  The headline value is the C pack rate; the acceptance
+    bar is ≥10M rows/s or ≥5× the Python line (ISSUE 11).
+
+    Part 2 — the served path: the herd workload against the fast
+    front once with the feeder on and once with GUBER_NATIVE_FEEDER=0
+    (the byte window path), same session.  Each arm embeds its native
+    event-ring stage histograms, so the artifact carries the
+    window_wait vs feeder_ring_wait p99 attribution the §23 tail
+    analysis needs.
+    """
+    from gubernator_tpu.core.native_plane import NativeColumnarFeeder
+    from gubernator_tpu.net import wire_codec
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+    from gubernator_tpu.service import COLUMNAR_DISQUALIFIERS
+
+    items_per_rpc = int(os.environ.get("BENCH_FEEDER_ITEMS", 100))
+    reps = int(os.environ.get("BENCH_FEEDER_REPS", 20_000))
+    # Producer threads: leave one core for the recycle thread.  With
+    # producers + recycler oversubscribing the vCPUs, this gVisor
+    # box's futex/yield costs collapse the pipeline ~30× (measured:
+    # 2 producers on 2 cores degrade 18M → 0.6M rows/s after a few
+    # seconds; 1 producer is stable).  Real conn threads never spin —
+    # they fall back to the byte path on ring pressure — so the
+    # pathological regime is bench-only.
+    threads = int(os.environ.get("BENCH_FEEDER_THREADS", 0)) or max(
+        1, min(4, (os.cpu_count() or 1) - 1)
+    )
+    body = pb.GetRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name="feed", unique_key=f"user_{i}_key", hits=1,
+                limit=10**9, duration=60_000,
+                algorithm=i % 2,
+            )
+            for i in range(items_per_rpc)
+        ]
+    ).SerializeToString()
+
+    # -- part 1: pack lines ------------------------------------------
+    # Ring shape measured on this box (2 cores): more, smaller
+    # windows pipeline best when producers and the recycle thread
+    # share cores — n_slots=8 / flush=2048 is flat-optimal from 1 to
+    # 2 producer threads (the 4/4096 default optimizes the SERVED
+    # path, where Python window serve dominates the recycle).
+    feeder = NativeColumnarFeeder(
+        disqualify_mask=COLUMNAR_DISQUALIFIERS,
+        n_slots=8, max_rows=8192, flush_rows=2048,
+        window_s=0.0002, window_handler=None,
+    )
+    # Median of several draws: single draws on this shared 2-core box
+    # swing >2x with scheduler luck (the herdtrace precedent -- all
+    # draws are committed in the artifact).
+    pack_draws = int(os.environ.get("BENCH_FEEDER_DRAWS", 5))
+    pack_rates = []
+    packed = 0
+    try:
+        feeder.bench_pack(body, items_per_rpc, 200, threads)  # warmup
+        for _ in range(pack_draws):
+            t0 = time.perf_counter()
+            got = feeder.bench_pack(body, items_per_rpc, reps, threads)
+            pack_dt = time.perf_counter() - t0
+            packed += got
+            pack_rates.append(got / pack_dt if pack_dt > 0 else 0.0)
+        feeder_stats = feeder.stats()
+    finally:
+        feeder.close()
+    pack_rate = float(np.median(pack_rates))
+
+    # The Python columnar line: one decode_reqs per RPC (fresh numpy
+    # columns each call — exactly the per-window work the dispatch
+    # thread used to do, minus the ctypes body copies it ALSO paid).
+    py_reps = max(200, int(reps / 20))
+    wire_codec.decode_reqs(body, items_per_rpc, 0)  # warmup/build
+    py_rates = []
+    for _ in range(pack_draws):
+        t0 = time.perf_counter()
+        for _ in range(py_reps):
+            dec = wire_codec.decode_reqs(body, items_per_rpc, 0)
+        py_dt = time.perf_counter() - t0
+        assert dec is not None and dec.n == items_per_rpc
+        py_rates.append(
+            py_reps * items_per_rpc / py_dt if py_dt > 0 else 0.0
+        )
+    py_rate = float(np.median(py_rates))
+
+    # -- part 2: front A/B (same session) ----------------------------
+    def _arm(feeder_on: bool, clients: Optional[int] = None) -> dict:
+        prev = os.environ.get("GUBER_NATIVE_FEEDER")
+        prev_threads = os.environ.get("BENCH_HERD_THREADS")
+        os.environ["GUBER_NATIVE_FEEDER"] = "1" if feeder_on else "0"
+        if clients is not None:
+            os.environ["BENCH_HERD_THREADS"] = str(clients)
+        try:
+            out = _run_herd(np, platform, force_fast=True)
+        finally:
+            if prev is None:
+                os.environ.pop("GUBER_NATIVE_FEEDER", None)
+            else:
+                os.environ["GUBER_NATIVE_FEEDER"] = prev
+            if clients is not None:
+                if prev_threads is None:
+                    os.environ.pop("BENCH_HERD_THREADS", None)
+                else:
+                    os.environ["BENCH_HERD_THREADS"] = prev_threads
+        stages = (out.get("native_events") or {}).get("stages") or {}
+        return {
+            "value": out.get("value"),
+            "p50_ms": out.get("p50_ms"),
+            "p99_ms": out.get("p99_ms"),
+            "errors": out.get("errors"),
+            "front": out.get("front"),
+            "window_wait": stages.get("window_wait"),
+            "window_serve": stages.get("window_serve"),
+            "feeder_pack": stages.get("feeder_pack"),
+            "feeder_ring_wait": stages.get("feeder_ring_wait"),
+            "feeder_serve": stages.get("feeder_serve"),
+        }
+
+    # Alternating off/on pairs, medians reported (single pairs swing
+    # with scheduler luck; herdtrace treatment — all draws committed).
+    ab_pairs = int(os.environ.get("BENCH_FEEDER_AB_PAIRS", 3))
+    arms_off = []
+    arms_on = []
+    for _ in range(ab_pairs):
+        arms_off.append(_arm(False))
+        arms_on.append(_arm(True))
+
+    def _median_arm(arms) -> dict:
+        # The median-BY-THROUGHPUT draw, reported wholesale: its own
+        # p99 and stage histograms stay internally consistent (mixing
+        # a median value with another draw's stage attribution would
+        # let the embedded tail numbers contradict the headline they
+        # sit next to).  Per-draw p99 lists ride separately below.
+        ranked = sorted(arms, key=lambda a: a.get("value") or 0.0)
+        return dict(ranked[len(ranked) // 2])
+
+    arm_off = _median_arm(arms_off)
+    arm_on = _median_arm(arms_on)
+    # Tail-analysis arm: the same feeder front WITHOUT the bench's
+    # deliberate core oversubscription (closed-loop C clients ≫
+    # cores).  At 32-on-2-cores the queue-wait p99 measures scheduler
+    # starvation of the one Python serve thread, identically on both
+    # ingest paths; this arm shows what the ring wait is when the
+    # serve thread can actually run (PERF.md §25's tail analysis).
+    light_clients = int(os.environ.get("BENCH_FEEDER_LIGHT_THREADS", 0)) or max(
+        2, 4 * (os.cpu_count() or 1)
+    )
+    arm_light = _arm(True, clients=light_clients)
+
+    def _p99(arm: dict, stage: str):
+        s = arm.get(stage)
+        return s.get("p99_ms") if isinstance(s, dict) else None
+
+    return {
+        "metric": (
+            "columnar feeder pack throughput (wire bytes → "
+            f"device-ready columns, {threads} C threads, "
+            f"{items_per_rpc}-item RPCs) + same-session front A/B"
+        ),
+        "value": round(pack_rate, 1),
+        "unit": "rows/sec packed",
+        "vs_baseline": round(pack_rate / max(py_rate, 1.0), 2),
+        "feeder_rows_packed": int(packed),
+        "pack_rate_draws": [round(r, 1) for r in pack_rates],
+        "python_line_draws": [round(r, 1) for r in py_rates],
+        "python_line_rows_per_s": round(py_rate, 1),
+        "pack_speedup": round(pack_rate / max(py_rate, 1.0), 2),
+        "feeder_ring": {
+            k: feeder_stats[k]
+            for k in (
+                "feeder_windows", "feeder_ring_full", "feeder_declined",
+            )
+        },
+        "front_ab": {
+            "feeder_on": arm_on,
+            "feeder_off": arm_off,
+            "feeder_on_light": {"clients": light_clients, **arm_light},
+            # The §23 tail comparison: the queue wait a fall-through
+            # RPC pays before its window serves, per ingest path.
+            "window_wait_p99_ms_off": sorted(
+                _p99(a, "window_wait") or 0.0 for a in arms_off
+            )[len(arms_off) // 2],
+            "feeder_ring_wait_p99_ms_on": sorted(
+                _p99(a, "feeder_ring_wait") or 0.0 for a in arms_on
+            )[len(arms_on) // 2],
+            "window_wait_p99_draws_off": [
+                _p99(a, "window_wait") for a in arms_off
+            ],
+            "feeder_ring_wait_p99_draws_on": [
+                _p99(a, "feeder_ring_wait") for a in arms_on
+            ],
+            "feeder_ring_wait_p99_ms_light": _p99(
+                arm_light, "feeder_ring_wait"
+            ),
+        },
+        "platform": platform,
+    }
 
 
 def _run_herdtrace(np, platform: str) -> dict:

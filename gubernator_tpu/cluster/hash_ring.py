@@ -19,7 +19,9 @@ that differ only in their final byte(s) produce hashes that differ
 only in low bits and fall into the SAME ring gap — sequentially
 suffixed names like "key0".."key999" collapse onto ~one owner per
 suffix-length class.  Real keys (entropy before the tail) distribute
-fine; synthetic key generators should vary a NON-terminal byte, and
+fine; quantified: a byte changed k positions before the end moves the
+hash by ~Δ·prime^k, so synthetic key generators should keep ≥3
+constant bytes AFTER the varying ones, and
 `GUBER_PEER_PICKER_HASH=fnv1a` (final op: multiply, full avalanche)
 avoids the property entirely.
 

@@ -195,6 +195,13 @@ KNOWN_ENV_KNOBS = (
     "GUBER_NATIVE_EVENTS",       # net/h2_fast.py: C event ring on/off
     "GUBER_NATIVE_EVENTS_CAP",   # net/h2_fast.py: ring record capacity
     "GUBER_NATIVE_EVENTS_INTERVAL",  # utils/native_events.py: drain period
+    # Columnar feeder plane (net/h2_fast.py; columnar_feeder.cpp).
+    "GUBER_NATIVE_FEEDER",       # net/h2_fast.py: C columnar feeder on/off
+    "GUBER_FEEDER_RING_SLOTS",   # net/h2_fast.py: ring window count
+    "GUBER_FEEDER_RING_ROWS",    # net/h2_fast.py: rows per ring window
+    "GUBER_FEEDER_RING_KEYBYTES",  # net/h2_fast.py: key bytes per window
+    "GUBER_RETRY_HINTS",         # net/h2_fast.py: retry_after_ms metadata
+                              # on native OVER_LIMIT answers
     # Discovery plane (read by the k8s watcher, not the daemon config).
     "GUBER_K8S_NAMESPACE",    # discovery/kubernetes.py
     "GUBER_K8S_POD_SELECTOR",  # discovery/kubernetes.py

@@ -59,6 +59,10 @@ extern "C" int64_t wire_decode_reqs(
 extern "C" int64_t wire_encode_resps(
     const int32_t* status, const int64_t* limit, const int64_t* remaining,
     const int64_t* reset_time, int64_t n, uint8_t* out, int64_t out_cap);
+extern "C" int64_t wire_encode_resps_hint(
+    const int32_t* status, const int64_t* limit, const int64_t* remaining,
+    const int64_t* reset_time, int64_t n, int32_t over_status,
+    int64_t now_ms, uint8_t* out, int64_t out_cap);
 
 namespace {
 
@@ -83,6 +87,9 @@ struct Plane {
   int64_t token_algo, breakers_mask, disqualify_mask;
   int32_t over_status, under_status;
   std::atomic<int64_t> clock_offset_ms{0};
+  // retry_after_ms metadata on OVER answers (dp_set_hints; the same
+  // herd-backoff hint the feeder's scatter encodes).
+  std::atomic<int64_t> hints{0};
   // Stats — guarded by mu (NOT atomics: the serve path already holds
   // the mutex, and keeping every counter write inside it means the
   // last action of any thread touching the plane is a mutex release,
@@ -166,6 +173,12 @@ void dp_free(void* handle) { delete static_cast<Plane*>(handle); }
 
 void dp_set_clock_offset(void* handle, int64_t offset_ms) {
   static_cast<Plane*>(handle)->clock_offset_ms.store(offset_ms);
+}
+
+// Toggle retry_after_ms metadata on natively answered OVER items
+// (reset_time-derived; "When Two is Worse Than One" herd backoff).
+void dp_set_hints(void* handle, int64_t on) {
+  static_cast<Plane*>(handle)->hints.store(on);
 }
 
 // Install a sticky over-limit record (exact until `reset` passes).
@@ -347,9 +360,14 @@ int64_t dp_try_serve(void* handle, const uint8_t* body, int64_t len,
     // which sized callers never hit) must leave the table untouched —
     // the Python path re-serves the same rows, and a committed drain
     // here would double-count them.
-    written = wire_encode_resps(status.data(), limit.data(),
-                                remaining.data(), reset.data(), n, out,
-                                out_cap);
+    written = p->hints.load()
+                  ? wire_encode_resps_hint(status.data(), limit.data(),
+                                           remaining.data(), reset.data(),
+                                           n, p->over_status, now, out,
+                                           out_cap)
+                  : wire_encode_resps(status.data(), limit.data(),
+                                      remaining.data(), reset.data(), n,
+                                      out, out_cap);
     if (written < 0) {
       ++p->declined;
       return -1;

@@ -58,6 +58,13 @@ extern "C" int64_t dp_try_serve(void* handle, const uint8_t* body,
 extern "C" int64_t evr_record(void* handle, int64_t kind, int64_t t_end_ns,
                               int64_t dur_ns, int64_t items);
 extern "C" int64_t evr_now_ns();
+// Columnar feeder plane (columnar_feeder.cpp, same .so): wire bytes →
+// device-ready columns inside THIS connection thread; returns packed
+// rows (> 0) or a decline and the byte window path takes over.  Also
+// reachable from the conn_loop gil-free root.
+extern "C" int64_t cf_pack(void* handle, const uint8_t* body, int64_t len,
+                           int64_t max_items, void* conn_token,
+                           int64_t stream, int64_t t_enq_ns);
 
 // Event kinds (utils/native_events.py mirrors these names).
 constexpr int64_t kEvNativeServe = 1;  // conn thread: decode→probe→send
@@ -214,9 +221,14 @@ struct Server {
   // nullptr = observability off, and the serve paths skip even the
   // clock reads.
   std::atomic<void*> ring{nullptr};
+  // Optional columnar feeder plane (columnar_feeder.cpp), attached
+  // like the plane; conn threads re-read it per RPC so detach takes
+  // effect at the next request.
+  std::atomic<void*> feeder{nullptr};
   // Stats.
   std::atomic<int64_t> rpcs{0}, windows{0}, errors{0};
   std::atomic<int64_t> native_rpcs{0}, native_items{0};
+  std::atomic<int64_t> feeder_rpcs{0}, feeder_items{0};
   // Connection threads are DETACHED (a long-lived daemon must not
   // accumulate unjoined thread handles across connection churn);
   // shutdown coordinates through the live-conn registry + an active
@@ -505,6 +517,15 @@ struct StreamState {
   bool headers_done = false;
 };
 
+// Opaque per-RPC handle the columnar feeder carries from pack to
+// response scatter: keeps the Conn alive (shared_ptr) and remembers
+// the server for stats.  Allocated by conn_loop on a successful pack,
+// consumed by h2s_feeder_respond / h2s_feeder_release.
+struct FeederToken {
+  std::shared_ptr<Conn> conn;
+  Server* srv;
+};
+
 // The per-connection serve loop: frame → deframe → native-plane probe
 // → respond, entirely inside this C thread.  The zero-GIL guarantee
 // of the native fast path (PERF.md §20) is checked here: nothing
@@ -678,7 +699,9 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                   const int64_t t0 = ring ? evr_now_ns() : 0;
                   if (plane != nullptr && items > 0) {
                     std::string resp;
-                    resp.resize(static_cast<size_t>(items) * 48 + 16);
+                    // Sized for the retry-hint encode (dp_set_hints):
+                    // 4 varint fields + one metadata entry per item.
+                    resp.resize(static_cast<size_t>(items) * 96 + 16);
                     const int64_t m = dp_try_serve(
                         plane,
                         reinterpret_cast<const uint8_t*>(body.data()),
@@ -702,6 +725,31 @@ void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
                         const int64_t t1 = evr_now_ns();
                         evr_record(ring, kEvNativeServe, t1, t1 - t0,
                                    items);
+                      }
+                    }
+                  }
+                  // Columnar feeder: fall-through RPCs pack straight
+                  // into the device-ready window ring from THIS
+                  // thread — the decode+hash+column append runs here,
+                  // in parallel across connections, instead of
+                  // serially in the dispatch thread.  Any decline
+                  // (slow-path rows, ring backpressure) drops to the
+                  // byte window path unchanged.
+                  if (!served_native && items > 0) {
+                    void* feeder = srv->feeder.load();
+                    if (feeder != nullptr) {
+                      auto* token = new FeederToken{conn, srv};
+                      const int64_t fr = cf_pack(
+                          feeder,
+                          reinterpret_cast<const uint8_t*>(body.data()),
+                          static_cast<int64_t>(body.size()), items,
+                          token, stream,
+                          ring ? (t0 ? t0 : evr_now_ns()) : 0);
+                      if (fr > 0) {
+                        srv->feeder_items.fetch_add(fr);
+                        served_native = true;  // routed: no byte queue
+                      } else {
+                        delete token;
                       }
                     }
                   }
@@ -989,6 +1037,56 @@ void h2s_attach_ring(void* handle, void* ring) {
   static_cast<Server*>(handle)->ring.store(ring);
 }
 
+// Attach (or detach with nullptr) a columnar feeder created by
+// cf_create.  Lifetime contract: detach here FIRST, then cf_stop
+// (drains in-flight windows, releasing their conn tokens), then
+// h2s_stop, then cf_free — conn threads re-read the pointer per RPC,
+// so a detach takes effect at the next request.
+void h2s_attach_feeder(void* handle, void* feeder) {
+  static_cast<Server*>(handle)->feeder.store(feeder);
+}
+
+// Response scatter bridge (called by the feeder's serve thread):
+// wrap one RPC's protobuf payload in a grpc frame and send it through
+// the connection's flow-control-aware write path; consumes the token.
+void h2s_feeder_respond(void* conn_token, int64_t stream,
+                        const uint8_t* payload, int64_t len,
+                        int32_t grpc_status) {
+  auto* token = static_cast<FeederToken*>(conn_token);
+  if (token == nullptr) return;
+  // Stats mirror the byte window path EXACTLY (dispatch_loop): dead
+  // conns count nothing, errors count only into `errors`, successes
+  // only into `rpcs` — otherwise error_rate = errors/rpcs silently
+  // changes meaning when GUBER_NATIVE_FEEDER toggles and corrupts
+  // the bench's feeder-on/off A/B.
+  if (!token->conn->dead.load()) {
+    std::string data;
+    if (grpc_status == 0) {
+      data.push_back(0);  // uncompressed grpc frame
+      uint8_t len4[4];
+      put_u32(len4, static_cast<uint32_t>(len));
+      data.append(reinterpret_cast<char*>(len4), 4);
+      data.append(reinterpret_cast<const char*>(payload),
+                  static_cast<size_t>(len));
+    }
+    send_rpc_payload(token->conn, static_cast<uint32_t>(stream),
+                     std::move(data), grpc_status);
+    if (grpc_status == 0) {
+      token->srv->rpcs.fetch_add(1);
+      token->srv->feeder_rpcs.fetch_add(1);
+    } else {
+      token->srv->errors.fetch_add(1);
+    }
+  }
+  delete token;
+}
+
+// Teardown-side token release: free without sending (the feeder was
+// stopped with windows still claimed — cf_free walks them).
+void h2s_feeder_release(void* conn_token) {
+  delete static_cast<FeederToken*>(conn_token);
+}
+
 int32_t h2s_lanes(void* handle) {
   return static_cast<int32_t>(
       static_cast<Server*>(handle)->listen_fds.size());
@@ -998,15 +1096,18 @@ int32_t h2s_port(void* handle) {
   return static_cast<Server*>(handle)->port;
 }
 
-// out5: rpcs, windows, errors, native_rpcs, native_items (callers may
-// pass a larger zeroed buffer; only the first five slots are written).
-void h2s_stats(void* handle, int64_t* out5) {
+// out7: rpcs, windows, errors, native_rpcs, native_items,
+// feeder_rpcs, feeder_items (callers may pass a larger zeroed buffer;
+// only the first seven slots are written).
+void h2s_stats(void* handle, int64_t* out7) {
   auto* srv = static_cast<Server*>(handle);
-  out5[0] = srv->rpcs.load();
-  out5[1] = srv->windows.load();
-  out5[2] = srv->errors.load();
-  out5[3] = srv->native_rpcs.load();
-  out5[4] = srv->native_items.load();
+  out7[0] = srv->rpcs.load();
+  out7[1] = srv->windows.load();
+  out7[2] = srv->errors.load();
+  out7[3] = srv->native_rpcs.load();
+  out7[4] = srv->native_items.load();
+  out7[5] = srv->feeder_rpcs.load();
+  out7[6] = srv->feeder_items.load();
 }
 
 void h2s_stop(void* handle) {
@@ -1014,6 +1115,7 @@ void h2s_stop(void* handle) {
   srv->closing.store(true);
   srv->plane.store(nullptr);
   srv->ring.store(nullptr);
+  srv->feeder.store(nullptr);
   for (int fd : srv->listen_fds) {
     ::shutdown(fd, SHUT_RDWR);
     ::close(fd);

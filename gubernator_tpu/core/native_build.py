@@ -64,7 +64,10 @@ def sanitizer_preload(mode: Optional[str] = None) -> Optional[str]:
 # threads) and the wire codec (its body decode / response encode) into
 # one .so, so dp_try_serve is an ordinary in-image call for the server.
 _EXTRA_SOURCES = {
-    "h2_server": ["decision_plane.cpp", "wire_codec.cpp", "event_ring.cpp"],
+    "h2_server": [
+        "decision_plane.cpp", "wire_codec.cpp", "event_ring.cpp",
+        "columnar_feeder.cpp",
+    ],
 }
 
 

@@ -26,8 +26,10 @@ application cannot shift timestamps.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -97,8 +99,6 @@ class StepPump:
     # guberlint: guard _queue, _noop, _noop_dev, _dev_stack_cache, submitted, flushes, fused_rounds, prestaged by engine._lock
 
     def __init__(self, engine, max_group: int = MAX_GROUP) -> None:
-        import jax
-
         self.engine = engine
         self.max_group = max_group
         self._queue: List[PumpTicket] = []
@@ -143,9 +143,10 @@ class StepPump:
 
     def submit(self, buf: np.ndarray) -> PumpTicket:  # guberlint: holds engine._lock
         """Queue one packed [PACKED_IN_ROWS, W] round.  Caller holds
-        the engine lock (dispatch order = queue order)."""
-        import time as _time
-
+        the engine lock (dispatch order = queue order).  Hot path for
+        the columnar feeder's ring windows: every window that reaches
+        the device enters here, so the per-call imports this method
+        used to carry are hoisted to module level."""
         t = PumpTicket(self, buf)
         t.t_submit = _time.monotonic()
         if (
@@ -155,8 +156,6 @@ class StepPump:
             # Start the h2d NOW: the transfer rides the device queue
             # behind the currently executing group, so upload(N+1)
             # overlaps compute(N) instead of serializing at flush.
-            import jax
-
             t.dev = jax.device_put(buf)
             self.prestaged += 1
         self._queue.append(t)
@@ -222,8 +221,6 @@ class StepPump:
         return buf
 
     def _noop_dev_buf(self, shape):  # guberlint: holds engine._lock
-        import jax
-
         buf = self._noop_dev.get(shape)
         if buf is None:
             buf = jax.device_put(self._noop_buf(shape))
@@ -234,8 +231,6 @@ class StepPump:
         """Cached device-side stack program: R pre-staged [rows, W]
         buffers → one [R, rows, W] scan input without a flush-time h2d
         (the double-buffered-window counterpart of np.stack)."""
-        import jax
-
         key = (count, shape)
         prog = self._dev_stack_cache.get(key)
         if prog is None:
@@ -253,8 +248,6 @@ class StepPump:
 
         eng = self.engine
         self.flushes += 1
-        import time as _time
-
         now_mono = _time.monotonic()
         for t in group:
             self.window_wait.observe(max(now_mono - t.t_submit, 0.0))

@@ -28,6 +28,7 @@ HBM on the far side of a high-latency link".
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -265,8 +266,9 @@ class ReadbackCombiner:
         return stacked
 
     def _distribute(self, group: List[Ticket], stacked) -> None:
-        import time as _time
-
+        # Hot path under feeder-driven load (one call per d2h
+        # transfer): the per-call time import is hoisted to module
+        # level, same as core/pump.py.
         t0 = _time.monotonic()
         host = np.asarray(stacked)  # ONE transfer for the whole group
         self.transfer_duration.observe(_time.monotonic() - t0)

@@ -82,6 +82,7 @@ def load() -> Optional[ctypes.CDLL]:
     lib.h2s_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_attach_plane.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_attach_ring.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.h2s_attach_feeder.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_stop.argtypes = [ctypes.c_void_p]
     # Event ring (core/native/event_ring.cpp, same .so).
     lib.evr_create.restype = ctypes.c_void_p
@@ -141,6 +142,44 @@ def native_ledger_enabled() -> bool:
     )
 
 
+def native_feeder_enabled() -> bool:
+    """GUBER_NATIVE_FEEDER (default on): pack fall-through RPCs into
+    the columnar feeder ring inside the C connection threads instead
+    of queueing wire bytes for the Python window path."""
+    return os.environ.get("GUBER_NATIVE_FEEDER", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+def retry_hints_enabled() -> bool:
+    """GUBER_RETRY_HINTS (default on): retry_after_ms metadata on
+    natively answered OVER_LIMIT items (reset_time-derived), so herds
+    back off instead of hammering."""
+    return os.environ.get("GUBER_RETRY_HINTS", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+def _int_knob(env: str, default: int) -> int:
+    v = os.environ.get(env, "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        log.warning("%s=%r not an integer; using %d", env, v, default)
+        return default
+
+
+def _feeder_ring_params() -> dict:
+    """GUBER_FEEDER_RING_SLOTS / _ROWS / _KEYBYTES — the ring's window
+    count, per-window row capacity, and per-window key-byte capacity
+    (clamped by the C side's cursor field widths)."""
+    return {
+        "n_slots": _int_knob("GUBER_FEEDER_RING_SLOTS", 4),
+        "max_rows": _int_knob("GUBER_FEEDER_RING_ROWS", 8192),
+        "key_cap": _int_knob("GUBER_FEEDER_RING_KEYBYTES", 1 << 20),
+    }
+
+
 class H2FastFront:
     """The native front bound to a V1Instance's columnar serve path."""
 
@@ -154,6 +193,7 @@ class H2FastFront:
         flush_items: int = 4096,  # early-flush: an engine-batch-worth
         lanes: Optional[int] = None,
         native_ledger: Optional[bool] = None,
+        native_feeder: Optional[bool] = None,
     ):
         lib = load()
         if lib is None:
@@ -174,6 +214,39 @@ class H2FastFront:
         self.lanes = int(lib.h2s_lanes(self._handle))
         self.plane = None
         self._attach_plane(native_ledger)
+        # Columnar feeder plane (core/native/columnar_feeder.cpp):
+        # fall-through RPCs pack into device-ready column windows in
+        # the C connection threads; Python enters once per window with
+        # zero-copy views and the C side scatters the responses.
+        # GUBER_NATIVE_FEEDER=0 restores the byte window path exactly.
+        self.feeder = None
+        if native_feeder is None:
+            native_feeder = native_feeder_enabled()
+        if native_feeder and not self._engine_columnar_ok():
+            # An engine that can never serve columnar (write-through
+            # store, or no apply_columnar entry) would make every ring
+            # window a futile decode+decline round trip — don't build
+            # the ring at all; the byte path's cheap guard-first
+            # decline handles such fronts.
+            native_feeder = False
+        if native_feeder:
+            try:
+                import gubernator_tpu.service as svc
+                from gubernator_tpu.core.native_plane import (
+                    NativeColumnarFeeder,
+                )
+
+                self.feeder = NativeColumnarFeeder(
+                    disqualify_mask=svc.COLUMNAR_DISQUALIFIERS,
+                    window_s=window_s,
+                    flush_rows=flush_items,
+                    hints=retry_hints_enabled(),
+                    window_handler=self._feeder_window,
+                    **_feeder_ring_params(),
+                )
+                lib.h2s_attach_feeder(self._handle, self.feeder.handle)
+            except (RuntimeError, OSError) as e:
+                log.warning("native columnar feeder unavailable: %s", e)
         # Event ring: the C threads publish per-stage latency events
         # (utils/native_events.py drains them).  Created unless
         # GUBER_NATIVE_EVENTS=0 — an unattached front pays nothing,
@@ -186,6 +259,10 @@ class H2FastFront:
             if ring:
                 self._ring = ctypes.c_void_p(ring)
                 lib.h2s_attach_ring(self._handle, self._ring)
+                if self.feeder is not None:
+                    # The feeder publishes feeder.pack/ring_wait/serve
+                    # stages into the same ring.
+                    self.feeder.attach_ring(self._ring)
 
     def _attach_plane(self, native_ledger: Optional[bool]) -> None:
         """Create and attach the native decision plane when the ledger
@@ -224,6 +301,9 @@ class H2FastFront:
             log.warning("native decision plane unavailable: %s", e)
             return
         ledger.attach_native(self.plane)
+        # reset_time-derived retry hints on OVER answers served by the
+        # plane (the feeder's scatter applies the same knob).
+        self.plane.set_hints(retry_hints_enabled())
         self._lib.h2s_attach_plane(self._handle, self.plane.handle)
 
     # -- the per-window entry ------------------------------------------
@@ -312,85 +392,111 @@ class H2FastFront:
             log.exception("h2 fast window failed")
             return 13  # INTERNAL
 
+    def _engine_columnar_ok(self) -> bool:
+        """The engine guards serve_decoded_local re-checks — hoisted
+        here so both ingest paths can decline BEFORE paying a decode
+        (a write-through store or a stub engine makes every window
+        UNIMPLEMENTED; the decode would be pure waste)."""
+        engine = self.instance.engine
+        return (
+            getattr(engine, "apply_columnar", None) is not None
+            and getattr(engine, "store", None) is None
+        )
+
     def _serve(self, payload: bytes, total: int):
-        """Columnar decode + engine apply for one window; None if the
-        batch needs the pb path (caller answers UNIMPLEMENTED)."""
+        """Columnar decode + engine apply for one byte window; None if
+        the batch needs the pb path (caller answers UNIMPLEMENTED).
+        The post-decode serve is service.serve_decoded_local — shared
+        with the feeder's ring windows so the ownership gate and
+        ledger semantics cannot drift between the two ingest paths."""
         import gubernator_tpu.service as svc
-        from gubernator_tpu.core.engine import PackedKeys
         from gubernator_tpu.net import wire_codec
 
-        inst = self.instance
-        engine = inst.engine
-        # Same engine guards as service.serve_wire_bytes: a
-        # write-through store must not be bypassed, and an engine
-        # without the columnar entry declines cleanly.
-        if getattr(engine, "apply_columnar", None) is None or getattr(
-            engine, "store", None
-        ) is not None:
-            return None
+        if not self._engine_columnar_ok():
+            return None  # guard-first: decline before decoding
         mask = svc.COLUMNAR_DISQUALIFIERS
         dec = wire_codec.decode_reqs(payload, max(total, 1), mask)
         if dec is None or dec.n != total:
             return None
-        # Ownership gate shared with service.serve_wire_bytes: the
-        # fast front must never answer peer-owned keys locally —
-        # clustered deployments route those through the full
-        # listener's forward path.
-        if not inst.all_locally_owned(dec):
-            return None
-        hk = getattr(inst, "hotkeys", None)
-        if hk is not None:
-            hk.offer_columns(
-                dec.key_buf, dec.key_offsets, dec.hits,
-                hashes=dec.fnv1a,
-            )
-        ledger = getattr(inst, "ledger", None)
-        if ledger is not None:
-            return self._serve_ledger(ledger, engine, dec)
-        packed = PackedKeys(dec.key_buf, dec.key_offsets, dec.n)
-        if hasattr(engine, "tables"):
-            return engine.apply_columnar(
-                packed, dec.algo, dec.behavior, dec.hits, dec.limit,
-                dec.duration, dec.burst, route_hashes=dec.fnv1a,
-            )
-        return engine.apply_columnar(
-            packed, dec.algo, dec.behavior, dec.hits, dec.limit,
-            dec.duration, dec.burst,
+        return self.instance.serve_decoded_local(dec)
+
+    # -- the per-window feeder entry (columnar_feeder.cpp) --------------
+
+    def _feeder_window(self, slot, n_rows, n_rpcs, key_bytes) -> int:
+        """Serve one sealed ring window: build a DecodedBatch of
+        ZERO-COPY views over the slot's C-resident columns (no decode,
+        no allocation — the C conn threads already packed them), run
+        the shared columnar serve, write the verdict lanes in place.
+        The feeder thread then encodes + scatters the responses in C.
+        """
+        from gubernator_tpu.net.wire_codec import DecodedBatch
+
+        # Engine-domain "now" for the scatter's retry-hint encode:
+        # reset_time verdicts are written in the ENGINE clock domain,
+        # so the hint math must subtract the same domain's now (a raw
+        # wall clock in C would skew every hint by the engine/host
+        # offset — frozen test clocks included).
+        slot.hint_now_ms[0] = self.instance.engine.clock.now_ms()
+        dec = DecodedBatch(
+            n=n_rows,
+            key_buf=slot.key_buf[:key_bytes],
+            key_offsets=slot.key_offsets[: n_rows + 1],
+            algo=slot.algo[:n_rows],
+            behavior=slot.behavior[:n_rows],
+            hits=slot.hits[:n_rows],
+            limit=slot.limit[:n_rows],
+            duration=slot.duration[:n_rows],
+            burst=slot.burst[:n_rows],
+            fnv1=slot.fnv1[:n_rows],
+            fnv1a=slot.fnv1a[:n_rows],
+            name_len=slot.name_lens[:n_rows],
         )
-
-    @staticmethod
-    def _serve_ledger(ledger, engine, dec):
-        """Ledger-aware window serve: hot-key rows (sticky over-limit,
-        live lease credit) answer without any device work — for a fully
-        hot window the engine is never dispatched at all, which is the
-        front's whole point on a dispatch-bound backend."""
-        from gubernator_tpu.core.engine import PackedKeys
-
-        plan = ledger.plan(dec, engine.clock.now_ms())
-        if plan.full:
-            return plan.dense_cols()
-        lane = plan.build_engine_lane()
-        packed = PackedKeys(lane.key_buf, lane.key_offsets, lane.n)
-        try:
-            if hasattr(engine, "tables"):
-                out = engine.apply_columnar(
-                    packed, lane.algo, lane.behavior, lane.hits,
-                    lane.limit, lane.duration, lane.burst,
-                    route_hashes=lane.fnv1a,
-                )
+        out = self.instance.serve_decoded_local(dec)
+        if out is not None:
+            st, lim, rem, rst = out
+            slot.out_status[:n_rows] = st
+            slot.out_limit[:n_rows] = lim
+            slot.out_remaining[:n_rows] = rem
+            slot.out_reset[:n_rows] = rst
+            slot.rpc_status[:n_rpcs] = 0
+            return 0
+        # The combined window declined (ownership, engine guards): one
+        # RPC out of scope must not fail its window-mates — re-serve
+        # each RPC alone off the same views and mark only the
+        # decliners UNIMPLEMENTED.  Rare path: per-RPC slicing may
+        # allocate the rebased offsets.
+        rows = slot.rpc_row
+        counts = slot.rpc_items
+        for r in range(n_rpcs):
+            row0 = int(rows[r])
+            k = int(counts[r])
+            off0 = int(slot.key_offsets[row0])
+            offk = int(slot.key_offsets[row0 + k])
+            sub = DecodedBatch(
+                n=k,
+                key_buf=slot.key_buf[off0:offk],
+                key_offsets=slot.key_offsets[row0 : row0 + k + 1] - off0,
+                algo=slot.algo[row0 : row0 + k],
+                behavior=slot.behavior[row0 : row0 + k],
+                hits=slot.hits[row0 : row0 + k],
+                limit=slot.limit[row0 : row0 + k],
+                duration=slot.duration[row0 : row0 + k],
+                burst=slot.burst[row0 : row0 + k],
+                fnv1=slot.fnv1[row0 : row0 + k],
+                fnv1a=slot.fnv1a[row0 : row0 + k],
+                name_len=slot.name_lens[row0 : row0 + k],
+            )
+            one = self.instance.serve_decoded_local(sub)
+            if one is None:
+                slot.rpc_status[r] = 12  # UNIMPLEMENTED
             else:
-                out = engine.apply_columnar(
-                    packed, lane.algo, lane.behavior, lane.hits,
-                    lane.limit, lane.duration, lane.burst,
-                )
-        except Exception:
-            plan.rollback()
-            raise
-        st, lim, rem, rst = out
-        plan.learn(st, lim, rem, rst)
-        if not plan.answered_rows and lane is dec:
-            return out
-        return plan.merge_outputs(st, rem, rst)
+                st, lim, rem, rst = one
+                slot.out_status[row0 : row0 + k] = st
+                slot.out_limit[row0 : row0 + k] = lim
+                slot.out_remaining[row0 : row0 + k] = rem
+                slot.out_reset[row0 : row0 + k] = rst
+                slot.rpc_status[r] = 0
+        return 0
 
     # -- event ring (core/native/event_ring.cpp) ------------------------
 
@@ -444,10 +550,14 @@ class H2FastFront:
             "errors": int(out[2]),
             "native_rpcs": int(out[3]),
             "native_items": int(out[4]),
+            "feeder_front_rpcs": int(out[5]),
+            "feeder_front_items": int(out[6]),
             "lanes": self.lanes,
         }
         if self.plane is not None:
             stats.update(self.plane.stats())
+        if self.feeder is not None:
+            stats.update(self.feeder.stats())
         return stats
 
     def close(self) -> None:
@@ -458,6 +568,15 @@ class H2FastFront:
                 # then joins/drains them before the ledger pulls its
                 # credit back and the table is freed.
                 self._lib.h2s_attach_plane(self._handle, None)
+            if self.feeder is not None:
+                # Feeder teardown is drain-then-close: detach (conn
+                # threads stop packing at the next RPC), stop (the
+                # serve thread drains every claimed window — pending
+                # RPCs answer UNAVAILABLE through still-live conns —
+                # then joins), and free only after h2s_stop below has
+                # also joined the conn threads.
+                self._lib.h2s_attach_feeder(self._handle, None)
+                self.feeder.stop()
             if self._ring is not None:
                 # Same contract as the plane: detach first, free only
                 # after h2s_stop joined/drained the writer threads.
@@ -470,6 +589,9 @@ class H2FastFront:
                     ledger.detach_native()
                 self.plane.close()
                 self.plane = None
+            if self.feeder is not None:
+                self.feeder.close()
+                self.feeder = None
             if self._ring is not None:
                 self._lib.evr_free(self._ring)
                 self._ring = None

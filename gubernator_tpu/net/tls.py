@@ -8,17 +8,37 @@ and a per-host server cert with SANs (selfCert :285-382).
 
 The reference uses ECDSA P-521 for AutoTLS; we use P-384 (P-521 offers
 no practical benefit and is slower in the Python `cryptography` stack).
+
+Cert minting backends: the Python `cryptography` package when
+importable, otherwise the `openssl` CLI (present in every image this
+repo targets; the grpc wheel itself links OpenSSL, so the CLI is a
+strictly weaker dependency than the wheel already carries).  Both
+produce the same shape — P-384 key, CA with basicConstraints+keyUsage,
+server cert with discovered SANs — and the TLS tests exercise
+whichever backend the environment has.
 """
 
 from __future__ import annotations
 
 import datetime
 import ipaddress
+import os
 import socket
+import subprocess
+import tempfile
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 import grpc
+
+
+def _have_cryptography() -> bool:
+    try:
+        import cryptography  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
 
 
 @dataclass
@@ -154,8 +174,114 @@ def _pem(cert, key) -> Tuple[bytes, bytes]:
     return cert_pem, key_pem
 
 
+def _run_openssl(args: List[str], cwd: str) -> None:
+    subprocess.run(
+        ["openssl"] + args, cwd=cwd, check=True, capture_output=True,
+        timeout=30,
+    )
+
+
+def _openssl_key(tmp: str, name: str) -> bytes:
+    """Mint a P-384 key via the openssl CLI, returned as PKCS8 PEM
+    (the format grpc's SSL credentials and the cryptography backend
+    both emit)."""
+    sec1 = os.path.join(tmp, f"{name}.sec1.pem")
+    pk8 = os.path.join(tmp, f"{name}.pem")
+    _run_openssl(
+        ["ecparam", "-name", "secp384r1", "-genkey", "-noout",
+         "-out", sec1], tmp,
+    )
+    _run_openssl(
+        ["pkcs8", "-topk8", "-nocrypt", "-in", sec1, "-out", pk8], tmp,
+    )
+    with open(pk8, "rb") as f:
+        return f.read()
+
+
+def _openssl_self_ca(valid_days: int) -> Tuple[bytes, bytes]:
+    with tempfile.TemporaryDirectory() as tmp:
+        key_pem = _openssl_key(tmp, "ca_key")
+        # Explicit -config: `req -x509` otherwise ALSO applies the
+        # system config's default extension section, and duplicated
+        # basicConstraints makes chain building reject the CA.
+        with open(os.path.join(tmp, "ca.cnf"), "w") as f:
+            f.write(
+                "[req]\n"
+                "distinguished_name = dn\n"
+                "x509_extensions = v3_ca\n"
+                "prompt = no\n"
+                "[dn]\n"
+                "O = gubernator_tpu\n"
+                "CN = gubernator_tpu AutoTLS CA\n"
+                "[v3_ca]\n"
+                "basicConstraints = critical,CA:TRUE\n"
+                "keyUsage = critical,digitalSignature,keyCertSign,cRLSign\n"
+                "subjectKeyIdentifier = hash\n"
+            )
+        _run_openssl(
+            [
+                "req", "-new", "-x509", "-key",
+                os.path.join(tmp, "ca_key.pem"), "-sha384",
+                "-days", str(valid_days),
+                "-config", os.path.join(tmp, "ca.cnf"),
+                "-out", os.path.join(tmp, "ca.pem"),
+            ],
+            tmp,
+        )
+        with open(os.path.join(tmp, "ca.pem"), "rb") as f:
+            return f.read(), key_pem
+
+
+def _openssl_server_cert(
+    ca_pem: bytes, ca_key_pem: bytes, hosts: Optional[List[str]],
+    valid_days: int,
+) -> Tuple[bytes, bytes]:
+    all_hosts = list(dict.fromkeys((hosts or []) + discover_san_hosts()))
+    sans = []
+    for h in all_hosts:
+        try:
+            ipaddress.ip_address(h)
+            sans.append(f"IP:{h}")
+        except ValueError:
+            sans.append(f"DNS:{h}")
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "ca.pem"), "wb") as f:
+            f.write(ca_pem)
+        with open(os.path.join(tmp, "ca_key.pem"), "wb") as f:
+            f.write(ca_key_pem)
+        key_pem = _openssl_key(tmp, "key")
+        _run_openssl(
+            [
+                "req", "-new", "-key", os.path.join(tmp, "key.pem"),
+                "-sha384",
+                "-subj", f"/O=gubernator_tpu/CN={socket.gethostname()}",
+                "-out", os.path.join(tmp, "csr.pem"),
+            ],
+            tmp,
+        )
+        with open(os.path.join(tmp, "ext.cnf"), "w") as f:
+            f.write(f"subjectAltName={','.join(sans)}\n")
+            f.write("extendedKeyUsage=serverAuth,clientAuth\n")
+            f.write("authorityKeyIdentifier=keyid,issuer\n")
+        _run_openssl(
+            [
+                "x509", "-req", "-in", os.path.join(tmp, "csr.pem"),
+                "-CA", os.path.join(tmp, "ca.pem"),
+                "-CAkey", os.path.join(tmp, "ca_key.pem"),
+                "-CAcreateserial", "-sha384", "-days", str(valid_days),
+                "-extfile", os.path.join(tmp, "ext.cnf"),
+                "-out", os.path.join(tmp, "cert.pem"),
+            ],
+            tmp,
+        )
+        with open(os.path.join(tmp, "cert.pem"), "rb") as f:
+            return f.read(), key_pem
+
+
 def generate_self_ca(valid_days: int = 365) -> Tuple[bytes, bytes]:
     """Mint a self-signed CA. reference: tls.go:384-436 (selfCA)."""
+    if not _have_cryptography():
+        return _openssl_self_ca(valid_days)
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes
 
@@ -213,6 +339,8 @@ def generate_server_cert(
 
     reference: tls.go:285-382 (selfCert).
     """
+    if not _have_cryptography():
+        return _openssl_server_cert(ca_pem, ca_key_pem, hosts, valid_days)
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.serialization import load_pem_private_key

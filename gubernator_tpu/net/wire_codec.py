@@ -70,6 +70,14 @@ def load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
         ]
+        lib.wire_encode_resps_hint.restype = ctypes.c_int64
+        # (status, limit, remaining, reset, n, over_status, now_ms,
+        #  out, out_cap)
+        lib.wire_encode_resps_hint.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ]
         lib.wire_encode_resps_owner.restype = ctypes.c_int64
         # (status, limit, remaining, reset, owner_idx, owner_buf,
         #  owner_offsets, n, out, out_cap)
@@ -317,6 +325,33 @@ def encode_resps(
     written = lib.wire_encode_resps(
         _ptr(status), _ptr(limit), _ptr(remaining), _ptr(reset_time),
         n, _ptr(out), len(out),
+    )
+    assert written >= 0
+    return out[:written].tobytes()
+
+
+def encode_resps_hint(
+    status: np.ndarray,
+    limit: np.ndarray,
+    remaining: np.ndarray,
+    reset_time: np.ndarray,
+    over_status: int,
+    now_ms: int,
+) -> bytes:
+    """Columns → response bytes with retry_after_ms metadata on
+    OVER_LIMIT items (the native tier's herd-backoff hint — the same
+    C encoder the decision plane and the columnar feeder scatter use)."""
+    lib = load()
+    assert lib is not None, "encode_resps_hint requires the native codec"
+    n = len(status)
+    status = np.ascontiguousarray(status, dtype=np.int32)
+    limit = np.ascontiguousarray(limit, dtype=np.int64)
+    remaining = np.ascontiguousarray(remaining, dtype=np.int64)
+    reset_time = np.ascontiguousarray(reset_time, dtype=np.int64)
+    out = np.empty(n * 96 + 16, dtype=np.uint8)
+    written = lib.wire_encode_resps_hint(
+        _ptr(status), _ptr(limit), _ptr(remaining), _ptr(reset_time),
+        n, int(over_status), int(now_ms), _ptr(out), len(out),
     )
     assert written >= 0
     return out[:written].tobytes()

@@ -844,6 +844,82 @@ class V1Instance:
             return all(o.info.is_owner for o in owners)
         return True
 
+    def serve_decoded_local(self, dec):
+        """Shared post-decode columnar serve for the native fronts —
+        the h2 fast front's byte windows AND the columnar feeder's
+        ring windows both land here, so the ownership gate, hot-key
+        accounting, and ledger semantics cannot drift between them.
+        Returns (status, limit, remaining, reset) columns, or None to
+        decline (caller answers UNIMPLEMENTED / falls to the pb path).
+        """
+        engine = self.engine
+        # Same engine guards as serve_wire_bytes: a write-through
+        # store must not be bypassed, and an engine without the
+        # columnar entry declines cleanly.
+        if getattr(engine, "apply_columnar", None) is None or getattr(
+            engine, "store", None
+        ) is not None:
+            return None
+        # The fast fronts must never answer peer-owned keys locally —
+        # clustered deployments route those through the full
+        # listener's forward path.
+        if not self.all_locally_owned(dec):
+            return None
+        if self.hotkeys is not None:
+            self.hotkeys.offer_columns(
+                dec.key_buf, dec.key_offsets, dec.hits,
+                hashes=dec.fnv1a,
+            )
+        if self.ledger is not None:
+            return self._serve_decoded_ledger(dec)
+        from gubernator_tpu.core.engine import PackedKeys
+
+        packed = PackedKeys(dec.key_buf, dec.key_offsets, dec.n)
+        if hasattr(engine, "tables"):
+            return engine.apply_columnar(
+                packed, dec.algo, dec.behavior, dec.hits, dec.limit,
+                dec.duration, dec.burst, route_hashes=dec.fnv1a,
+            )
+        return engine.apply_columnar(
+            packed, dec.algo, dec.behavior, dec.hits, dec.limit,
+            dec.duration, dec.burst,
+        )
+
+    def _serve_decoded_ledger(self, dec):
+        """Ledger-aware columnar serve for the native fronts: hot-key
+        rows (sticky over-limit, live lease credit) answer without any
+        device work — for a fully hot window the engine is never
+        dispatched at all, which is the fronts' whole point on a
+        dispatch-bound backend."""
+        from gubernator_tpu.core.engine import PackedKeys
+
+        engine = self.engine
+        plan = self.ledger.plan(dec, engine.clock.now_ms())
+        if plan.full:
+            return plan.dense_cols()
+        lane = plan.build_engine_lane()
+        packed = PackedKeys(lane.key_buf, lane.key_offsets, lane.n)
+        try:
+            if hasattr(engine, "tables"):
+                out = engine.apply_columnar(
+                    packed, lane.algo, lane.behavior, lane.hits,
+                    lane.limit, lane.duration, lane.burst,
+                    route_hashes=lane.fnv1a,
+                )
+            else:
+                out = engine.apply_columnar(
+                    packed, lane.algo, lane.behavior, lane.hits,
+                    lane.limit, lane.duration, lane.burst,
+                )
+        except Exception:
+            plan.rollback()
+            raise
+        st, lim, rem, rst = out
+        plan.learn(st, lim, rem, rst)
+        if not plan.answered_rows and lane is dec:
+            return out
+        return plan.merge_outputs(st, rem, rst)
+
     def serve_wire_bytes(
         self, raw: bytes, *, check_ownership: bool = True
     ) -> Optional[bytes]:

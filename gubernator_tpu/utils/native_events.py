@@ -35,8 +35,20 @@ from gubernator_tpu.utils.metrics import DurationStat, record_swallowed
 log = logging.getLogger("gubernator_tpu.native_events")
 
 # kind -> stage name (h2_server.cpp kEvNativeServe/kEvWindowWait/
-# kEvWindowServe).
-STAGES = {1: "native_serve", 2: "window_wait", 3: "window_serve"}
+# kEvWindowServe; columnar_feeder.cpp kEvFeederPack/kEvFeederRingWait/
+# kEvFeederServe).
+STAGES = {
+    1: "native_serve",
+    2: "window_wait",
+    3: "window_serve",
+    # Columnar feeder plane: per-RPC wire→columns pack (conn thread),
+    # pack → window-callback queue wait (the feeder's analog of
+    # window_wait — the stage the §23 p99 tail lived in), and the
+    # per-window columnar serve wall.
+    4: "feeder_pack",
+    5: "feeder_ring_wait",
+    6: "feeder_serve",
+}
 
 # Span stubs recorded per drain tick, bounded: under a 9k/s native
 # herd an unbounded stub stream would evict every interesting span

@@ -109,6 +109,22 @@ def _extract(data: dict) -> dict | None:
         out["dispatches_per_batch_unfused"] = data[
             "dispatches_per_batch_unfused"
         ]
+    # Columnar feeder artifacts (feeder mode): fold the pack line vs
+    # the Python columnar line, plus the front A/B's queue-wait p99
+    # per ingest path — the §23→§25 tail trajectory.
+    if data.get("python_line_rows_per_s") is not None:
+        out["python_line_rows_per_s"] = data["python_line_rows_per_s"]
+        if data.get("pack_speedup") is not None:
+            out["pack_speedup"] = data["pack_speedup"]
+        ab = data.get("front_ab")
+        if isinstance(ab, dict):
+            for k in (
+                "window_wait_p99_ms_off",
+                "feeder_ring_wait_p99_ms_on",
+                "feeder_ring_wait_p99_ms_light",
+            ):
+                if ab.get(k) is not None:
+                    out[k] = ab[k]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its

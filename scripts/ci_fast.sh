@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly five things:
+# Runs exactly six things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -17,18 +17,23 @@
 #      decision end-to-end through the real router, asserting a
 #      non-empty stitched span tree (root + engine child sharing one
 #      trace id) — jax-free, same 10 s wall budget as guberlint;
-#   3. the fused-kernel parity tier (tests/test_fused_parity.py,
+#   3. the feeder smoke (scripts/feeder_smoke.py): the native
+#      columnar feeder's C-packed columns bit-equal to the Python
+#      columnar decode for a multi-RPC window, plus the ring window
+#      lifecycle and drain-then-close teardown — jax-free, 30 s wall
+#      budget (cold .so rebuild included);
+#   4. the fused-kernel parity tier (tests/test_fused_parity.py,
 #      GUBER_FUSED=interpret, jax CPU only, 120 s wall budget): the
 #      Pallas decision kernel bit-equal to models/spec.py + the
 #      single-dispatch-per-batch invariant — the kernel stays
 #      CI-enforced without TPU hardware (PERF.md section 24);
-#   4. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   5. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants; the
 #      multi-cycle soaks are @slow);
-#   5. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#   6. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -70,6 +75,23 @@ echo "trace smoke: ${SMOKE_MS} ms (budget 10000 ms)" >&2
 if [ "${SMOKE_MS}" -gt 10000 ]; then
   echo "trace smoke blew its 10 s budget — it must stay jax-free and" >&2
   echo "cheap enough to run before the tier-1 suite" >&2
+  exit 1
+fi
+
+echo "=== feeder smoke (columnar pack parity + window lifecycle) ===" >&2
+FEED_T0=$(date +%s%N)
+if ! timeout -k 10 60 python scripts/feeder_smoke.py; then
+  echo "feeder smoke: the native columnar feeder's packed columns no" >&2
+  echo "longer match the Python columnar decode, or the ring window" >&2
+  echo "lifecycle broke (scripts/feeder_smoke.py; PERF.md section 25)" >&2
+  exit 1
+fi
+FEED_MS=$(( ($(date +%s%N) - FEED_T0) / 1000000 ))
+echo "feeder smoke: ${FEED_MS} ms (budget 30000 ms)" >&2
+if [ "${FEED_MS}" -gt 30000 ]; then
+  echo "feeder smoke blew its 30 s budget — it must stay jax-free and" >&2
+  echo "cheap enough to gate every native edit (a cold .so rebuild is" >&2
+  echo "the only legitimate slow path)" >&2
   exit 1
 fi
 

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Columnar feeder smoke: one jax-free pass over the native feeder
+plane's load-bearing contract, cheap enough to gate every commit
+(ci_fast stage; wall budget enforced by the caller).
+
+Asserts, in order:
+  1. the C pack's columns are BIT-EQUAL to the Python columnar decode
+     (key bytes, offsets, every value lane, both FNV hashes) for a
+     multi-RPC window;
+  2. the ring's window lifecycle works end-to-end: seal → columnar
+     callback with zero-copy views → verdict write-back → recycle;
+  3. drain-then-close teardown leaves consistent stats.
+
+The deep fuzz/overflow/TSan coverage lives in tests/test_feeder.py and
+tests/test_h2_server_san.py; this is the canary that the .so still
+builds and the claim protocol still lines up after any native edit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from gubernator_tpu.net import wire_codec
+from gubernator_tpu.net.h2_fast import load
+
+
+def _payload(n, salt):
+    # Hand-rolled GetRateLimitsReq (no protobuf import: stays light).
+    def varint(v):
+        out = b""
+        while v >= 0x80:
+            out += bytes([(v & 0x7F) | 0x80])
+            v >>= 7
+        return out + bytes([v])
+
+    def field(tag, wt, payload):
+        return bytes([(tag << 3) | wt]) + payload
+
+    items = b""
+    for i in range(n):
+        name = f"smoke_{salt}".encode()
+        key = f"user_{i}_k{salt}".encode()
+        item = (
+            field(1, 2, varint(len(name)) + name)
+            + field(2, 2, varint(len(key)) + key)
+            + field(3, 0, varint(i + 1))
+            + field(4, 0, varint(10**9 + i))
+            + field(5, 0, varint(60_000))
+            + field(6, 0, varint(i % 2))
+        )
+        items += field(1, 2, varint(len(item)) + item)
+    return items
+
+
+def main() -> int:
+    if load() is None:
+        print("feeder smoke: native h2 server unavailable; skipping")
+        return 0
+    from gubernator_tpu.core.native_plane import NativeColumnarFeeder
+
+    captured = []
+
+    def handler(slot, n_rows, n_rpcs, key_bytes):
+        captured.append(
+            {
+                "key_buf": slot.key_buf[:key_bytes].copy(),
+                "key_offsets": slot.key_offsets[: n_rows + 1].copy(),
+                "lanes": {
+                    lane: getattr(slot, lane)[:n_rows].copy()
+                    for lane in (
+                        "algo", "behavior", "hits", "limit", "duration",
+                        "burst", "fnv1", "fnv1a", "name_lens",
+                    )
+                },
+                "rpc_row": slot.rpc_row[:n_rpcs].copy(),
+                "rpc_items": slot.rpc_items[:n_rpcs].copy(),
+            }
+        )
+        slot.out_status[:n_rows] = 0
+        slot.rpc_status[:n_rpcs] = 0
+        return 0
+
+    feeder = NativeColumnarFeeder(
+        n_slots=2, max_rows=512, window_s=0.2, window_handler=handler
+    )
+    try:
+        bodies = [_payload(7, s) for s in range(3)]
+        for b in bodies:
+            rc = feeder.pack(b)
+            assert rc == 7, f"pack returned {rc}"
+        feeder.flush()
+        assert len(captured) == 1, f"windows: {len(captured)}"
+        got = captured[0]
+        for r, body in enumerate(bodies):
+            dec = wire_codec.decode_reqs(body, 512, 0)
+            assert dec is not None
+            row0 = int(got["rpc_row"][r])
+            k = int(got["rpc_items"][r])
+            assert k == dec.n
+            off0 = int(got["key_offsets"][row0])
+            np.testing.assert_array_equal(
+                got["key_offsets"][row0 : row0 + k + 1] - off0,
+                dec.key_offsets,
+            )
+            np.testing.assert_array_equal(
+                got["key_buf"][off0 : int(got["key_offsets"][row0 + k])],
+                dec.key_buf,
+            )
+            for lane, col in got["lanes"].items():
+                ref = getattr(dec, "name_len" if lane == "name_lens" else lane)
+                np.testing.assert_array_equal(
+                    col[row0 : row0 + k], ref, err_msg=lane
+                )
+        st = feeder.stats()
+        assert st["feeder_rows"] == 21 and st["feeder_served_rows"] == 21
+        assert st["feeder_windows"] == 1 and st["feeder_declined"] == 0
+    finally:
+        feeder.close()
+    print("feeder smoke: pack parity + window lifecycle + teardown ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,569 @@
+"""Native columnar feeder plane tests (core/native/columnar_feeder.cpp).
+
+The load-bearing guarantee is PARITY: the columns the C conn threads
+pack straight from wire bytes must be bit-equal to what the Python
+columnar line (net/wire_codec.decode_reqs) produces for the same
+payload — key bytes, offsets, every value lane, and both FNV hashes.
+Plus the ring's operational contract: overflow backpressure declines
+(never blocks, never drops), teardown drains then closes (no
+use-after-free, no stranded RPCs), and the retry-hint metadata rides
+natively answered OVER_LIMIT items.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.net import h2_fast, wire_codec
+from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+pytestmark = pytest.mark.skipif(
+    h2_fast.load() is None, reason="native h2 server unavailable"
+)
+
+
+def _payload(items):
+    return pb.GetRateLimitsReq(
+        requests=[pb.RateLimitReq(**kw) for kw in items]
+    ).SerializeToString()
+
+
+def _capture_feeder(**kw):
+    """A feeder whose window handler snapshots the packed columns."""
+    from gubernator_tpu.core.native_plane import NativeColumnarFeeder
+
+    captured = []
+
+    def handler(slot, n_rows, n_rpcs, key_bytes):
+        captured.append(
+            {
+                "key_buf": slot.key_buf[:key_bytes].copy(),
+                "key_offsets": slot.key_offsets[: n_rows + 1].copy(),
+                "algo": slot.algo[:n_rows].copy(),
+                "behavior": slot.behavior[:n_rows].copy(),
+                "hits": slot.hits[:n_rows].copy(),
+                "limit": slot.limit[:n_rows].copy(),
+                "duration": slot.duration[:n_rows].copy(),
+                "burst": slot.burst[:n_rows].copy(),
+                "fnv1": slot.fnv1[:n_rows].copy(),
+                "fnv1a": slot.fnv1a[:n_rows].copy(),
+                "name_lens": slot.name_lens[:n_rows].copy(),
+                "rpc_row": slot.rpc_row[:n_rpcs].copy(),
+                "rpc_items": slot.rpc_items[:n_rpcs].copy(),
+            }
+        )
+        slot.rpc_status[:n_rpcs] = 0
+        return 0
+
+    feeder = NativeColumnarFeeder(window_handler=handler, **kw)
+    return feeder, captured
+
+
+def _fuzz_items(rng, n):
+    """Random request rows across algorithms, value widths (32-bit
+    boundaries, int64 extremes, negative hits = settle rows), and
+    key shapes (incl. '_' in names — name_lens must still split)."""
+    items = []
+    for _ in range(n):
+        name = rng.choice(
+            ["r", "rate_limit", "x" * 60, "a_b_c", "Ω≈ç"]
+        ) + str(rng.integers(0, 99))
+        key = rng.choice(["k", "user_1234", "z" * 120]) + str(
+            rng.integers(0, 999)
+        )
+        items.append(
+            dict(
+                name=name,
+                unique_key=key,
+                hits=int(
+                    rng.choice(
+                        [0, 1, -1, 7, 2**31 - 1, 2**31, -(2**40), 2**62]
+                    )
+                ),
+                limit=int(rng.choice([1, 100, 2**32 + 5, 2**62])),
+                duration=int(rng.choice([1000, 60_000, 2**40])),
+                algorithm=int(rng.choice([0, 1])),
+                behavior=int(rng.choice([0, 2, 8, 32])),  # non-disqualifying
+                burst=int(rng.choice([0, 5, 2**33])),
+            )
+        )
+    return items
+
+
+def test_pack_parity_fuzz():
+    """C-packed columns bit-equal to the Python columnar decode across
+    wire widths/algorithms — single-RPC windows."""
+    feeder, captured = _capture_feeder(n_slots=2, max_rows=2048)
+    rng = np.random.default_rng(7)
+    try:
+        payloads = []
+        for round_ in range(20):
+            body = _payload(_fuzz_items(rng, int(rng.integers(1, 40))))
+            payloads.append(body)
+            rc = feeder.pack(body)
+            assert rc > 0
+            feeder.flush()
+        assert len(captured) == len(payloads)
+        for body, got in zip(payloads, captured):
+            dec = wire_codec.decode_reqs(body, 2048, 0)
+            assert dec is not None
+            assert got["key_offsets"][0] == 0
+            np.testing.assert_array_equal(got["key_buf"], dec.key_buf)
+            np.testing.assert_array_equal(
+                got["key_offsets"], dec.key_offsets
+            )
+            for lane in (
+                "algo", "behavior", "hits", "limit", "duration", "burst",
+                "fnv1", "fnv1a",
+            ):
+                np.testing.assert_array_equal(
+                    got[lane], getattr(dec, lane), err_msg=lane
+                )
+            np.testing.assert_array_equal(got["name_lens"], dec.name_len)
+    finally:
+        feeder.close()
+
+
+def test_pack_parity_multi_rpc_window():
+    """Several RPCs packed into ONE window: per-RPC ranges (rpc_row /
+    rpc_items) recover each body's own decode exactly, and the joint
+    offsets column stays gap-free."""
+    feeder, captured = _capture_feeder(
+        n_slots=2, max_rows=2048, window_s=0.5
+    )
+    rng = np.random.default_rng(11)
+    try:
+        bodies = [
+            _payload(_fuzz_items(rng, int(rng.integers(1, 12))))
+            for _ in range(6)
+        ]
+        for b in bodies:
+            assert feeder.pack(b) > 0
+        feeder.flush()
+        assert len(captured) == 1
+        got = captured[0]
+        assert len(got["rpc_row"]) == len(bodies)
+        # Ranges are contiguous and ordered (claims are sequential).
+        assert got["rpc_row"][0] == 0
+        np.testing.assert_array_equal(
+            got["rpc_row"][1:],
+            (got["rpc_row"] + got["rpc_items"])[:-1],
+        )
+        for r, body in enumerate(bodies):
+            dec = wire_codec.decode_reqs(body, 2048, 0)
+            row0 = int(got["rpc_row"][r])
+            k = int(got["rpc_items"][r])
+            assert k == dec.n
+            off0 = int(got["key_offsets"][row0])
+            np.testing.assert_array_equal(
+                got["key_offsets"][row0 : row0 + k + 1] - off0,
+                dec.key_offsets,
+            )
+            np.testing.assert_array_equal(
+                got["key_buf"][off0 : int(got["key_offsets"][row0 + k])],
+                dec.key_buf,
+            )
+            for lane in ("hits", "limit", "duration", "fnv1a"):
+                np.testing.assert_array_equal(
+                    got[lane][row0 : row0 + k], getattr(dec, lane),
+                    err_msg=lane,
+                )
+    finally:
+        feeder.close()
+
+
+def test_pack_declines_disqualified_and_malformed():
+    from gubernator_tpu.service import COLUMNAR_DISQUALIFIERS
+    from gubernator_tpu.types import Behavior
+
+    feeder, captured = _capture_feeder(
+        disqualify_mask=COLUMNAR_DISQUALIFIERS
+    )
+    try:
+        body = _payload(
+            [
+                dict(
+                    name="g", unique_key="k", hits=1, limit=5,
+                    duration=1000, behavior=int(Behavior.GLOBAL),
+                )
+            ]
+        )
+        assert feeder.pack(body) == -1  # disqualified → byte path
+        assert feeder.pack(b"\xff\xff\xff") == -1  # malformed
+        assert feeder.stats()["feeder_declined"] == 2
+        assert not captured
+    finally:
+        feeder.close()
+
+
+def test_oversized_claim_declines_without_sealing():
+    """An RPC whose key bytes can never fit even an EMPTY window must
+    decline to the byte path (-1) WITHOUT sealing the open window —
+    sealing would force-flush co-producers' group-commit windows on
+    every oversized arrival."""
+    feeder, captured = _capture_feeder(
+        n_slots=2, max_rows=2048, key_cap=1, window_s=0.5,
+    )  # key_cap clamps to the 64 KiB floor
+    try:
+        small = _payload(
+            [dict(name="sm", unique_key="k1xyz", hits=1, limit=9,
+                  duration=1000)]
+        )
+        big = _payload(
+            [
+                dict(name="big", unique_key="k" * 80 + str(i), hits=1,
+                     limit=9, duration=1000)
+                for i in range(1000)
+            ]
+        )  # ~80 KB of key bytes > the 64 KiB window floor
+        assert feeder.pack(small) == 1
+        before = feeder.stats()
+        assert feeder.pack(big, max_items=1000) == -1
+        after = feeder.stats()
+        assert after["feeder_declined"] == before["feeder_declined"] + 1
+        assert after["feeder_ring_full"] == before["feeder_ring_full"]
+        # The open window kept its claim open: more rows still join it.
+        assert feeder.pack(small) == 1
+        feeder.flush()
+        assert len(captured) == 1 and len(captured[0]["algo"]) == 2
+    finally:
+        feeder.close()
+
+
+def test_max_rpcs_clamp_reflected_in_views():
+    """The C side clamps max_rpcs to its cursor field width; the
+    Python views must map the CLAMPED capacity, not the raw argument
+    (an oversized view would let whole-array writes run past the C
+    allocation)."""
+    feeder, _ = _capture_feeder(n_slots=2, max_rows=64, max_rpcs=100_000)
+    try:
+        assert feeder.max_rpcs == 8191  # kRpcsMask
+        assert len(feeder.slots[0].rpc_status) == 8191
+        assert feeder.stats()["feeder_max_rpcs"] == 8191
+    finally:
+        feeder.close()
+
+
+def test_ring_overflow_backpressure_and_recovery():
+    """A blocked serve thread + tiny ring ⇒ cf_pack returns the
+    backpressure decline (never blocks, never drops); once the serve
+    thread drains, packing works again."""
+    from gubernator_tpu.core.native_plane import NativeColumnarFeeder
+
+    release = threading.Event()
+    served = []
+
+    def handler(slot, n_rows, n_rpcs, key_bytes):
+        release.wait(timeout=10)
+        served.append(n_rows)
+        slot.rpc_status[:n_rpcs] = 0
+        return 0
+
+    feeder = NativeColumnarFeeder(
+        n_slots=2, max_rows=64, max_rpcs=16, flush_rows=8,
+        window_s=0.001, window_handler=handler,
+    )
+    try:
+        body = _payload(
+            [
+                dict(name="bp", unique_key=f"k{i}xyz", hits=1, limit=9,
+                     duration=1000)
+                for i in range(8)
+            ]
+        )
+        # Window A seals at flush_rows=8 and blocks in the handler;
+        # window B fills and seals; with n_slots=2 there is nowhere to
+        # rotate → backpressure.
+        deadline = time.monotonic() + 10
+        rc = feeder.pack(body)
+        while rc > 0 and time.monotonic() < deadline:
+            rc = feeder.pack(body)
+        assert rc == -2
+        assert feeder.stats()["feeder_ring_full"] >= 1
+        release.set()
+        feeder.flush()
+        assert sum(served) == feeder.stats()["feeder_served_rows"]
+        # Recovered: the ring accepts claims again.
+        deadline = time.monotonic() + 10
+        rc = feeder.pack(body)
+        while rc == -2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+            rc = feeder.pack(body)
+        assert rc > 0
+        feeder.flush()
+    finally:
+        feeder.close()
+
+
+def test_teardown_drains_claimed_windows():
+    """close() with claimed-but-unserved windows must drain (stats
+    account every packed row) and free without crash — the
+    drain-then-close contract."""
+    from gubernator_tpu.core.native_plane import NativeColumnarFeeder
+
+    hold = threading.Event()
+
+    def handler(slot, n_rows, n_rpcs, key_bytes):
+        hold.wait(timeout=3)
+        slot.rpc_status[:n_rpcs] = 0
+        return 0
+
+    feeder = NativeColumnarFeeder(
+        n_slots=3, max_rows=64, flush_rows=8, window_s=0.001,
+        window_handler=handler,
+    )
+    body = _payload(
+        [dict(name="td", unique_key=f"x{i}abc", hits=1, limit=9,
+              duration=1000) for i in range(8)]
+    )
+    packed = 0
+    for _ in range(3):
+        rc = feeder.pack(body)
+        if rc > 0:
+            packed += rc
+    hold.set()
+    feeder.close()  # stop drains remaining windows, then frees
+    assert packed > 0
+
+
+def test_concurrent_pack_parity():
+    """Many Python threads pack concurrently; every packed row must
+    appear exactly once across the captured windows (claim/commit
+    protocol: no losses, no duplicates, offsets gap-free)."""
+    feeder, captured = _capture_feeder(
+        n_slots=4, max_rows=4096, window_s=0.002
+    )
+    try:
+        n_threads, reps = 8, 50
+        body = _payload(
+            [dict(name="cc", unique_key=f"u{i}qrs", hits=1, limit=9,
+                  duration=1000) for i in range(5)]
+        )
+        dec = wire_codec.decode_reqs(body, 64, 0)
+        ok = [0] * n_threads
+
+        def worker(t):
+            for _ in range(reps):
+                rc = feeder.pack(body)
+                if rc > 0:
+                    ok[t] += rc
+
+        ts = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        feeder.flush()
+        total = sum(ok)
+        assert total > 0
+        got_rows = sum(len(c["algo"]) for c in captured)
+        assert got_rows == total
+        klen = int(dec.key_offsets[-1])
+        for c in captured:
+            # Offsets stay cumulative and gap-free across interleaved
+            # claims, and every row's key slice is one of the body's.
+            lens = np.diff(c["key_offsets"])
+            assert c["key_offsets"][0] == 0
+            assert int(c["key_offsets"][-1]) == len(c["key_buf"])
+            assert (lens > 0).all()
+            n = len(c["algo"])
+            assert n % dec.n == 0  # whole RPCs only
+            for r0 in range(0, n, dec.n):
+                o0 = int(c["key_offsets"][r0])
+                np.testing.assert_array_equal(
+                    c["key_buf"][o0 : o0 + klen], dec.key_buf
+                )
+    finally:
+        feeder.close()
+
+
+def test_encode_resps_hint_parity_and_metadata():
+    """The hint encoder is wire_encode_resps plus ONLY the metadata
+    entry on OVER items: parse both and compare field-by-field."""
+    from gubernator_tpu.types import Status
+
+    status = np.array(
+        [int(Status.UNDER_LIMIT), int(Status.OVER_LIMIT)], dtype=np.int32
+    )
+    limit = np.array([10, 10], dtype=np.int64)
+    remaining = np.array([3, 0], dtype=np.int64)
+    reset = np.array([50_000, 60_000], dtype=np.int64)
+    plain = pb.GetRateLimitsResp.FromString(
+        wire_codec.encode_resps(status, limit, remaining, reset)
+    )
+    hinted = pb.GetRateLimitsResp.FromString(
+        wire_codec.encode_resps_hint(
+            status, limit, remaining, reset,
+            int(Status.OVER_LIMIT), 45_000,
+        )
+    )
+    for a, b in zip(plain.responses, hinted.responses):
+        assert (a.status, a.limit, a.remaining, a.reset_time) == (
+            b.status, b.limit, b.remaining, b.reset_time
+        )
+    assert not dict(hinted.responses[0].metadata)  # UNDER: no hint
+    assert dict(hinted.responses[1].metadata) == {
+        "retry_after_ms": "15000"
+    }
+    # Stale reset clamps at zero, never negative.
+    again = pb.GetRateLimitsResp.FromString(
+        wire_codec.encode_resps_hint(
+            status, limit, remaining, reset,
+            int(Status.OVER_LIMIT), 99_000,
+        )
+    )
+    assert dict(again.responses[1].metadata) == {"retry_after_ms": "0"}
+
+
+def _spawn_fast_daemon(**over):
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        cache_size=4096,
+        peer_discovery_type="none",
+        device_count=1,
+        sweep_interval=0.0,
+        h2_fast_address="127.0.0.1:0",
+        h2_fast_window=0.001,
+        **over,
+    )
+    return spawn_daemon(conf)
+
+
+def _fast_call(daemon):
+    import grpc
+
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+
+    ch = grpc.insecure_channel(daemon.h2_fast_address)
+    return ch, ch.unary_unary(
+        f"/{V1_SERVICE}/GetRateLimits",
+        request_serializer=lambda r: r,
+        response_deserializer=lambda r: r,
+    )
+
+
+def test_feeder_e2e_through_front():
+    """Fall-through RPCs (ledger off ⇒ every RPC falls through) ride
+    the feeder ring end-to-end: answers match the engine contract,
+    OVER_LIMIT carries the retry hint, and the byte window path stays
+    idle (windows == 0)."""
+    d = _spawn_fast_daemon(ledger=False)
+    try:
+        ch, call = _fast_call(d)
+        payload = _payload(
+            [
+                dict(name="fe2e", unique_key=f"k{i}end", hits=1, limit=2,
+                     duration=60_000)
+                for i in range(3)
+            ]
+        )
+        for _ in range(3):
+            raw = call(payload)
+        resp = pb.GetRateLimitsResp.FromString(raw)
+        sts = [r.status for r in resp.responses]
+        assert sts == [1, 1, 1]  # limit 2, third round: all OVER
+        for r in resp.responses:
+            hint = int(dict(r.metadata)["retry_after_ms"])
+            # reset-derived and in the ENGINE clock domain: a fresh
+            # 60 s bucket's reset is near-full, so the hint must be a
+            # sane wait, not a clock-offset artifact.
+            assert 50_000 < hint <= 60_000, hint
+        st = d.h2_fast.stats()
+        assert st["feeder_front_rpcs"] == 3
+        assert st["feeder_windows"] >= 1
+        assert st["windows"] == 0  # byte window path never entered
+        assert st["errors"] == 0
+        ch.close()
+    finally:
+        d.close()
+
+
+def test_feeder_front_declines_global_to_byte_path():
+    """A GLOBAL-behavior RPC must NOT enter the feeder (C-side
+    disqualify) — it falls to the byte window path and answers
+    UNIMPLEMENTED exactly like the pre-feeder front."""
+    import grpc
+
+    from gubernator_tpu.types import Behavior
+
+    d = _spawn_fast_daemon(ledger=False)
+    try:
+        ch, call = _fast_call(d)
+        payload = _payload(
+            [
+                dict(name="g", unique_key="k1end", hits=1, limit=5,
+                     duration=60_000, behavior=int(Behavior.GLOBAL))
+            ]
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            call(payload)
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        st = d.h2_fast.stats()
+        assert st["feeder_front_rpcs"] == 0
+        assert st["feeder_declined"] >= 1
+        assert st["windows"] >= 1  # byte path handled it
+        ch.close()
+    finally:
+        d.close()
+
+
+def test_feeder_disabled_restores_byte_path(monkeypatch):
+    monkeypatch.setenv("GUBER_NATIVE_FEEDER", "0")
+    d = _spawn_fast_daemon(ledger=False)
+    try:
+        assert d.h2_fast.feeder is None
+        ch, call = _fast_call(d)
+        payload = _payload(
+            [dict(name="off", unique_key="k1end", hits=1, limit=5,
+                  duration=60_000)]
+        )
+        raw = call(payload)
+        resp = pb.GetRateLimitsResp.FromString(raw)
+        assert resp.responses[0].remaining == 4
+        st = d.h2_fast.stats()
+        assert st["windows"] >= 1
+        assert "feeder_rpcs" not in st
+        ch.close()
+    finally:
+        d.close()
+
+
+def test_retry_hints_disabled(monkeypatch):
+    monkeypatch.setenv("GUBER_RETRY_HINTS", "0")
+    d = _spawn_fast_daemon(ledger=False)
+    try:
+        ch, call = _fast_call(d)
+        payload = _payload(
+            [dict(name="noh", unique_key="k1end", hits=1, limit=1,
+                  duration=60_000)]
+        )
+        call(payload)
+        resp = pb.GetRateLimitsResp.FromString(call(payload))
+        assert resp.responses[0].status == 1  # OVER
+        assert not dict(resp.responses[0].metadata)
+        ch.close()
+    finally:
+        d.close()
+
+
+def test_feeder_stats_in_front_stats():
+    d = _spawn_fast_daemon(ledger=False)
+    try:
+        st = d.h2_fast.stats()
+        for k in (
+            "feeder_rpcs", "feeder_rows", "feeder_windows",
+            "feeder_ring_full", "feeder_declined",
+        ):
+            assert k in st
+    finally:
+        d.close()

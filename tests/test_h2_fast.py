@@ -201,10 +201,15 @@ def test_fast_front_ownership_gate():
         front = H2FastFront(d0.instance, window_s=0.001)
         try:
             stub = V1Stub(dial(front.address))
-            # Find a key owned by the OTHER node.
+            # Find a key owned by the OTHER node.  Candidate keys keep
+            # ≥3 constant bytes AFTER the varying digits: FNV-1's final
+            # op is an xor, so a byte changed k positions before the
+            # end only moves the hash by ~Δ·prime^k — with k=1 all 200
+            # candidates cluster into one ring gap and can land on one
+            # node (the documented hash_ring.py distribution caveat).
             remote_key = None
             for i in range(200):
-                key = f"{i}r"
+                key = f"{i}rem"
                 owner = d0.instance.local_picker.get(f"own_{key}")
                 if owner.info.grpc_address != d0.grpc_address:
                     remote_key = key

@@ -61,14 +61,43 @@ def window(buf, length, counts_ptr, lens_ptr, n_rpcs, total, out_ptr,
 cb = h2_fast._CALLBACK(window)
 handle = lib.h2s_start(0, 500, 16384, 4096, 2, cb)  # 2 listener lanes
 assert handle, "h2 server failed to bind"
+
+# Columnar feeder attached: the hammer's fall-through RPCs now run
+# the REAL integrated path — conn threads cf_pack into the ring, the
+# feeder serve thread enters this columnar handler, and the scatter
+# rides h2s_feeder_respond back through the connections — all under
+# TSan.  Windows are tiny (flush_rows=8) so seal/rotate churns.
+from gubernator_tpu.core import native_plane
+
+def feeder_window(slot, n_rows, n_rpcs, key_bytes):
+    slot.out_status[:n_rows] = 0
+    slot.out_limit[:n_rows] = 100
+    slot.out_remaining[:n_rows] = 99
+    slot.out_reset[:n_rows] = 0
+    slot.rpc_status[:n_rpcs] = 0
+    return 0
+
+feeder = native_plane.NativeColumnarFeeder(
+    n_slots=3, max_rows=256, max_rpcs=64, flush_rows=8,
+    window_s=0.0005, window_handler=feeder_window,
+)
+lib.h2s_attach_feeder(handle, feeder.handle)
+
 print("PORT", int(lib.h2s_port(handle)), flush=True)
 sys.stdin.read()  # parent closes stdin when the hammer is done
 # Stats BEFORE stop: h2s_stop frees the server (TSan caught this
 # harness's original stats-after-stop as a heap-use-after-free).
 stats = np.zeros(8, dtype=np.int64)
 lib.h2s_stats(handle, stats.ctypes.data_as(ctypes.c_void_p))
+# Teardown order contract (net/h2_fast.close): detach, drain-stop the
+# feeder, stop the server, then free the ring.
+lib.h2s_attach_feeder(handle, None)
+feeder.stop()
 lib.h2s_stop(handle)
-print("san stress ok rpcs=%d windows=%d" % (stats[0], stats[1]), flush=True)
+feeder.close()
+assert stats[5] > 0, "hammer never exercised the feeder path"
+print("san stress ok rpcs=%d windows=%d feeder_rpcs=%d"
+      % (stats[0], stats[1], stats[5]), flush=True)
 """
 
 _CLIENT_SRC = r"""
@@ -262,6 +291,127 @@ assert total == pulled + final, (total, pulled, final)
 plane.close()
 print("plane san stress ok admitted=%d" % total, flush=True)
 """
+
+
+# Columnar feeder stress, PRELOADED: C bench threads (true
+# multi-producer claim/commit against the lock-free window cursor)
+# race the serve thread's seal/rotate/recycle AND a Python window
+# callback writing verdict lanes, then a mid-traffic flush and a
+# drain-then-close teardown.  Row conservation is asserted: every
+# packed row is either served or drained, never lost or duplicated.
+_FEEDER_SRC = r"""
+import threading
+import numpy as np
+
+from gubernator_tpu.core import native_plane
+
+def enc_field(tag, wt, payload):
+    return bytes([(tag << 3) | wt]) + payload
+def varint(v):
+    out = b""
+    while v >= 0x80:
+        out += bytes([(v & 0x7F) | 0x80]); v >>= 7
+    return out + bytes([v])
+items = b""
+for i in range(4):
+    k = ("hot%dxyz" % i).encode()
+    item = (enc_field(1, 2, varint(3) + b"san") + enc_field(2, 2, varint(len(k)) + k)
+            + enc_field(3, 0, varint(1)) + enc_field(4, 0, varint(100))
+            + enc_field(5, 0, varint(60000)))
+    items += enc_field(1, 2, varint(len(item)) + item)
+body = items
+
+served = [0]
+def handler(slot, n_rows, n_rpcs, key_bytes):
+    served[0] += n_rows
+    slot.out_status[:n_rows] = 0
+    slot.out_limit[:n_rows] = 100
+    slot.out_remaining[:n_rows] = 99
+    slot.out_reset[:n_rows] = 0
+    slot.rpc_status[:n_rpcs] = 0
+    return 0
+
+feeder = native_plane.NativeColumnarFeeder(
+    n_slots=3, max_rows=256, max_rpcs=64, flush_rows=64,
+    window_s=0.0005, window_handler=handler,
+)
+# Phase 1: C-threaded multi-producer hammer (true parallel claims).
+packed = feeder.bench_pack(body, 4, 1500, 4)
+feeder.flush()
+# Phase 2: Python threads interleave packs with flushes.
+py_packed = [0] * 4
+def pylane(t):
+    for i in range(300):
+        rc = feeder.pack(body)
+        if rc > 0:
+            py_packed[t] += rc
+        if i % 50 == 0:
+            feeder.flush()
+threads = [threading.Thread(target=pylane, args=(t,)) for t in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+feeder.flush()
+st = feeder.stats()
+total = packed + sum(py_packed)
+assert st["feeder_rows"] == total, (st, total)
+assert served[0] == st["feeder_served_rows"]
+# served_rows excludes sink-mode/drain windows; everything packed must
+# be accounted as served once callbacks were attached the whole run.
+assert st["feeder_served_rows"] == total, (st, total)
+feeder.close()
+print("feeder san stress ok rows=%d" % total, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_columnar_feeder_threaded_stress_under_tsan():
+    """TSan over the feeder's lock-free claim/commit/seal/recycle
+    protocol — C producer threads, the serve thread, and the Python
+    callback racing on one ring."""
+    if os.environ.get("GUBER_NATIVE_SAN", "") in ("", "0"):
+        pytest.skip("set GUBER_NATIVE_SAN=1 to run the TSan stress")
+    preload = sanitizer_preload("thread")
+    if preload is None:
+        pytest.skip("libtsan not available from this toolchain")
+    orig_san = os.environ.get("GUBER_NATIVE_SAN")
+    os.environ["GUBER_NATIVE_SAN"] = "thread"
+    try:
+        so = ensure_built("h2_server")
+    finally:
+        if orig_san is None:
+            os.environ.pop("GUBER_NATIVE_SAN", None)
+        else:
+            os.environ["GUBER_NATIVE_SAN"] = orig_san
+    if so is None:
+        pytest.skip("sanitized h2_server build failed (no g++?)")
+    supp = REPO / "tests" / "tsan_suppressions.txt"
+    proc = subprocess.run(
+        [sys.executable, "-c", _FEEDER_SRC],
+        cwd=REPO,
+        env=dict(
+            os.environ,
+            GUBER_NATIVE_SAN="thread",
+            LD_PRELOAD=preload,
+            TSAN_OPTIONS=(
+                "halt_on_error=1 exitcode=66 report_thread_leaks=0 "
+                f"report_mutex_bugs=0 detect_deadlocks=0 suppressions={supp}"
+            ),
+            PYTHONMALLOC="malloc",
+            GUBERNATOR_TPU_X64="0",
+            GUBERNATOR_TPU_COMPILE_CACHE="0",
+        ),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert "ThreadSanitizer" not in proc.stderr, (
+        "TSan report from columnar feeder:\n" + proc.stderr[-4000:]
+    )
+    assert proc.returncode == 0, (
+        f"feeder san stress failed rc={proc.returncode}\n"
+        f"stdout: {proc.stdout[-1000:]}\nstderr: {proc.stderr[-3000:]}"
+    )
+    assert "feeder san stress ok" in proc.stdout
 
 
 @pytest.mark.slow

@@ -351,7 +351,7 @@ def test_collector_histograms_and_span_stubs(tracer):
     try:
         assert col.drain_once() == 3
         counts = col.event_counts()
-        assert counts == {
+        assert {k: v for k, v in counts.items() if v} == {
             "native_serve": 1, "window_wait": 1, "window_serve": 1,
         }
         h = col.histograms()["native_serve"]
