@@ -195,6 +195,10 @@ KNOWN_ENV_KNOBS = (
     "GUBER_NATIVE_EVENTS",       # net/h2_fast.py: C event ring on/off
     "GUBER_NATIVE_EVENTS_CAP",   # net/h2_fast.py: ring record capacity
     "GUBER_NATIVE_EVENTS_INTERVAL",  # utils/native_events.py: drain period
+    # Event front (net/h2_fast.py; h2_server.cpp reactors, PERF §26).
+    "GUBER_H2_EVENT_FRONT",      # net/h2_fast.py: epoll reactor front on/off
+    "GUBER_H2_REACTORS",         # net/h2_fast.py: reactor threads (0=ncpu-1)
+    "GUBER_H2_IDLE_TIMEOUT",     # net/h2_fast.py: idle-conn reap (GOAWAY)
     # Columnar feeder plane (net/h2_fast.py; columnar_feeder.cpp).
     "GUBER_NATIVE_FEEDER",       # net/h2_fast.py: C columnar feeder on/off
     "GUBER_FEEDER_RING_SLOTS",   # net/h2_fast.py: ring window count

@@ -44,6 +44,23 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,  # resp_cap
         ctypes.c_void_p,  # out_resp_len
     ]
+    lib.h2_connscale_run.restype = ctypes.c_int64
+    lib.h2_connscale_run.argtypes = [
+        ctypes.c_char_p,  # host
+        ctypes.c_int32,  # port
+        ctypes.c_char_p,  # path
+        ctypes.c_char_p,  # authority
+        ctypes.c_void_p,  # payload
+        ctypes.c_int64,  # payload_len
+        ctypes.c_double,  # seconds
+        ctypes.c_int64,  # n_conns
+        ctypes.c_int64,  # n_active
+        ctypes.c_int32,  # threads
+        ctypes.c_double,  # ramp_budget_s
+        ctypes.c_void_p,  # out_lats
+        ctypes.c_int64,  # max_lats
+        ctypes.c_void_p,  # out_stats
+    ]
     _lib = lib
     return _lib
 
@@ -94,3 +111,56 @@ def bench_unary(
         resp[: int(resp_len[0])].tobytes(),
         int(stats[3]),
     )
+
+
+def connscale(
+    address: str,
+    path: str,
+    payload: bytes,
+    seconds: float,
+    n_conns: int,
+    n_active: int,
+    threads: int = 1,
+    ramp_budget_s: float = 60.0,
+    max_lats: int = 100_000,
+) -> Optional[dict]:
+    """Connection-scale load (PERF.md §26): hold `n_conns` open
+    connections from `threads` epoll worker threads, run closed unary
+    loops on the first `n_active` — the client-side mirror of the
+    server's reactor front, cheap enough per connection to drive the
+    C10K→C100K ramp without the generator itself starving the server's
+    serve thread (the §25 trap).  The measurement window opens only
+    after the connect ramp completes.  Returns a dict or None when the
+    native client is unavailable / nothing connected."""
+    lib = load()
+    if lib is None:
+        return None
+    host, port = address.rsplit(":", 1)
+    lats = np.zeros(max_lats, dtype=np.float64)
+    stats = np.zeros(8, dtype=np.int64)
+    rc = lib.h2_connscale_run(
+        host.encode(),
+        int(port),
+        path.encode(),
+        host.encode(),
+        payload,
+        len(payload),
+        float(seconds),
+        int(n_conns),
+        int(n_active),
+        int(threads),
+        float(ramp_budget_s),
+        lats.ctypes.data_as(ctypes.c_void_p),
+        max_lats,
+        stats.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        return None
+    return {
+        "rpcs": int(stats[0]),
+        "errors": int(stats[1]),
+        "lats_s": lats[: int(stats[2])],
+        "connected": int(stats[3]),
+        "alive_at_end": int(stats[4]),
+        "ramp_ms": int(stats[5]),
+    }

@@ -196,8 +196,12 @@ struct Feeder {
 
 // Thread-local decode scratch: the two-phase pack (decode here, then
 // claim EXACT sizes and copy) is what keeps the claim protocol
-// gap-free.  Sized on first use per thread; conn threads reuse it for
-// every RPC they ever pack.
+// gap-free.  Sized on first use per CALLING thread — on the
+// thread-per-conn plane that was one scratch per connection; under
+// the §26 event front the callers are the epoll reactors, so the
+// whole C100K fleet shares ncpu−1 scratches (per-reactor, not
+// per-connection) and the high-water sizing amortizes across every
+// connection on the lane.
 struct PackScratch {
   std::vector<uint8_t> key_buf;
   std::vector<int64_t> key_offsets;

@@ -7,8 +7,13 @@
 // ports the ledger's two *exact* answer forms — sticky over-limit and
 // closed-form credit-lease drain (ops/bucket_kernel.token_extras_host)
 // — next to the h2 server, so a hot-key RPC's whole lifecycle (frame →
-// decode → probe → drain → encode) completes inside the C connection
-// thread with zero GIL acquisitions and zero Python frames.
+// decode → probe → drain → encode) completes inside the calling C
+// thread with zero GIL acquisitions and zero Python frames.  The
+// caller is a connection thread on the threaded plane or an epoll
+// reactor on the §26 event front — dp_try_serve allocates nothing
+// per-thread, so the reactor consolidation costs it nothing; it is
+// reachable from both gil-free roots and must stay Py*-free AND
+// nonblocking (guberlint's native pass checks both).
 //
 // Coherence protocol (core/ledger.py owns the authority):
 //   * Python GRANTS: on an engine-confirmed lease (or sticky-OVER

@@ -23,10 +23,12 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/epoll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <cstdint>
 #include <cstring>
@@ -168,11 +170,11 @@ struct Conn {
     static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
     uint8_t settings[9];
     frame_header(settings, 0, kFrameSettings, 0, 0);
-    if (!send_all(reinterpret_cast<const uint8_t*>(kPreface), 24)) return false;
-    return send_all(settings, 9);
+    if (!send_full(reinterpret_cast<const uint8_t*>(kPreface), 24)) return false;
+    return send_full(settings, 9);
   }
 
-  bool send_all(const uint8_t* p, size_t n) {
+  bool send_full(const uint8_t* p, size_t n) {
     while (n) {
       ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
       if (w <= 0) return false;
@@ -222,7 +224,7 @@ struct Conn {
     p[9] = 0;  // uncompressed
     put_u32(p + 10, static_cast<uint32_t>(body_len));
     std::memcpy(p + 14, body, body_len);
-    if (!send_all(out.data(), out.size())) return 0;
+    if (!send_full(out.data(), out.size())) return 0;
     send_window -= static_cast<int64_t>(data_len);
 
     // Read until END_STREAM on sid.
@@ -259,7 +261,7 @@ struct Conn {
             saw_settings = true;
             uint8_t ack[9];
             frame_header(ack, 0, kFrameSettings, kFlagAck, 0);
-            if (!send_all(ack, 9)) return 0;
+            if (!send_full(ack, 9)) return 0;
           }
           break;
         case kFramePing:
@@ -267,7 +269,7 @@ struct Conn {
             uint8_t pong[17];
             frame_header(pong, 8, kFramePing, kFlagAck, 0);
             std::memcpy(pong + 9, payload, 8);
-            if (!send_all(pong, 17)) return 0;
+            if (!send_full(pong, 17)) return 0;
           }
           break;
         case kFrameWindowUpdate:
@@ -292,7 +294,7 @@ struct Conn {
           uint8_t wu[13];
           frame_header(wu, 4, kFrameWindowUpdate, 0, 0);
           put_u32(wu + 9, static_cast<uint32_t>(recv_since_update));
-          if (!send_all(wu, 13)) return 0;
+          if (!send_full(wu, 13)) return 0;
           recv_since_update = 0;
         }
         // Trailers-only reply (no DATA) = grpc error status: a real
@@ -411,6 +413,475 @@ int64_t h2_bench_unary(const char* host, int32_t port, const char* path,
   out_stats[2] = std::min<int64_t>(lat_cursor.load(), max_lats);
   out_stats[3] = connected.load();
   return ok_any.load() ? 0 : -1;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Connection-scale epoll client (BENCH_MODE=connscale, PERF.md §26).
+//
+// Holds n_conns connections open against one address from a HANDFUL
+// of epoll threads — the client-side mirror of the server's reactor
+// front, and the load shape that lets the C10K→C100K ramp be driven
+// at all (one client thread per connection would melt the box before
+// the server noticed).  The first n_active connections run a closed
+// unary loop (one in-flight RPC each); the rest sit established and
+// idle, answering SETTINGS/PING, exactly like a parked client fleet.
+// Crucially for the §25 starvation analysis: the whole generator
+// burns `threads` CPUs (default 1), so the measurement no longer
+// starves the server's one Python serve thread under its own load.
+
+namespace {
+
+struct CsConn {
+  int fd = -1;
+  bool connecting = false;   // nonblocking connect() in flight
+  bool established = false;  // preface + SETTINGS written
+  bool active = false;       // runs the closed unary loop
+  bool dead = false;
+  int retries = 0;
+  std::vector<uint8_t> rbuf;
+  size_t rlen = 0;
+  std::string wbuf;          // pending output (short-write carry)
+  size_t woff = 0;
+  uint32_t next_stream = 1;
+  uint32_t inflight = 0;     // stream awaiting END_STREAM (0 = idle)
+  bool data_seen = false;
+  int64_t send_window = 65535;
+  int64_t recv_since_update = 0;
+  Clock::time_point t0;      // in-flight RPC start
+};
+
+struct CsShared {
+  const char* host;
+  int port;
+  sockaddr_in addr{};
+  std::string header_block;
+  const uint8_t* payload;
+  size_t payload_len;
+  double seconds;
+  std::atomic<int64_t> rpcs{0}, errors{0}, connected{0}, alive{0};
+  std::atomic<int64_t> lat_cursor{0};
+  double* out_lats = nullptr;
+  int64_t max_lats = 0;
+};
+
+// Per-connection epoll interest: EPOLLIN always; EPOLLOUT only while
+// a connect or short write is pending (level-triggered — with tens of
+// thousands of mostly-idle fds, LT costs nothing and removes the
+// drain-to-EAGAIN obligations edge mode carries).
+void cs_interest(int epfd, CsConn* c, int op) {
+  epoll_event ev{};
+  ev.events = EPOLLIN |
+              ((c->connecting || c->woff < c->wbuf.size()) ? EPOLLOUT : 0);
+  ev.data.ptr = c;
+  epoll_ctl(epfd, op, c->fd, &ev);
+}
+
+void cs_close(CsShared& sh, int epfd, CsConn* c, bool established_was) {
+  if (c->fd >= 0) {
+    epoll_ctl(epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    ::close(c->fd);
+    c->fd = -1;
+  }
+  c->dead = true;
+  if (established_was) sh.alive.fetch_sub(1);
+}
+
+bool cs_flush(CsShared& sh, int epfd, CsConn* c) {
+  while (c->woff < c->wbuf.size()) {
+    ssize_t w = ::send(c->fd, c->wbuf.data() + c->woff,
+                       c->wbuf.size() - c->woff,
+                       MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (w > 0) {
+      c->woff += static_cast<size_t>(w);
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      cs_interest(epfd, c, EPOLL_CTL_MOD);
+      return true;
+    }
+    if (w < 0 && errno == EINTR) continue;
+    return false;  // peer gone
+  }
+  if (c->woff) {
+    c->wbuf.clear();
+    c->woff = 0;
+    cs_interest(epfd, c, EPOLL_CTL_MOD);
+  }
+  return true;
+}
+
+bool cs_start_connect(CsShared& sh, int epfd, CsConn* c) {
+  c->fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (c->fd < 0) return false;
+  int one = 1;
+  setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  const int rc = ::connect(
+      c->fd, reinterpret_cast<const sockaddr*>(&sh.addr), sizeof(sh.addr));
+  if (rc != 0 && errno != EINPROGRESS) {
+    ::close(c->fd);
+    c->fd = -1;
+    return false;
+  }
+  c->connecting = true;
+  cs_interest(epfd, c, EPOLL_CTL_ADD);
+  return true;
+}
+
+void cs_establish(CsShared& sh, int epfd, CsConn* c) {
+  c->connecting = false;
+  c->established = true;
+  c->rbuf.resize(2048);
+  static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  c->wbuf.append(kPreface, 24);
+  uint8_t settings[9];
+  frame_header(settings, 0, kFrameSettings, 0, 0);
+  c->wbuf.append(reinterpret_cast<char*>(settings), 9);
+  sh.connected.fetch_add(1);
+  sh.alive.fetch_add(1);
+  if (!cs_flush(sh, epfd, c)) cs_close(sh, epfd, c, true);
+}
+
+void cs_start_rpc(CsShared& sh, int epfd, CsConn* c) {
+  const uint32_t sid = c->next_stream;
+  c->next_stream += 2;
+  const size_t data_len = 5 + sh.payload_len;
+  if (c->send_window < static_cast<int64_t>(data_len)) {
+    // Parked on window credit: resume when WINDOW_UPDATE arrives
+    // (tiny payloads — the server replenishes every 16KB).
+    c->inflight = 0;
+    return;
+  }
+  uint8_t fh[9];
+  frame_header(fh, static_cast<uint32_t>(sh.header_block.size()),
+               kFrameHeaders, kFlagEndHeaders, sid);
+  c->wbuf.append(reinterpret_cast<char*>(fh), 9);
+  c->wbuf += sh.header_block;
+  frame_header(fh, static_cast<uint32_t>(data_len), kFrameData,
+               kFlagEndStream, sid);
+  c->wbuf.append(reinterpret_cast<char*>(fh), 9);
+  c->wbuf.push_back(0);  // uncompressed
+  uint8_t len4[4];
+  put_u32(len4, static_cast<uint32_t>(sh.payload_len));
+  c->wbuf.append(reinterpret_cast<char*>(len4), 4);
+  c->wbuf.append(reinterpret_cast<const char*>(sh.payload),
+                 sh.payload_len);
+  c->send_window -= static_cast<int64_t>(data_len);
+  c->inflight = sid;
+  c->data_seen = false;
+  c->t0 = Clock::now();
+  if (!cs_flush(sh, epfd, c)) cs_close(sh, epfd, c, true);
+}
+
+// One RPC finished (END_STREAM on the in-flight stream): book it and
+// start the next while the measurement window is open.
+void cs_rpc_done(CsShared& sh, int epfd, CsConn* c, bool ok,
+                 const Clock::time_point& deadline) {
+  c->inflight = 0;
+  if (ok) {
+    sh.rpcs.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+    const double dt =
+        std::chrono::duration<double>(Clock::now() - c->t0).count();
+    const int64_t i =
+        sh.lat_cursor.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — same join-publishes argument
+    if (sh.max_lats > 0) sh.out_lats[i % sh.max_lats] = dt;
+  } else {
+    sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+  }
+  // Replenish the server's view of our receive window in bulk.
+  if (c->recv_since_update >= 4096) {
+    uint8_t wu[13];
+    frame_header(wu, 4, kFrameWindowUpdate, 0, 0);
+    put_u32(wu + 9, static_cast<uint32_t>(c->recv_since_update));
+    c->wbuf.append(reinterpret_cast<char*>(wu), 13);
+    c->recv_since_update = 0;
+  }
+  if (c->active && Clock::now() < deadline) cs_start_rpc(sh, epfd, c);
+}
+
+// Drain and parse whatever the socket holds; LT epoll re-arms any
+// leftover.
+void cs_read(CsShared& sh, int epfd, CsConn* c,
+             const Clock::time_point& deadline) {
+  for (;;) {
+    if (c->rlen == c->rbuf.size())
+      c->rbuf.resize(std::max<size_t>(2048, c->rbuf.size() * 2));
+    const ssize_t r = ::recv(c->fd, c->rbuf.data() + c->rlen,
+                             c->rbuf.size() - c->rlen, MSG_DONTWAIT);
+    if (r > 0) {
+      c->rlen += static_cast<size_t>(r);
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (r < 0 && errno == EINTR) continue;
+    cs_close(sh, epfd, c, c->established);
+    if (c->inflight)
+      sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+    return;
+  }
+  size_t pos = 0;
+  while (c->rlen - pos >= 9) {
+    const uint8_t* f = c->rbuf.data() + pos;
+    const uint32_t flen =
+        (uint32_t(f[0]) << 16) | (uint32_t(f[1]) << 8) | f[2];
+    if (c->rlen - pos < 9 + flen) break;
+    const uint8_t type = f[3], flags = f[4];
+    const uint32_t stream = get_u32(f + 5) & 0x7fffffff;
+    const uint8_t* payload = f + 9;
+    switch (type) {
+      case kFrameData:
+        c->recv_since_update += flen;
+        if (stream == c->inflight) {
+          if (flen > 0) c->data_seen = true;
+          if (flags & kFlagEndStream)
+            cs_rpc_done(sh, epfd, c, c->data_seen, deadline);
+        }
+        break;
+      case kFrameHeaders:
+        if (stream == c->inflight && (flags & kFlagEndStream))
+          cs_rpc_done(sh, epfd, c, c->data_seen, deadline);
+        break;
+      case kFrameSettings:
+        if (!(flags & kFlagAck)) {
+          uint8_t ack[9];
+          frame_header(ack, 0, kFrameSettings, kFlagAck, 0);
+          c->wbuf.append(reinterpret_cast<char*>(ack), 9);
+        }
+        break;
+      case kFramePing:
+        if (!(flags & kFlagAck) && flen == 8) {
+          uint8_t pong[17];
+          frame_header(pong, 8, kFramePing, kFlagAck, 0);
+          std::memcpy(pong + 9, payload, 8);
+          c->wbuf.append(reinterpret_cast<char*>(pong), 17);
+        }
+        break;
+      case kFrameWindowUpdate:
+        if (stream == 0) {
+          const bool was_parked =
+              c->active && c->inflight == 0 && c->established;
+          c->send_window += get_u32(payload) & 0x7fffffff;
+          if (was_parked && Clock::now() < deadline)
+            cs_start_rpc(sh, epfd, c);
+        }
+        break;
+      case kFrameRst:
+        if (stream == c->inflight)
+          cs_rpc_done(sh, epfd, c, false, deadline);
+        break;
+      case kFrameGoaway:
+        cs_close(sh, epfd, c, c->established);
+        if (c->inflight)
+          sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+        return;
+      default:
+        break;
+    }
+    pos += 9 + flen;
+    if (c->dead) return;
+  }
+  if (pos) {
+    std::memmove(c->rbuf.data(), c->rbuf.data() + pos, c->rlen - pos);
+    c->rlen -= pos;
+  }
+  if (!c->wbuf.empty() && !c->dead) {
+    if (!cs_flush(sh, epfd, c)) cs_close(sh, epfd, c, c->established);
+  }
+  // Shrink a burst buffer so 100k idle conns stay cheap.
+  if (c->rlen == 0 && c->rbuf.size() > (32u << 10)) {
+    c->rbuf.resize(2048);
+    c->rbuf.shrink_to_fit();
+  }
+}
+
+// One worker: ramp its connection range (bounded connect batches),
+// then run the closed loops on its active conns until the deadline.
+// guberlint: gil-free
+// guberlint: epoll-root
+void cs_worker(CsShared& sh, std::vector<CsConn>& conns, size_t lo,
+               size_t hi, size_t active_below,
+               std::atomic<int64_t>& ramped,
+               const std::atomic<bool>& go, double ramp_budget_s) {
+  const int epfd = epoll_create1(0);
+  if (epfd < 0) {
+    ramped.fetch_add(1);  // never strand the main thread's barrier
+    return;
+  }
+  constexpr size_t kConnectBatch = 256;
+  size_t next = lo, inflight_connects = 0;
+  const auto ramp_deadline =
+      Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                         std::chrono::duration<double>(ramp_budget_s));
+  epoll_event evs[512];
+  // Phase 1: establish everything (connect ramp).
+  while (Clock::now() < ramp_deadline) {
+    while (inflight_connects < kConnectBatch && next < hi) {
+      CsConn* c = &conns[next];
+      c->active = next < active_below;
+      ++next;
+      if (cs_start_connect(sh, epfd, c)) {
+        ++inflight_connects;
+      } else if (c->retries++ < 3) {
+        --next;  // retry the same slot
+      } else {
+        sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+        c->dead = true;
+      }
+    }
+    bool all_done = next >= hi && inflight_connects == 0;
+    if (all_done) break;
+    const int n = epoll_wait(epfd, evs, 512, 50);
+    for (int i = 0; i < n; ++i) {
+      auto* c = static_cast<CsConn*>(evs[i].data.ptr);
+      if (c->dead) continue;
+      if (c->connecting) {
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+        if ((evs[i].events & (EPOLLERR | EPOLLHUP)) || err != 0) {
+          --inflight_connects;
+          ::close(c->fd);
+          c->fd = -1;
+          c->connecting = false;
+          if (c->retries++ < 3) {
+            if (cs_start_connect(sh, epfd, c)) ++inflight_connects;
+          } else {
+            sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+            c->dead = true;
+          }
+          continue;
+        }
+        --inflight_connects;
+        cs_establish(sh, epfd, c);
+        continue;
+      }
+      // Early server frames (SETTINGS) during ramp.
+      if (evs[i].events & EPOLLIN) cs_read(sh, epfd, c, ramp_deadline);
+      if (!c->dead && (evs[i].events & EPOLLOUT)) {
+        if (!cs_flush(sh, epfd, c)) cs_close(sh, epfd, c, c->established);
+      }
+    }
+  }
+  ramped.fetch_add(1);
+  while (!go.load()) std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  // Connects still in flight when the ramp budget expired never
+  // established: close and count them — left in the loop they would
+  // spin on level-triggered EPOLLOUT and then be silently destroyed
+  // by a zero-length misread, under-reporting the held count.
+  for (size_t i = lo; i < hi; ++i) {
+    CsConn* c = &conns[i];
+    if (!c->dead && c->fd >= 0 && c->connecting) {
+      sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+      cs_close(sh, epfd, c, false);
+    }
+  }
+  // Phase 2: measured closed loops.
+  const auto deadline =
+      Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                         std::chrono::duration<double>(sh.seconds));
+  for (size_t i = lo; i < hi && i < active_below; ++i)
+    if (!conns[i].dead && conns[i].established)
+      cs_start_rpc(sh, epfd, &conns[i]);
+  while (Clock::now() < deadline) {
+    const int n = epoll_wait(epfd, evs, 512, 50);
+    for (int i = 0; i < n; ++i) {
+      auto* c = static_cast<CsConn*>(evs[i].data.ptr);
+      if (c->dead) continue;
+      if (evs[i].events & (EPOLLERR | EPOLLHUP)) {
+        cs_close(sh, epfd, c, c->established);
+        if (c->inflight)
+          sh.errors.fetch_add(1, std::memory_order_relaxed);  // guberlint: ok native — bench counter, read after join
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) cs_read(sh, epfd, c, deadline);
+      if (!c->dead && (evs[i].events & EPOLLOUT)) {
+        if (!cs_flush(sh, epfd, c)) cs_close(sh, epfd, c, c->established);
+      }
+    }
+  }
+  // Harness teardown, not connection death: leave sh.alive at its
+  // deadline value (it is the conns_alive_at_end stat).
+  for (size_t i = lo; i < hi; ++i)
+    if (conns[i].fd >= 0) cs_close(sh, epfd, &conns[i], false);
+  ::close(epfd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connection-scale load: hold `n_conns` open connections, run closed
+// unary loops on the first `n_active` of them from `threads` epoll
+// worker threads.  out_stats: [0] rpcs, [1] errors (transport +
+// trailers-only grpc errors + conns that never connected), [2] lats
+// recorded, [3] conns that completed the h2 preface, [4] conns still
+// alive at the deadline, [5] ramp wall, ms.  Latencies ring-overwrite
+// out_lats like h2_bench_unary.  Returns 0, or -1 when nothing
+// connected.
+// guberlint: gil-free
+int64_t h2_connscale_run(const char* host, int32_t port, const char* path,
+                         const char* authority, const uint8_t* payload,
+                         int64_t payload_len, double seconds,
+                         int64_t n_conns, int64_t n_active, int32_t threads,
+                         double ramp_budget_s, double* out_lats,
+                         int64_t max_lats, int64_t* out_stats) {
+  CsShared sh;
+  sh.host = host;
+  sh.port = port;
+  sh.header_block = build_header_block(path, authority);
+  sh.payload = payload;
+  sh.payload_len = static_cast<size_t>(payload_len);
+  sh.seconds = seconds;
+  sh.out_lats = out_lats;
+  sh.max_lats = max_lats;
+  sh.addr.sin_family = AF_INET;
+  sh.addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &sh.addr.sin_addr) != 1) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) return -1;
+    sh.addr.sin_addr =
+        reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  if (threads < 1) threads = 1;
+  if (n_active > n_conns) n_active = n_conns;
+  std::vector<CsConn> conns(static_cast<size_t>(n_conns));
+  std::atomic<int64_t> ramped{0};
+  std::atomic<bool> go{false};
+  const auto t_ramp0 = Clock::now();
+  std::vector<std::thread> workers;
+  const size_t per = (static_cast<size_t>(n_conns) + threads - 1) / threads;
+  for (int32_t t = 0; t < threads; ++t) {
+    const size_t lo = static_cast<size_t>(t) * per;
+    const size_t hi =
+        std::min(static_cast<size_t>(n_conns), lo + per);
+    if (lo >= hi) break;
+    workers.emplace_back([&, lo, hi]() {
+      cs_worker(sh, conns, lo, hi, static_cast<size_t>(n_active),
+                ramped, go, ramp_budget_s);
+    });
+  }
+  // Open the measurement window only once every worker finished (or
+  // timed out) its ramp: throughput must not average in connect time.
+  while (ramped.load() < static_cast<int64_t>(workers.size()))
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  const int64_t ramp_ms =
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          Clock::now() - t_ramp0)
+          .count();
+  go.store(true);
+  for (auto& th : workers) th.join();
+  out_stats[0] = sh.rpcs.load();
+  out_stats[1] = sh.errors.load();
+  out_stats[2] = std::min<int64_t>(sh.lat_cursor.load(), max_lats);
+  out_stats[3] = sh.connected.load();
+  out_stats[4] = sh.alive.load();
+  out_stats[5] = ramp_ms;
+  return sh.connected.load() > 0 ? 0 : -1;
 }
 
 }  // extern "C"

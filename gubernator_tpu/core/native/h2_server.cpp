@@ -9,11 +9,32 @@
 // ctypes callback that receives the window's concatenated request
 // bodies and returns decision columns.
 //
+// Two connection planes share one frame state machine (PERF.md §26):
+//
+// - EVENT FRONT (default): a small fixed pool of epoll reactor
+//   threads — one per SO_REUSEPORT listener lane, default ncpu−1 so
+//   one core stays reserved for the serve/dispatch plane — owns every
+//   connection fd through edge-triggered nonblocking I/O.  Per-
+//   connection ReadState machines replace per-connection stacks, so
+//   the front holds C100K connections in a handful of threads instead
+//   of a hundred thousand; egress batches through writev across the
+//   queued responses and resumes on EPOLLOUT after short writes.
+//   Reads are budgeted per wake (kReadBudget) so one firehose
+//   connection cannot monopolize its reactor, and — the §25
+//   starvation fix — conn-side CPU load is bounded by the reactor
+//   count, so the one Python serve thread can no longer be starved by
+//   connection handling.  Idle connections are reaped (GOAWAY +
+//   close) after idle_timeout_ms of silence.
+//
+// - THREAD-PER-CONN (event_front=0): the pre-§26 plane, one detached
+//   C thread per connection with blocking reads/writes — kept as the
+//   same-session A/B arm and for hosts without epoll.
+//
 // Scope (deliberate, documented in net/h2_fast.py): a dedicated
 // cleartext listener that serves exactly one unary method, so request
 // HEADERS need no HPACK decoding at all — header blocks are skipped
 // wholesale (the port IS the route), which is what makes the front
-// ~500 lines instead of an HPACK/huffman implementation.  Responses
+// small instead of an HPACK/huffman implementation.  Responses
 // use static-table + literal HPACK (no dynamic table, no huffman),
 // which every conformant peer accepts.  Requests whose decisions
 // cannot be expressed as plain (status, limit, remaining, reset)
@@ -28,13 +49,20 @@
 // proto/gubernator.proto).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pthread.h>
+#include <sched.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -44,6 +72,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 // Native decision plane (decision_plane.cpp, same .so): whole-RPC
@@ -53,23 +82,30 @@ extern "C" int64_t dp_try_serve(void* handle, const uint8_t* body,
                                 int64_t now_ms, uint8_t* out,
                                 int64_t out_cap);
 // Event ring (event_ring.cpp, same .so): lock-free per-stage latency
-// tap the conn/dispatch threads publish into — zero mutex, zero
-// allocation, zero Py* (reachable from the conn_loop gil-free root).
+// tap the conn/reactor/dispatch threads publish into — zero mutex,
+// zero allocation, zero Py* (reachable from the gil-free roots).
 extern "C" int64_t evr_record(void* handle, int64_t kind, int64_t t_end_ns,
                               int64_t dur_ns, int64_t items);
 extern "C" int64_t evr_now_ns();
 // Columnar feeder plane (columnar_feeder.cpp, same .so): wire bytes →
-// device-ready columns inside THIS connection thread; returns packed
-// rows (> 0) or a decline and the byte window path takes over.  Also
-// reachable from the conn_loop gil-free root.
+// device-ready columns inside the CALLING thread (a conn thread on
+// the threaded plane, a reactor on the event plane — the pack scratch
+// is thread_local, so the event plane pays one scratch per REACTOR
+// instead of one per connection); returns packed rows (> 0) or a
+// decline and the byte window path takes over.  Also reachable from
+// the gil-free roots.
 extern "C" int64_t cf_pack(void* handle, const uint8_t* body, int64_t len,
                            int64_t max_items, void* conn_token,
                            int64_t stream, int64_t t_enq_ns);
 
 // Event kinds (utils/native_events.py mirrors these names).
-constexpr int64_t kEvNativeServe = 1;  // conn thread: decode→probe→send
+constexpr int64_t kEvNativeServe = 1;  // conn/reactor: decode→probe→send
 constexpr int64_t kEvWindowWait = 2;   // enqueue → dispatch pickup
 constexpr int64_t kEvWindowServe = 3;  // window callback (Python) wall
+// 4..6 are the columnar feeder's (columnar_feeder.cpp).
+constexpr int64_t kEvReactorWake = 7;   // one epoll wake's processing wall
+constexpr int64_t kEvReactorRead = 8;   // one conn's read drain (items=bytes)
+constexpr int64_t kEvReactorWrite = 9;  // one writev flush (items=bytes)
 
 namespace {
 
@@ -78,6 +114,16 @@ constexpr uint8_t kData = 0x0, kHeaders = 0x1, kRst = 0x3, kSettings = 0x4,
                   kContinuation = 0x9;
 constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
                   kFlagPadded = 0x8;
+
+// Event-front tuning.  kReadBudget bounds one connection's read drain
+// per epoll wake (a firehose client yields the reactor to its lane
+// mates and resumes next iteration); kMaxOutBytes bounds the egress
+// queue of a client that stops reading (beyond it the conn is dead —
+// flow control already bounds DATA, this bounds a peer that granted
+// huge windows and then parked); kMaxIov is the writev batch width.
+constexpr size_t kReadBudget = 256 * 1024;
+constexpr size_t kMaxOutBytes = 8u << 20;
+constexpr int kMaxIov = 64;
 
 void put_u24(uint8_t* p, uint32_t v) {
   p[0] = (v >> 16) & 0xff;
@@ -189,14 +235,22 @@ struct PendingRpc {
   int64_t t_enq_ns;       // event-ring window-wait anchor (0 = no ring)
 };
 
+struct Reactor;
+
+// Hand a write-side-killed event-plane conn back to its reactor (a
+// parked peer generates no epoll event, so nothing else would ever
+// reap it).  Defined after Reactor.
+void notify_conn_dead(Conn* c);
+
 struct Server {
   // guberlint: guard queue, queued_items by q_mu
   // guberlint: guard conns by conns_mu
-  // SO_REUSEPORT listener lanes: one listen fd + accept thread per
-  // lane, all bound to the same port, so the kernel spreads incoming
-  // connections (and therefore framing/decide work, which runs on the
-  // per-connection threads) across cores instead of serializing on
-  // one accept queue.
+  // SO_REUSEPORT listener lanes: one listen fd per lane, all bound to
+  // the same port, so the kernel spreads incoming connections (and
+  // therefore framing/decide work) across cores instead of
+  // serializing on one accept queue.  On the threaded plane each lane
+  // gets an accept thread; on the event plane each lane IS one
+  // reactor's accept source.
   std::vector<int> listen_fds;
   int port = 0;
   WindowCallback callback = nullptr;
@@ -208,6 +262,11 @@ struct Server {
   int64_t flush_items = 4096;
   int64_t queued_items = 0;  // guarded by q_mu
   std::atomic<bool> closing{false};
+  // Event front (PERF.md §26): reactor pool instead of conn threads.
+  bool event_front = false;
+  int64_t idle_timeout_ms = 0;  // 0 = no idle reaping
+  std::vector<std::unique_ptr<Reactor>> reactors;
+  std::vector<std::thread> reactor_threads;
   std::vector<std::thread> accept_threads;
   std::thread dispatch_thread;
   std::mutex q_mu;
@@ -229,10 +288,12 @@ struct Server {
   std::atomic<int64_t> rpcs{0}, windows{0}, errors{0};
   std::atomic<int64_t> native_rpcs{0}, native_items{0};
   std::atomic<int64_t> feeder_rpcs{0}, feeder_items{0};
-  // Connection threads are DETACHED (a long-lived daemon must not
-  // accumulate unjoined thread handles across connection churn);
-  // shutdown coordinates through the live-conn registry + an active
-  // counter instead of joins.
+  std::atomic<int64_t> conns_open{0}, idle_reaped{0};
+  // Threaded plane only: connection threads are DETACHED (a long-
+  // lived daemon must not accumulate unjoined thread handles across
+  // connection churn); shutdown coordinates through the live-conn
+  // registry + an active counter instead of joins.  Event-plane conns
+  // are owned (and torn down) by their reactor's joinable thread.
   std::atomic<int64_t> active_conns{0};
   std::mutex conns_mu;
   std::condition_variable conns_cv;
@@ -251,18 +312,50 @@ struct PendingSend {
   std::string trailers;  // pre-framed trailer HEADERS
 };
 
+// Per-connection frame-parse state: on the threaded plane this lived
+// on the conn thread's stack; the event plane replaces the stack with
+// this struct so one reactor can hold thousands of connections
+// mid-frame.  Touched ONLY by the owning thread (the conn thread, or
+// the one reactor that owns the fd) — never concurrently.
+struct ReadState {
+  std::vector<uint8_t> buf;
+  size_t len = 0;
+  size_t preface_seen = 0;
+  // Stream table as a flat vector — ids are few and short-lived.
+  std::vector<std::pair<uint32_t, std::string>> streams;  // id → body
+};
+
 struct Conn : std::enable_shared_from_this<Conn> {
   // guberlint: guard conn_send_window, initial_stream_window, blocked, early_credits by write_mu
+  // guberlint: guard outq, outq_off, outq_bytes, want_out by write_mu
   int fd;
+  // Event plane: the owning reactor's epoll fd (−1 = threaded plane).
+  // Set once before the fd is published to the reactor; read by the
+  // write path (any thread) to pick nonblocking egress + EPOLLOUT
+  // arming over blocking sends.
+  int epfd = -1;
+  Reactor* rx = nullptr;  // owning reactor (death notification)
   std::mutex write_mu;
   std::atomic<bool> dead{false};
   int64_t recv_since_update = 0;
+  // Idle-reaping clock (event plane): monotonic ns of the last read
+  // activity.  Written by the owning reactor, read by its sweep.
+  std::atomic<int64_t> last_activity_ns{0};
+  ReadState rs;
   // Peer's receive allowance for OUR sends (guarded by write_mu):
   // connection-level window plus the initial per-stream window from
   // the peer's SETTINGS.  Responses only move inside these.
   int64_t conn_send_window = 65535;
   int64_t initial_stream_window = 65535;
   std::deque<PendingSend> blocked;
+  // Event-plane egress queue: wire bytes accepted by the framing
+  // layer but not yet by the socket.  Flushed via writev (batched
+  // across queued responses); a short write leaves the tail here and
+  // arms EPOLLOUT for resumption.
+  std::deque<std::string> outq;
+  size_t outq_off = 0;    // bytes of outq.front() already written
+  size_t outq_bytes = 0;  // total queued (backpressure cap)
+  bool want_out = false;  // EPOLLOUT armed
   // WINDOW_UPDATE credit that arrived BEFORE the stream's response was
   // queued (the client may grant window while the request is still in
   // the dispatch queue) — it must not be dropped or the response can
@@ -286,14 +379,17 @@ struct Conn : std::enable_shared_from_this<Conn> {
     if (fd >= 0) ::close(fd);
   }
 
-  bool send_locked(const std::string& buf) {
+  // Threaded-plane write-through: loop until the socket took it all.
+  bool send_blocking_locked(const std::string& buf) {
     const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
     size_t n = buf.size();
     while (n) {
-      // guberlint: ok native — the write path serializes on write_mu by
-      // design (responses must not interleave frames); the send is
-      // bounded by the socket buffer, and a stalled peer flips `dead`
-      // so the conn tears down instead of convoying its server threads.
+      // guberlint: ok native — threaded-plane branch only (epfd < 0
+      // gates it out of every reactor path): the write path
+      // serializes on write_mu by design (responses must not
+      // interleave frames); the send is bounded by the socket buffer,
+      // and a stalled peer flips `dead` so the conn tears down
+      // instead of convoying its server threads.
       ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
       if (w <= 0) {
         dead.store(true);
@@ -305,9 +401,94 @@ struct Conn : std::enable_shared_from_this<Conn> {
     return true;
   }
 
-  bool send_all(const std::string& buf) {
+  // Arm/disarm EPOLLOUT on the owning reactor.  epoll_ctl is
+  // thread-safe, so the dispatch/feeder threads can arm from their
+  // own context; a conn already removed from the epoll set fails
+  // ENOENT harmlessly (its fd stays open until the last shared_ptr
+  // drops, so the fd cannot be reused out from under a late MOD).
+  void arm_out_locked() {  // guberlint: holds write_mu
+    if (want_out || epfd < 0) return;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT | EPOLLET | EPOLLRDHUP;
+    ev.data.fd = fd;
+    if (epoll_ctl(epfd, EPOLL_CTL_MOD, fd, &ev) == 0) want_out = true;
+  }
+  void disarm_out_locked() {  // guberlint: holds write_mu
+    if (!want_out || epfd < 0) return;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLET | EPOLLRDHUP;
+    ev.data.fd = fd;
+    epoll_ctl(epfd, EPOLL_CTL_MOD, fd, &ev);
+    want_out = false;
+  }
+
+  // Event-plane egress: writev as much of outq as the socket takes,
+  // batched across queued responses; EAGAIN leaves the tail queued
+  // and arms EPOLLOUT.  Returns false only when the conn died.
+  bool flush_out_locked() {  // guberlint: holds write_mu
+    while (!outq.empty()) {
+      struct iovec iov[kMaxIov];
+      int niov = 0;
+      size_t off = outq_off;
+      for (auto it = outq.begin(); it != outq.end() && niov < kMaxIov;
+           ++it) {
+        iov[niov].iov_base = const_cast<char*>(it->data()) + off;
+        iov[niov].iov_len = it->size() - off;
+        off = 0;
+        ++niov;
+      }
+      const ssize_t w = ::writev(fd, iov, niov);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          arm_out_locked();
+          break;
+        }
+        dead.store(true);
+        notify_conn_dead(this);
+        return false;
+      }
+      size_t left = static_cast<size_t>(w);
+      outq_bytes -= left;
+      while (left) {
+        const size_t head = outq.front().size() - outq_off;
+        if (left >= head) {
+          left -= head;
+          outq.pop_front();
+          outq_off = 0;
+        } else {
+          outq_off += left;
+          left = 0;
+        }
+      }
+    }
+    if (outq.empty()) disarm_out_locked();
+    return true;
+  }
+
+  // By value: rvalue call sites (framed temporaries — the common
+  // case) MOVE into the egress queue instead of deep-copying every
+  // response's wire bytes per send.
+  bool send_locked(std::string buf) {  // guberlint: holds write_mu
+    if (epfd < 0) return send_blocking_locked(buf);
+    if (outq_bytes + buf.size() > kMaxOutBytes) {
+      // Backpressure kill: the peer granted window but stopped
+      // reading — unbounded queueing would let one parked client
+      // hold the server's memory.  The reactor must be TOLD (a
+      // parked peer fires no epoll event) or the fd + 8MB of queue
+      // would sit until the idle sweep, or forever with reaping off.
+      dead.store(true);
+      notify_conn_dead(this);
+      return false;
+    }
+    outq_bytes += buf.size();
+    outq.push_back(std::move(buf));
+    return flush_out_locked();
+  }
+
+  bool send_all(std::string buf) {
     std::lock_guard<std::mutex> lock(write_mu);
-    return send_locked(buf);
+    return send_locked(std::move(buf));
   }
 
   // Drain blocked responses in FIFO preference as far as the windows
@@ -334,7 +515,7 @@ struct Conn : std::enable_shared_from_this<Conn> {
         frame_header(out, static_cast<uint32_t>(chunk), kData, 0,
                      p.stream);
         out.append(p.data, p.off, chunk);
-        if (!send_locked(out)) return;
+        if (!send_locked(std::move(out))) return;
         conn_send_window -= static_cast<int64_t>(chunk);
         p.stream_window -= static_cast<int64_t>(chunk);
         p.off += chunk;
@@ -343,7 +524,7 @@ struct Conn : std::enable_shared_from_this<Conn> {
         ++it;
         continue;
       }
-      send_locked(p.trailers);
+      send_locked(std::move(p.trailers));  // entry erased next
       it = blocked.erase(it);
     }
   }
@@ -512,301 +693,310 @@ void send_rpc_response(const std::shared_ptr<Conn>& conn, uint32_t stream,
                    grpc_status);
 }
 
-struct StreamState {
-  std::string body;        // accumulated grpc DATA payload
-  bool headers_done = false;
-};
-
 // Opaque per-RPC handle the columnar feeder carries from pack to
 // response scatter: keeps the Conn alive (shared_ptr) and remembers
-// the server for stats.  Allocated by conn_loop on a successful pack,
-// consumed by h2s_feeder_respond / h2s_feeder_release.
+// the server for stats.  Allocated by the frame machine on a
+// successful pack, consumed by h2s_feeder_respond / h2s_feeder_release.
 struct FeederToken {
   std::shared_ptr<Conn> conn;
   Server* srv;
 };
 
-// The per-connection serve loop: frame → deframe → native-plane probe
-// → respond, entirely inside this C thread.  The zero-GIL guarantee
-// of the native fast path (PERF.md §20) is checked here: nothing
-// reachable from this loop may call Python C-API or the window
-// callback trampoline — queueing to the dispatch thread (which DOES
-// re-enter Python) is the only bridge, and it is data, not a call.
-// guberlint: gil-free
-void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
-  std::vector<uint8_t> buf(1 << 16);
-  size_t len = 0;
-  // Expect the client preface.
-  static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
-  size_t preface_seen = 0;
-  {
-    // SETTINGS: INITIAL_WINDOW_SIZE 4MB so request bodies up to the
-    // body cap never stall on per-stream flow control (we do not send
-    // per-stream WINDOW_UPDATEs), MAX_FRAME_SIZE stays default 16KB.
-    std::string s;
-    frame_header(s, 6, kSettings, 0, 0);
-    uint8_t entry[6] = {0x00, 0x04, 0x00, 0x40, 0x00, 0x00};  // id=4, 4MiB
-    s.append(reinterpret_cast<char*>(entry), 6);
-    if (!conn->send_all(s)) return;
-  }
-  // Stream table as a flat vector — ids are few and short-lived.
-  std::vector<std::pair<uint32_t, StreamState>> streams;
+static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 
-  auto stream_of = [&](uint32_t id) -> StreamState& {
-    for (auto& kv : streams)
-      if (kv.first == id) return kv.second;
-    streams.emplace_back(id, StreamState{});
-    return streams.back().second;
-  };
-  auto drop_stream = [&](uint32_t id) {
-    for (size_t i = 0; i < streams.size(); ++i)
-      if (streams[i].first == id) {
-        streams.erase(streams.begin() + i);
-        return;
-      }
-  };
-
-  while (!srv->closing.load() && !conn->dead.load()) {
-    if (len == buf.size()) buf.resize(buf.size() * 2);
-    ssize_t r = ::recv(conn->fd, buf.data() + len, buf.size() - len, 0);
-    if (r <= 0) break;
-    len += static_cast<size_t>(r);
-    size_t pos = 0;
-    // Preface bytes first.
-    while (preface_seen < 24 && pos < len) {
-      if (static_cast<char>(buf[pos]) != kPreface[preface_seen]) {
-        conn->dead.store(true);
-        break;
-      }
-      ++pos;
-      ++preface_seen;
+std::string& stream_body(ReadState& rs, uint32_t id) {
+  for (auto& kv : rs.streams)
+    if (kv.first == id) return kv.second;
+  rs.streams.emplace_back(id, std::string());
+  return rs.streams.back().second;
+}
+void drop_stream(ReadState& rs, uint32_t id) {
+  for (size_t i = 0; i < rs.streams.size(); ++i)
+    if (rs.streams[i].first == id) {
+      rs.streams.erase(rs.streams.begin() + i);
+      return;
     }
+}
+
+// One fully-deframed RPC body: native-plane probe → feeder pack →
+// byte window queue, in that preference order — the per-RPC pipeline
+// both connection planes share.  Runs on the conn thread (threaded
+// plane) or the owning reactor (event plane); never touches Python.
+// guberlint: gil-free
+void serve_rpc(Server* srv, const std::shared_ptr<Conn>& conn,
+               uint32_t stream, std::string body, int64_t items) {
+  // Native decision plane: hot-key RPCs answer right here, in this
+  // thread — no queue, no window wait, no GIL, no Python frames.
+  // Any decline (cold key, fall-through row, out-of-scope behavior)
+  // takes the window path unchanged.
+  bool routed = false;
+  void* plane = srv->plane.load();
+  void* ring = srv->ring.load();
+  const int64_t t0 = ring ? evr_now_ns() : 0;
+  if (plane != nullptr && items > 0) {
+    std::string resp;
+    // Sized for the retry-hint encode (dp_set_hints):
+    // 4 varint fields + one metadata entry per item.
+    resp.resize(static_cast<size_t>(items) * 96 + 16);
+    const int64_t m = dp_try_serve(
+        plane, reinterpret_cast<const uint8_t*>(body.data()),
+        static_cast<int64_t>(body.size()), items, -1,
+        reinterpret_cast<uint8_t*>(&resp[0]),
+        static_cast<int64_t>(resp.size()));
+    if (m >= 0) {
+      resp.resize(static_cast<size_t>(m));
+      std::string data;
+      data.push_back(0);  // uncompressed grpc frame
+      uint8_t len4[4];
+      put_u32(len4, static_cast<uint32_t>(resp.size()));
+      data.append(reinterpret_cast<char*>(len4), 4);
+      data += resp;
+      send_rpc_payload(conn, stream, std::move(data), 0);
+      srv->rpcs.fetch_add(1);
+      srv->native_rpcs.fetch_add(1);
+      srv->native_items.fetch_add(items);
+      routed = true;
+      if (ring) {
+        const int64_t t1 = evr_now_ns();
+        evr_record(ring, kEvNativeServe, t1, t1 - t0, items);
+      }
+    }
+  }
+  // Columnar feeder: fall-through RPCs pack straight into the
+  // device-ready window ring from THIS thread — the decode+hash+
+  // column append runs here, in parallel across lanes, instead of
+  // serially in the dispatch thread.  Any decline (slow-path rows,
+  // ring backpressure) drops to the byte window path unchanged.
+  if (!routed && items > 0) {
+    void* feeder = srv->feeder.load();
+    if (feeder != nullptr) {
+      auto* token = new FeederToken{conn, srv};
+      const int64_t fr = cf_pack(
+          feeder, reinterpret_cast<const uint8_t*>(body.data()),
+          static_cast<int64_t>(body.size()), items, token, stream,
+          ring ? (t0 ? t0 : evr_now_ns()) : 0);
+      if (fr > 0) {
+        srv->feeder_items.fetch_add(fr);
+        routed = true;  // routed: no byte queue
+      } else {
+        delete token;
+      }
+    }
+  }
+  if (!routed) {
+    std::lock_guard<std::mutex> lock(srv->q_mu);
+    srv->queue.push_back(
+        PendingRpc{conn, stream, std::move(body), items, t0});
+    srv->queued_items += items;
+    srv->q_cv.notify_one();
+  }
+}
+
+// The shared frame machine: consume complete preface bytes + frames
+// from conn->rs, route deframed RPCs through serve_rpc, and leave any
+// partial frame buffered for the next read.  Both connection planes
+// feed it — blocking recv loops on the threaded plane, budgeted
+// nonblocking drains on the reactors — so partial and coalesced reads
+// hit identical code.
+// guberlint: gil-free
+void process_input(Server* srv, const std::shared_ptr<Conn>& conn) {
+  ReadState& rs = conn->rs;
+  size_t pos = 0;
+  // Preface bytes first.
+  while (rs.preface_seen < 24 && pos < rs.len) {
+    if (static_cast<char>(rs.buf[pos]) != kPreface[rs.preface_seen]) {
+      conn->dead.store(true);
+      return;
+    }
+    ++pos;
+    ++rs.preface_seen;
+  }
+  // Frames.
+  for (;;) {
     if (conn->dead.load()) break;
-    // Frames.
-    for (;;) {
-      if (len - pos < 9) break;
-      const uint8_t* f = buf.data() + pos;
-      const uint32_t flen =
-          (uint32_t(f[0]) << 16) | (uint32_t(f[1]) << 8) | f[2];
-      if (flen > (1u << 20)) {  // far beyond our advertised 16KB max
-        conn->dead.store(true);
+    if (rs.len - pos < 9) break;
+    const uint8_t* f = rs.buf.data() + pos;
+    const uint32_t flen =
+        (uint32_t(f[0]) << 16) | (uint32_t(f[1]) << 8) | f[2];
+    if (flen > (1u << 20)) {  // far beyond our advertised 16KB max
+      conn->dead.store(true);
+      break;
+    }
+    if (rs.len - pos < 9 + flen) break;
+    const uint8_t type = f[3], flags = f[4];
+    const uint32_t stream = get_u32(f + 5) & 0x7fffffff;
+    const uint8_t* payload = f + 9;
+    switch (type) {
+      case kSettings:
+        if (!(flags & kFlagAck)) {
+          // Honor the peer's send-side windows: INITIAL_WINDOW_SIZE
+          // (id 4) caps how much response DATA each stream may carry
+          // before a WINDOW_UPDATE (RFC 9113 §6.5.2, §6.9.2).
+          for (uint32_t off = 0; off + 6 <= flen; off += 6) {
+            const uint16_t id =
+                (uint16_t(payload[off]) << 8) | payload[off + 1];
+            const uint32_t val = get_u32(payload + off + 2);
+            if (id == 0x4) {
+              if (val > 0x7fffffffu) {  // FLOW_CONTROL_ERROR
+                conn->dead.store(true);
+                break;
+              }
+              conn->set_initial_window(static_cast<int64_t>(val));
+            }
+          }
+          if (conn->dead.load()) break;
+          std::string s;
+          frame_header(s, 0, kSettings, kFlagAck, 0);
+          conn->send_all(s);
+        }
+        break;
+      case kPing:
+        if (!(flags & kFlagAck) && flen == 8) {
+          std::string s;
+          frame_header(s, 8, kPing, kFlagAck, 0);
+          s.append(reinterpret_cast<const char*>(payload), 8);
+          conn->send_all(s);
+        }
+        break;
+      case kHeaders:
+      case kContinuation: {
+        // Single-method port: header CONTENT is irrelevant (the
+        // port is the route); only END_STREAM matters (a request
+        // with no body ends here — answer UNIMPLEMENTED).
+        stream_body(rs, stream);
+        if (flags & kFlagEndStream) {
+          send_rpc_response(conn, stream, nullptr, 0, 0, 0, 12);
+          drop_stream(rs, stream);
+        }
         break;
       }
-      if (len - pos < 9 + flen) break;
-      const uint8_t type = f[3], flags = f[4];
-      const uint32_t stream = get_u32(f + 5) & 0x7fffffff;
-      const uint8_t* payload = f + 9;
-      switch (type) {
-        case kSettings:
-          if (!(flags & kFlagAck)) {
-            // Honor the peer's send-side windows: INITIAL_WINDOW_SIZE
-            // (id 4) caps how much response DATA each stream may carry
-            // before a WINDOW_UPDATE (RFC 9113 §6.5.2, §6.9.2).
-            for (uint32_t off = 0; off + 6 <= flen; off += 6) {
-              const uint16_t id =
-                  (uint16_t(payload[off]) << 8) | payload[off + 1];
-              const uint32_t val = get_u32(payload + off + 2);
-              if (id == 0x4) {
-                if (val > 0x7fffffffu) {  // FLOW_CONTROL_ERROR
-                  conn->dead.store(true);
-                  break;
-                }
-                conn->set_initial_window(static_cast<int64_t>(val));
-              }
-            }
-            if (conn->dead.load()) break;
-            std::string s;
-            frame_header(s, 0, kSettings, kFlagAck, 0);
-            conn->send_all(s);
-          }
-          break;
-        case kPing:
-          if (!(flags & kFlagAck) && flen == 8) {
-            std::string s;
-            frame_header(s, 8, kPing, kFlagAck, 0);
-            s.append(reinterpret_cast<const char*>(payload), 8);
-            conn->send_all(s);
-          }
-          break;
-        case kHeaders:
-        case kContinuation: {
-          // Single-method port: header CONTENT is irrelevant (the
-          // port is the route); only END_STREAM matters (a request
-          // with no body ends here — answer UNIMPLEMENTED).
-          StreamState& st = stream_of(stream);
-          if (flags & kFlagEndHeaders) st.headers_done = true;
-          if (flags & kFlagEndStream) {
-            send_rpc_response(conn, stream, nullptr, 0, 0, 0, 12);
-            drop_stream(stream);
-          }
-          break;
-        }
-        case kData: {
-          // PADDED flag: first payload byte is the pad length, pad
-          // bytes trail — both must be stripped or they corrupt the
-          // grpc message body.
-          const uint8_t* dp = payload;
-          uint32_t dlen = flen;
-          if (flags & kFlagPadded) {
-            if (dlen < 1) {
-              conn->dead.store(true);
-              break;
-            }
-            const uint8_t pad = dp[0];
-            ++dp;
-            --dlen;
-            if (pad > dlen) {
-              conn->dead.store(true);
-              break;
-            }
-            dlen -= pad;
-          }
-          StreamState& st = stream_of(stream);
-          if (st.body.size() + dlen > (4u << 20)) {
-            // No legitimate rate-limit request is megabytes long —
-            // cap per-stream buffering (DoS guard) and drop the conn.
+      case kData: {
+        // PADDED flag: first payload byte is the pad length, pad
+        // bytes trail — both must be stripped or they corrupt the
+        // grpc message body.
+        const uint8_t* dp = payload;
+        uint32_t dlen = flen;
+        if (flags & kFlagPadded) {
+          if (dlen < 1) {
             conn->dead.store(true);
             break;
           }
-          st.body.append(reinterpret_cast<const char*>(dp), dlen);
-          conn->recv_since_update += flen;  // flow control counts raw
-          if (flags & kFlagEndStream) {
-            // grpc frame: 1-byte compressed flag + u32 length + body.
-            if (st.body.size() < 5 || st.body[0] != 0) {
-              send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
-            } else {
-              const uint32_t mlen =
-                  get_u32(reinterpret_cast<const uint8_t*>(st.body.data()) + 1);
-              if (5 + mlen > st.body.size()) {
-                send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
-              } else {
-                std::string body = st.body.substr(5, mlen);
-                const int64_t items = count_items(
-                    reinterpret_cast<const uint8_t*>(body.data()),
-                    reinterpret_cast<const uint8_t*>(body.data()) +
-                        body.size());
-                if (items < 0 || items > 1000) {
-                  send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
-                } else {
-                  // Native decision plane: hot-key RPCs answer right
-                  // here, in this connection thread — no queue, no
-                  // window wait, no GIL, no Python frames.  Any
-                  // decline (cold key, fall-through row, out-of-scope
-                  // behavior) takes the window path unchanged.
-                  bool served_native = false;
-                  void* plane = srv->plane.load();
-                  void* ring = srv->ring.load();
-                  const int64_t t0 = ring ? evr_now_ns() : 0;
-                  if (plane != nullptr && items > 0) {
-                    std::string resp;
-                    // Sized for the retry-hint encode (dp_set_hints):
-                    // 4 varint fields + one metadata entry per item.
-                    resp.resize(static_cast<size_t>(items) * 96 + 16);
-                    const int64_t m = dp_try_serve(
-                        plane,
-                        reinterpret_cast<const uint8_t*>(body.data()),
-                        static_cast<int64_t>(body.size()), items, -1,
-                        reinterpret_cast<uint8_t*>(&resp[0]),
-                        static_cast<int64_t>(resp.size()));
-                    if (m >= 0) {
-                      resp.resize(static_cast<size_t>(m));
-                      std::string data;
-                      data.push_back(0);  // uncompressed grpc frame
-                      uint8_t len4[4];
-                      put_u32(len4, static_cast<uint32_t>(resp.size()));
-                      data.append(reinterpret_cast<char*>(len4), 4);
-                      data += resp;
-                      send_rpc_payload(conn, stream, std::move(data), 0);
-                      srv->rpcs.fetch_add(1);
-                      srv->native_rpcs.fetch_add(1);
-                      srv->native_items.fetch_add(items);
-                      served_native = true;
-                      if (ring) {
-                        const int64_t t1 = evr_now_ns();
-                        evr_record(ring, kEvNativeServe, t1, t1 - t0,
-                                   items);
-                      }
-                    }
-                  }
-                  // Columnar feeder: fall-through RPCs pack straight
-                  // into the device-ready window ring from THIS
-                  // thread — the decode+hash+column append runs here,
-                  // in parallel across connections, instead of
-                  // serially in the dispatch thread.  Any decline
-                  // (slow-path rows, ring backpressure) drops to the
-                  // byte window path unchanged.
-                  if (!served_native && items > 0) {
-                    void* feeder = srv->feeder.load();
-                    if (feeder != nullptr) {
-                      auto* token = new FeederToken{conn, srv};
-                      const int64_t fr = cf_pack(
-                          feeder,
-                          reinterpret_cast<const uint8_t*>(body.data()),
-                          static_cast<int64_t>(body.size()), items,
-                          token, stream,
-                          ring ? (t0 ? t0 : evr_now_ns()) : 0);
-                      if (fr > 0) {
-                        srv->feeder_items.fetch_add(fr);
-                        served_native = true;  // routed: no byte queue
-                      } else {
-                        delete token;
-                      }
-                    }
-                  }
-                  if (!served_native) {
-                    std::lock_guard<std::mutex> lock(srv->q_mu);
-                    srv->queue.push_back(PendingRpc{
-                        conn, stream, std::move(body), items, t0});
-                    srv->queued_items += items;
-                    srv->q_cv.notify_one();
-                  }
-                }
-              }
-            }
-            drop_stream(stream);
+          const uint8_t pad = dp[0];
+          ++dp;
+          --dlen;
+          if (pad > dlen) {
+            conn->dead.store(true);
+            break;
           }
-          // Replenish the connection-level receive window.
-          if (conn->recv_since_update >= 1 << 14) {
-            std::string s;
-            frame_header(s, 4, kWindowUpdate, 0, 0);
-            uint8_t inc[4];
-            put_u32(inc, static_cast<uint32_t>(conn->recv_since_update));
-            s.append(reinterpret_cast<char*>(inc), 4);
-            conn->send_all(s);
-            conn->recv_since_update = 0;
-          }
-          break;
+          dlen -= pad;
         }
-        case kRst:
-          drop_stream(stream);
-          conn->drop_stream_sends(stream);
-          break;
-        case kGoaway:
+        std::string& st_body = stream_body(rs, stream);
+        if (st_body.size() + dlen > (4u << 20)) {
+          // No legitimate rate-limit request is megabytes long —
+          // cap per-stream buffering (DoS guard) and drop the conn.
           conn->dead.store(true);
           break;
-        case kWindowUpdate: {
-          if (flen != 4) {
-            conn->dead.store(true);
-            break;
+        }
+        st_body.append(reinterpret_cast<const char*>(dp), dlen);
+        conn->recv_since_update += flen;  // flow control counts raw
+        if (flags & kFlagEndStream) {
+          // grpc frame: 1-byte compressed flag + u32 length + body.
+          if (st_body.size() < 5 || st_body[0] != 0) {
+            send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
+          } else {
+            const uint32_t mlen = get_u32(
+                reinterpret_cast<const uint8_t*>(st_body.data()) + 1);
+            if (5 + mlen > st_body.size()) {
+              send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
+            } else {
+              std::string body = st_body.substr(5, mlen);
+              const int64_t items = count_items(
+                  reinterpret_cast<const uint8_t*>(body.data()),
+                  reinterpret_cast<const uint8_t*>(body.data()) +
+                      body.size());
+              if (items < 0 || items > 1000) {
+                send_rpc_response(conn, stream, nullptr, 0, 0, 0, 13);
+              } else {
+                serve_rpc(srv, conn, stream, std::move(body), items);
+              }
+            }
           }
-          const uint32_t inc = get_u32(payload) & 0x7fffffff;
-          if (inc == 0) {  // PROTOCOL_ERROR per RFC 9113 §6.9
-            conn->dead.store(true);
-            break;
-          }
-          conn->window_update(stream, inc);
+          drop_stream(rs, stream);
+        }
+        // Replenish the connection-level receive window.
+        if (conn->recv_since_update >= 1 << 14) {
+          std::string s;
+          frame_header(s, 4, kWindowUpdate, 0, 0);
+          uint8_t inc[4];
+          put_u32(inc, static_cast<uint32_t>(conn->recv_since_update));
+          s.append(reinterpret_cast<char*>(inc), 4);
+          conn->send_all(s);
+          conn->recv_since_update = 0;
+        }
+        break;
+      }
+      case kRst:
+        drop_stream(rs, stream);
+        conn->drop_stream_sends(stream);
+        break;
+      case kGoaway:
+        conn->dead.store(true);
+        break;
+      case kWindowUpdate: {
+        if (flen != 4) {
+          conn->dead.store(true);
           break;
         }
-        default:
+        const uint32_t inc = get_u32(payload) & 0x7fffffff;
+        if (inc == 0) {  // PROTOCOL_ERROR per RFC 9113 §6.9
+          conn->dead.store(true);
           break;
+        }
+        conn->window_update(stream, inc);
+        break;
       }
-      pos += 9 + flen;
-      if (conn->dead.load()) break;
+      default:
+        break;
     }
-    if (pos) {
-      std::memmove(buf.data(), buf.data() + pos, len - pos);
-      len -= pos;
-    }
+    pos += 9 + flen;
+  }
+  if (pos) {
+    std::memmove(rs.buf.data(), rs.buf.data() + pos, rs.len - pos);
+    rs.len -= pos;
+  }
+}
+
+// The initial server SETTINGS: INITIAL_WINDOW_SIZE 4MB so request
+// bodies up to the body cap never stall on per-stream flow control
+// (we do not send per-stream WINDOW_UPDATEs), MAX_FRAME_SIZE stays
+// default 16KB.
+std::string initial_settings() {
+  std::string s;
+  frame_header(s, 6, kSettings, 0, 0);
+  uint8_t entry[6] = {0x00, 0x04, 0x00, 0x40, 0x00, 0x00};  // id=4, 4MiB
+  s.append(reinterpret_cast<char*>(entry), 6);
+  return s;
+}
+
+// The threaded-plane per-connection serve loop: blocking recv into
+// the conn's ReadState, frames through the shared machine.  The
+// zero-GIL guarantee of the native fast path (PERF.md §20) is checked
+// here: nothing reachable from this loop may call Python C-API or the
+// window callback trampoline — queueing to the dispatch thread (which
+// DOES re-enter Python) is the only bridge, and it is data, not a
+// call.
+// guberlint: gil-free
+void conn_loop(Server* srv, std::shared_ptr<Conn> conn) {
+  ReadState& rs = conn->rs;
+  rs.buf.resize(1 << 16);
+  if (!conn->send_all(initial_settings())) return;
+  while (!srv->closing.load() && !conn->dead.load()) {
+    if (rs.len == rs.buf.size()) rs.buf.resize(rs.buf.size() * 2);
+    ssize_t r = ::recv(conn->fd, rs.buf.data() + rs.len,
+                       rs.buf.size() - rs.len, 0);
+    if (r <= 0) break;
+    rs.len += static_cast<size_t>(r);
+    process_input(srv, conn);
   }
   conn->dead.store(true);
 }
@@ -934,8 +1124,10 @@ void accept_loop(Server* srv, int listen_fd) {
       srv->conns.push_back(conn);
     }
     srv->active_conns.fetch_add(1);
+    srv->conns_open.fetch_add(1);
     std::thread([srv, conn]() {
       conn_loop(srv, conn);
+      srv->conns_open.fetch_sub(1);
       srv->active_conns.fetch_sub(1);
       std::lock_guard<std::mutex> lock(srv->conns_mu);
       srv->conns_cv.notify_all();
@@ -943,22 +1135,361 @@ void accept_loop(Server* srv, int listen_fd) {
   }
 }
 
+// ---------------------------------------------------------------------
+// Event front (PERF.md §26).
+
+struct Reactor {
+  // guberlint: guard dead_fds by dead_mu
+  int epfd = -1;
+  int wake_fd = -1;   // eventfd: h2s_stop (and the write-side death
+                      // notifier) kick a parked epoll_wait
+  int listen_fd = -1;
+  // Accept pause (EMFILE/ENFILE backoff): the listen fd is level-
+  // triggered, so an un-accepted pending connection would otherwise
+  // re-fire every wake and busy-spin the reactor exactly when fds
+  // run out.  Paused = removed from the epoll set until the deadline.
+  int64_t accept_paused_until_ns = 0;
+  // Connections killed by the WRITE side (backpressure cap, writev
+  // failure) from the dispatch/feeder threads: a parked peer
+  // generates no epoll event, so the killer enqueues the fd here and
+  // kicks wake_fd; the owning reactor drops them next wake.
+  std::mutex dead_mu;
+  std::vector<int> dead_fds;
+  // The destructor owns epfd/wake_fd: a partial h2s_start failure
+  // (fd exhaustion on a later lane) or h2s_stop's delete both
+  // release them through ~Reactor — no separate close bookkeeping
+  // to miss.  listen_fd belongs to srv->listen_fds.
+  ~Reactor() {
+    if (epfd >= 0) ::close(epfd);
+    if (wake_fd >= 0) ::close(wake_fd);
+  }
+  // Owned connections, keyed by fd.  Reactor-thread-only: every
+  // insert/lookup/erase happens on the owning reactor, so the map
+  // needs no lock (cross-thread writers touch only Conn's mutex-
+  // guarded write side and arm EPOLLOUT via the thread-safe
+  // epoll_ctl).  Named `owned`, not `conns`: Server.conns is the
+  // mutex-guarded registry and the native pass matches receivers
+  // textually.
+  std::unordered_map<int, std::shared_ptr<Conn>> owned;
+  // Read-budget carryover: conns whose socket still held data when
+  // their per-wake budget ran out; re-drained before the next
+  // epoll_wait so edge-triggered reads never stall.
+  std::vector<std::shared_ptr<Conn>> pending;
+  int64_t last_sweep_ns = 0;
+};
+
+void notify_conn_dead(Conn* c) {
+  Reactor* rx = c->rx;
+  if (rx == nullptr) return;
+  {
+    std::lock_guard<std::mutex> lock(rx->dead_mu);
+    rx->dead_fds.push_back(c->fd);
+  }
+  uint64_t one = 1;
+  const ssize_t r = ::write(rx->wake_fd, &one, sizeof(one));
+  (void)r;
+}
+
+void reactor_drop(Server* srv, Reactor* rx, int fd) {
+  auto it = rx->owned.find(fd);
+  if (it == rx->owned.end()) return;
+  it->second->dead.store(true);
+  epoll_ctl(rx->epfd, EPOLL_CTL_DEL, fd, nullptr);
+  // shutdown (not close): the fd must stay allocated until the last
+  // shared_ptr drops — the dispatch/feeder threads may still hold
+  // this conn, and a recycled fd number under a late EPOLLOUT arm
+  // would hit a stranger's socket.  ~Conn closes it.
+  ::shutdown(fd, SHUT_RDWR);
+  rx->owned.erase(it);
+  srv->conns_open.fetch_sub(1);
+}
+
+// Accept every pending connection on this reactor's lane (edge-
+// triggered listen fd: drain until EAGAIN).  Sockets are born
+// nonblocking (SOCK_NONBLOCK) — the reactor never blocks in recv/
+// send/writev on them.
+void reactor_accept(Server* srv, Reactor* rx) {
+  for (;;) {
+    int fd = ::accept4(rx->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (fd < 0) {
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      if (errno == EMFILE || errno == ENFILE || errno == ENOBUFS ||
+          errno == ENOMEM) {
+        // fd exhaustion: the pending connection was NOT consumed and
+        // the listen fd is level-triggered, so leaving it in the
+        // epoll set would re-fire every wake and busy-spin this
+        // reactor at exactly the moment the box is out of fds.
+        // Pause: deregister and retry after a beat.
+        epoll_ctl(rx->epfd, EPOLL_CTL_DEL, rx->listen_fd, nullptr);
+        rx->accept_paused_until_ns = evr_now_ns() + 100000000;
+      }
+      return;  // EAGAIN (drained) or closing
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_shared<Conn>(fd);
+    conn->epfd = rx->epfd;
+    conn->rx = rx;
+    conn->last_activity_ns.store(evr_now_ns());
+    // Small initial parse buffer: C100K idle connections must not
+    // cost 64KB each (the threaded plane's sizing); it grows on
+    // demand and shrinks when drained.
+    conn->rs.buf.resize(4096);
+    {
+      std::lock_guard<std::mutex> lock(srv->conns_mu);
+      // Prune only when the registry has clearly outgrown the live
+      // set — a per-accept full prune is O(conns) and would make a
+      // 10k-connection ramp quadratic.
+      if (srv->conns.size() >
+          static_cast<size_t>(srv->conns_open.load()) * 2 + 64) {
+        srv->conns.erase(
+            std::remove_if(srv->conns.begin(), srv->conns.end(),
+                           [](const std::weak_ptr<Conn>& w) {
+                             return w.expired();
+                           }),
+            srv->conns.end());
+      }
+      srv->conns.push_back(conn);
+    }
+    srv->conns_open.fetch_add(1);
+    rx->owned[fd] = conn;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLET | EPOLLRDHUP;
+    ev.data.fd = fd;
+    if (epoll_ctl(rx->epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+      reactor_drop(srv, rx, fd);
+      continue;
+    }
+    conn->send_all(initial_settings());
+  }
+}
+
+// Budgeted edge-triggered read drain: pull bytes until EAGAIN or the
+// per-wake budget is spent, running the frame machine after every
+// chunk so responses start before the drain finishes.  A budget-
+// exhausted conn goes on the carryover list — the reactor services
+// its lane mates first, then returns, so a firehose cannot starve
+// the lane (or, transitively, the serve plane).
+void reactor_read(Server* srv, Reactor* rx,
+                  const std::shared_ptr<Conn>& conn) {
+  ReadState& rs = conn->rs;
+  void* ring = srv->ring.load();
+  const int64_t t0 = ring ? evr_now_ns() : 0;
+  size_t budget = kReadBudget;
+  int64_t got = 0;
+  bool more = false;
+  while (!conn->dead.load()) {
+    if (rs.len == rs.buf.size())
+      rs.buf.resize(std::max<size_t>(4096, rs.buf.size() * 2));
+    const ssize_t r = ::recv(conn->fd, rs.buf.data() + rs.len,
+                             rs.buf.size() - rs.len, MSG_DONTWAIT);
+    if (r > 0) {
+      rs.len += static_cast<size_t>(r);
+      got += r;
+      process_input(srv, conn);
+      if (budget <= static_cast<size_t>(r)) {
+        more = true;  // budget spent; resume after lane mates
+        break;
+      }
+      budget -= static_cast<size_t>(r);
+      continue;
+    }
+    if (r == 0) {
+      conn->dead.store(true);
+    } else if (errno != EAGAIN && errno != EWOULDBLOCK &&
+               errno != EINTR) {
+      conn->dead.store(true);
+    }
+    break;  // EAGAIN: drained
+  }
+  if (got > 0) {
+    conn->last_activity_ns.store(evr_now_ns());
+    if (ring) {
+      const int64_t t1 = evr_now_ns();
+      evr_record(ring, kEvReactorRead, t1, t1 - t0, got);
+    }
+    // Shrink a drained burst buffer: idle connections must not pin
+    // the high-water mark.
+    if (rs.len == 0 && rs.buf.size() > (64u << 10)) {
+      rs.buf.resize(4096);
+      rs.buf.shrink_to_fit();
+    }
+  }
+  if (more && !conn->dead.load()) rx->pending.push_back(conn);
+}
+
+// EPOLLOUT: resume the writev flush a short write parked, then let
+// flow control queue whatever the freed socket room now admits.
+// Recorded as the reactor.write stage (items = bytes moved this
+// resumption) — the backpressure path, not the common inline flush.
+void reactor_flush(Server* srv, const std::shared_ptr<Conn>& conn) {
+  void* ring = srv->ring.load();
+  const int64_t t0 = ring ? evr_now_ns() : 0;
+  int64_t moved = 0;
+  {
+    std::lock_guard<std::mutex> lock(conn->write_mu);
+    const size_t before = conn->outq_bytes;
+    if (conn->flush_out_locked()) conn->pump_locked();
+    moved = static_cast<int64_t>(before) -
+            static_cast<int64_t>(conn->outq_bytes);
+  }
+  if (ring) {
+    const int64_t t1 = evr_now_ns();
+    evr_record(ring, kEvReactorWrite, t1, t1 - t0, moved);
+  }
+}
+
+// Idle reaping: connections silent past idle_timeout_ms get a GOAWAY
+// and the axe.  The pre-§26 front held dead client connections
+// forever (nothing ever read EOF on a silent socket); at C100K that
+// is a slow fd leak.
+void reactor_sweep_idle(Server* srv, Reactor* rx, int64_t now_ns) {
+  const int64_t cutoff = now_ns - srv->idle_timeout_ms * 1000000;
+  std::vector<int> doomed;
+  for (auto& kv : rx->owned)
+    if (kv.second->last_activity_ns.load() < cutoff)
+      doomed.push_back(kv.first);
+  for (int fd : doomed) {
+    auto it = rx->owned.find(fd);
+    if (it == rx->owned.end()) continue;
+    std::string g;
+    frame_header(g, 8, kGoaway, 0, 0);
+    g.append(8, '\0');  // last-stream-id 0, NO_ERROR
+    it->second->send_all(g);
+    reactor_drop(srv, rx, fd);
+    srv->idle_reaped.fetch_add(1);
+  }
+}
+
+// The reactor loop: one epoll owns this lane's listen fd plus every
+// connection accepted from it.  Everything the threaded plane did per
+// connection — deframe, native-plane probe, feeder pack, byte-window
+// queue, response framing — runs here through the same shared frame
+// machine, across ALL the lane's connections, in one thread.
+// guberlint: gil-free
+// guberlint: epoll-root
+void reactor_loop(Server* srv, Reactor* rx) {
+  epoll_event evs[256];
+  while (!srv->closing.load()) {
+    // Carryover work pending ⇒ poll without sleeping; otherwise park
+    // briefly (bounded so `closing` and the idle sweep stay live).
+    const int timeout_ms = rx->pending.empty() ? 200 : 0;
+    const int n = epoll_wait(rx->epfd, evs, 256, timeout_ms);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    void* ring = srv->ring.load();
+    const int64_t t0 = ring ? evr_now_ns() : 0;
+    for (int i = 0; i < n; ++i) {
+      const int fd = evs[i].data.fd;
+      if (fd == rx->listen_fd) {
+        reactor_accept(srv, rx);
+        continue;
+      }
+      if (fd == rx->wake_fd) {
+        uint64_t junk;
+        const ssize_t r = ::read(rx->wake_fd, &junk, sizeof(junk));
+        (void)r;
+        continue;
+      }
+      auto it = rx->owned.find(fd);
+      if (it == rx->owned.end()) continue;  // dropped earlier this wake
+      std::shared_ptr<Conn> conn = it->second;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) conn->dead.store(true);
+      if (!conn->dead.load() && (evs[i].events & EPOLLOUT))
+        reactor_flush(srv, conn);
+      if (!conn->dead.load() &&
+          (evs[i].events & (EPOLLIN | EPOLLRDHUP)))
+        reactor_read(srv, rx, conn);
+      if (conn->dead.load()) reactor_drop(srv, rx, fd);
+    }
+    if (!rx->pending.empty()) {
+      std::vector<std::shared_ptr<Conn>> again;
+      again.swap(rx->pending);
+      for (auto& conn : again) {
+        if (!conn->dead.load()) reactor_read(srv, rx, conn);
+        if (conn->dead.load()) reactor_drop(srv, rx, conn->fd);
+      }
+    }
+    {
+      // Write-side deaths (backpressure cap / writev failure from
+      // the dispatch or feeder threads): a parked peer fires no
+      // epoll event, so the killers queue the fd and kick wake_fd.
+      std::vector<int> doomed;
+      {
+        std::lock_guard<std::mutex> lock(rx->dead_mu);
+        doomed.swap(rx->dead_fds);
+      }
+      for (int fd : doomed) reactor_drop(srv, rx, fd);
+    }
+    const int64_t now_ns = evr_now_ns();
+    if (rx->accept_paused_until_ns != 0 &&
+        now_ns >= rx->accept_paused_until_ns) {
+      rx->accept_paused_until_ns = 0;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = rx->listen_fd;
+      epoll_ctl(rx->epfd, EPOLL_CTL_ADD, rx->listen_fd, &ev);
+      reactor_accept(srv, rx);  // drain whatever queued while paused
+    }
+    if (srv->idle_timeout_ms > 0 &&
+        now_ns - rx->last_sweep_ns >
+            std::min<int64_t>(srv->idle_timeout_ms * 250000,
+                              1000000000)) {
+      rx->last_sweep_ns = now_ns;
+      reactor_sweep_idle(srv, rx, now_ns);
+    }
+    if (ring && n > 0) {
+      const int64_t t1 = evr_now_ns();
+      evr_record(ring, kEvReactorWake, t1, t1 - t0, n);
+    }
+  }
+  // Teardown: this thread owns every conn it accepted — drop them
+  // all before joining (no detached-thread drain needed on this
+  // plane).
+  std::vector<int> fds;
+  fds.reserve(rx->owned.size());
+  for (auto& kv : rx->owned) fds.push_back(kv.first);
+  for (int fd : fds) reactor_drop(srv, rx, fd);
+}
+
 }  // namespace
 
 extern "C" {
 
-// Start the front on 127.0.0.1:port (0 = ephemeral) with `lanes`
-// SO_REUSEPORT listener lanes (degrades to fewer if a lane fails to
-// bind; at least one always exists).  Returns an opaque handle, or
-// nullptr on bind failure.
+// Start the front on 127.0.0.1:port (0 = ephemeral).
+//
+// event_front != 0 (the default plane, PERF.md §26): `reactors`
+// epoll reactor threads (0 = ncpu−1, min 1), one per SO_REUSEPORT
+// listener lane, own all connection fds; `lanes` is ignored (lanes ≡
+// reactors there).  idle_timeout_ms > 0 reaps connections silent
+// that long (GOAWAY + close).  When ncpu > 1 the reactor threads are
+// pinned off cpu0 (best-effort) so the serve/dispatch plane keeps a
+// reserved core — the §25 starvation fix.
+//
+// event_front == 0: the thread-per-connection plane with `lanes`
+// SO_REUSEPORT accept lanes (degrades to fewer if a lane fails to
+// bind; at least one always exists).
+//
+// Returns an opaque handle, or nullptr on bind failure.
 void* h2s_start(int32_t port, int64_t window_us, int64_t max_batch,
-                int64_t flush_items, int32_t lanes,
+                int64_t flush_items, int32_t lanes, int32_t event_front,
+                int32_t reactors, int64_t idle_timeout_ms,
                 WindowCallback callback) {
   auto* srv = new Server();
   srv->callback = callback;
   srv->window_us = window_us;
   srv->max_batch = max_batch;
   if (flush_items > 0) srv->flush_items = flush_items;
+  srv->event_front = event_front != 0;
+  if (idle_timeout_ms > 0) srv->idle_timeout_ms = idle_timeout_ms;
+  const long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+  if (srv->event_front) {
+    if (reactors <= 0)
+      reactors = static_cast<int32_t>(std::max(1L, ncpu - 1));
+    lanes = reactors;
+  }
   if (lanes < 1) lanes = 1;
   int bind_port = port;
   if (lanes > 1 && port != 0) {
@@ -998,7 +1529,7 @@ void* h2s_start(int32_t port, int64_t window_us, int64_t max_batch,
     addr.sin_port = htons(static_cast<uint16_t>(bind_port));
     inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
     if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
-        ::listen(fd, 128) != 0) {
+        ::listen(fd, 1024) != 0) {
       ::close(fd);
       break;
     }
@@ -1016,8 +1547,50 @@ void* h2s_start(int32_t port, int64_t window_us, int64_t max_batch,
     delete srv;
     return nullptr;
   }
-  for (int fd : srv->listen_fds)
-    srv->accept_threads.emplace_back(accept_loop, srv, fd);
+  if (srv->event_front) {
+    for (int fd : srv->listen_fds) {
+      // The reactors accept-until-EAGAIN; the listen fds must be
+      // nonblocking or a spurious wake parks the whole lane.
+      const int fl = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+      auto rx = std::make_unique<Reactor>();
+      rx->listen_fd = fd;
+      rx->epfd = epoll_create1(0);
+      rx->wake_fd = eventfd(0, EFD_NONBLOCK);
+      if (rx->epfd < 0 || rx->wake_fd < 0) {
+        // ~Reactor releases rx's and every earlier lane's epfd/
+        // wake_fd (delete srv destroys srv->reactors).
+        for (int lf : srv->listen_fds) ::close(lf);
+        delete srv;
+        return nullptr;
+      }
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(rx->epfd, EPOLL_CTL_ADD, fd, &ev);
+      ev.events = EPOLLIN;
+      ev.data.fd = rx->wake_fd;
+      epoll_ctl(rx->epfd, EPOLL_CTL_ADD, rx->wake_fd, &ev);
+      srv->reactors.push_back(std::move(rx));
+    }
+    for (auto& rx : srv->reactors)
+      srv->reactor_threads.emplace_back(reactor_loop, srv, rx.get());
+    if (ncpu > 1 &&
+        static_cast<long>(srv->reactor_threads.size()) <= ncpu - 1) {
+      // Reserved serve core (best-effort — gVisor/containers may
+      // refuse affinity): reactors live on cpus 1..n−1, leaving cpu0
+      // for the dispatch/Python serve plane so conn-side load cannot
+      // starve the window path (the §25 tail).
+      cpu_set_t set;
+      CPU_ZERO(&set);
+      for (long c = 1; c < ncpu; ++c) CPU_SET(c, &set);
+      for (auto& t : srv->reactor_threads)
+        pthread_setaffinity_np(t.native_handle(), sizeof(set), &set);
+    }
+  } else {
+    for (int fd : srv->listen_fds)
+      srv->accept_threads.emplace_back(accept_loop, srv, fd);
+  }
   srv->dispatch_thread = std::thread(dispatch_loop, srv);
   return srv;
 }
@@ -1092,22 +1665,33 @@ int32_t h2s_lanes(void* handle) {
       static_cast<Server*>(handle)->listen_fds.size());
 }
 
+int32_t h2s_reactors(void* handle) {
+  return static_cast<int32_t>(
+      static_cast<Server*>(handle)->reactors.size());
+}
+
 int32_t h2s_port(void* handle) {
   return static_cast<Server*>(handle)->port;
 }
 
-// out7: rpcs, windows, errors, native_rpcs, native_items,
-// feeder_rpcs, feeder_items (callers may pass a larger zeroed buffer;
-// only the first seven slots are written).
-void h2s_stats(void* handle, int64_t* out7) {
+// out: [0] rpcs, [1] windows, [2] errors, [3] native_rpcs,
+// [4] native_items, [5] feeder_rpcs, [6] feeder_items,
+// [7] conns_open, [8] idle_reaped, [9] reactors, [10] event_front
+// (callers may pass a larger zeroed buffer; only the first eleven
+// slots are written).
+void h2s_stats(void* handle, int64_t* out) {
   auto* srv = static_cast<Server*>(handle);
-  out7[0] = srv->rpcs.load();
-  out7[1] = srv->windows.load();
-  out7[2] = srv->errors.load();
-  out7[3] = srv->native_rpcs.load();
-  out7[4] = srv->native_items.load();
-  out7[5] = srv->feeder_rpcs.load();
-  out7[6] = srv->feeder_items.load();
+  out[0] = srv->rpcs.load();
+  out[1] = srv->windows.load();
+  out[2] = srv->errors.load();
+  out[3] = srv->native_rpcs.load();
+  out[4] = srv->native_items.load();
+  out[5] = srv->feeder_rpcs.load();
+  out[6] = srv->feeder_items.load();
+  out[7] = srv->conns_open.load();
+  out[8] = srv->idle_reaped.load();
+  out[9] = static_cast<int64_t>(srv->reactors.size());
+  out[10] = srv->event_front ? 1 : 0;
 }
 
 void h2s_stop(void* handle) {
@@ -1120,6 +1704,16 @@ void h2s_stop(void* handle) {
     ::shutdown(fd, SHUT_RDWR);
     ::close(fd);
   }
+  // Kick parked reactors; each drops its owned conns on loop exit and
+  // its thread is joinable — the event plane needs no detached-thread
+  // drain.
+  for (auto& rx : srv->reactors) {
+    uint64_t one = 1;
+    const ssize_t r = ::write(rx->wake_fd, &one, sizeof(one));
+    (void)r;
+  }
+  for (auto& t : srv->reactor_threads)
+    if (t.joinable()) t.join();
   {
     std::lock_guard<std::mutex> lock(srv->q_mu);
     srv->q_cv.notify_all();
@@ -1128,8 +1722,8 @@ void h2s_stop(void* handle) {
     if (t.joinable()) t.join();
   if (srv->dispatch_thread.joinable()) srv->dispatch_thread.join();
   {
-    // Conn threads block in recv(); shut their sockets down, then
-    // wait (bounded) for the detached threads to drain.
+    // Threaded-plane conn threads block in recv(); shut their sockets
+    // down, then wait (bounded) for the detached threads to drain.
     std::unique_lock<std::mutex> lock(srv->conns_mu);
     for (auto& w : srv->conns)
       if (auto c = w.lock()) {

@@ -306,6 +306,9 @@ class Daemon:
                 native_ledger=conf.native_ledger,
             )
             self.h2_fast_address = self.h2_fast.address
+            # Connection-plane gauge source (gubernator_h2_conns):
+            # the collector scrapes conn_stats() off the instance.
+            self.instance.h2_front = self.h2_fast
             # Native event collector: drain the C front's event ring
             # into histograms/metrics/span stubs (utils/native_events;
             # GUBER_NATIVE_EVENTS=0 disables the ring entirely).

@@ -28,10 +28,19 @@ over-limit answers and credit-lease drains — delegated into a C table
 (core/native/decision_plane.cpp) probed inside the connection threads,
 so hot-key RPCs complete with zero GIL acquisitions and zero Python
 frames; only cold/fall-through traffic enters the per-window Python
-path.  GUBER_H2_LANES (default: CPU count) shards the listener across
-SO_REUSEPORT accept lanes.  The plane anchors to CLOCK_REALTIME, so it
-only attaches when the engine runs on the live SYSTEM_CLOCK (frozen
-test clocks keep the Python-only ledger).
+path.  The plane anchors to CLOCK_REALTIME, so it only attaches when
+the engine runs on the live SYSTEM_CLOCK (frozen test clocks keep the
+Python-only ledger).
+
+Event front (GUBER_H2_EVENT_FRONT, default on; PERF.md §26): the C
+side multiplexes ALL connections over a small pool of epoll reactor
+threads (GUBER_H2_REACTORS, default ncpu−1 — one core stays reserved
+for the Python serve plane) instead of one detached thread per
+connection, with writev-batched egress and idle-connection reaping
+(GUBER_H2_IDLE_TIMEOUT; GOAWAY + close).  GUBER_H2_EVENT_FRONT=0
+restores the thread-per-connection plane, where GUBER_H2_LANES
+(default: CPU count) shards the listener across SO_REUSEPORT accept
+lanes.
 """
 
 from __future__ import annotations
@@ -39,6 +48,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -73,12 +83,15 @@ def load() -> Optional[ctypes.CDLL]:
     lib.h2s_start.restype = ctypes.c_void_p
     lib.h2s_start.argtypes = [
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_int32, _CALLBACK,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        _CALLBACK,
     ]
     lib.h2s_port.restype = ctypes.c_int32
     lib.h2s_port.argtypes = [ctypes.c_void_p]
     lib.h2s_lanes.restype = ctypes.c_int32
     lib.h2s_lanes.argtypes = [ctypes.c_void_p]
+    lib.h2s_reactors.restype = ctypes.c_int32
+    lib.h2s_reactors.argtypes = [ctypes.c_void_p]
     lib.h2s_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_attach_plane.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.h2s_attach_ring.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
@@ -132,6 +145,47 @@ def default_lanes() -> int:
     if n > 0:
         return n
     return max(1, os.cpu_count() or 1)
+
+
+def event_front_enabled() -> bool:
+    """GUBER_H2_EVENT_FRONT (default on): epoll reactor connection
+    multiplexing instead of thread-per-connection (PERF.md §26)."""
+    return os.environ.get("GUBER_H2_EVENT_FRONT", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+def default_reactors() -> int:
+    """GUBER_H2_REACTORS: epoll reactor threads for the event front.
+    0 (default) = auto, resolved by the C side to ncpu−1 (min 1) so
+    one core stays reserved for the serve/dispatch plane — the §25
+    starvation fix."""
+    v = os.environ.get("GUBER_H2_REACTORS", "").strip()
+    try:
+        n = int(v) if v else 0
+    except ValueError:
+        log.warning("GUBER_H2_REACTORS=%r not an integer; using auto", v)
+        n = 0
+    return max(0, n)
+
+
+def idle_timeout_ms() -> int:
+    """GUBER_H2_IDLE_TIMEOUT (event front): reap connections silent
+    this long (GOAWAY + close; Go-style duration or float seconds).
+    Default 300s; 0 disables — the threaded front (and the pre-§26
+    event front) held dead client connections forever."""
+    raw = os.environ.get("GUBER_H2_IDLE_TIMEOUT", "").strip()
+    if not raw:
+        return 300_000
+    try:
+        from gubernator_tpu.config import parse_duration
+
+        return max(0, int(parse_duration(raw) * 1000))
+    except ValueError:
+        log.warning(
+            "GUBER_H2_IDLE_TIMEOUT=%r is not a duration; using 300s", raw
+        )
+        return 300_000
 
 
 def native_ledger_enabled() -> bool:
@@ -194,17 +248,34 @@ class H2FastFront:
         lanes: Optional[int] = None,
         native_ledger: Optional[bool] = None,
         native_feeder: Optional[bool] = None,
+        event_front: Optional[bool] = None,
+        reactors: Optional[int] = None,
+        idle_timeout_s: Optional[float] = None,
     ):
         lib = load()
         if lib is None:
             raise RuntimeError("native h2 server unavailable")
         self._lib = lib
         self.instance = instance
+        # Serializes conn_stats() (the metrics collector's scrape
+        # thread) against close(): the handle must not be freed while
+        # an FFI stats call is in flight.
+        self._teardown_mu = threading.Lock()
+        if event_front is None:
+            event_front = event_front_enabled()
+        if reactors is None:
+            reactors = default_reactors()
+        idle_ms = (
+            idle_timeout_ms()
+            if idle_timeout_s is None
+            else max(0, int(idle_timeout_s * 1000))
+        )
         # The ctypes callback object must outlive the server.
         self._cb = _CALLBACK(self._window)
         self._handle = lib.h2s_start(
             port, int(window_s * 1e6), max_batch, flush_items,
             default_lanes() if lanes is None else max(1, int(lanes)),
+            1 if event_front else 0, int(reactors), idle_ms,
             self._cb,
         )
         if not self._handle:
@@ -212,6 +283,8 @@ class H2FastFront:
         self.port = int(lib.h2s_port(self._handle))
         self.address = f"127.0.0.1:{self.port}"
         self.lanes = int(lib.h2s_lanes(self._handle))
+        self.reactors = int(lib.h2s_reactors(self._handle))
+        self.event_front = bool(event_front)
         self.plane = None
         self._attach_plane(native_ledger)
         # Columnar feeder plane (core/native/columnar_feeder.cpp):
@@ -539,11 +612,35 @@ class H2FastFront:
 
     # -- lifecycle ------------------------------------------------------
 
+    def conn_stats(self) -> dict:
+        """The connection-plane slice alone (cheap: one FFI call) —
+        the gubernator_h2_conns gauge scrapes this per collect.
+        Serialized against close() by _teardown_mu: a bare truthiness
+        check would be check-then-use (the argument re-read could see
+        None → NULL deref in C, or a captured handle could be freed
+        mid-call)."""
+        out = np.zeros(16, dtype=np.int64)
+        with self._teardown_mu:
+            handle = self._handle
+            if handle:
+                self._lib.h2s_stats(
+                    handle, out.ctypes.data_as(ctypes.c_void_p)
+                )
+        return {
+            "conns_open": int(out[7]),
+            "conns_idle_reaped": int(out[8]),
+            "reactors": int(out[9]),
+            "event_front": bool(out[10]),
+        }
+
     def stats(self) -> dict:
-        out = np.zeros(8, dtype=np.int64)
-        self._lib.h2s_stats(
-            self._handle, out.ctypes.data_as(ctypes.c_void_p)
-        )
+        out = np.zeros(16, dtype=np.int64)
+        with self._teardown_mu:
+            handle = self._handle
+            if handle:
+                self._lib.h2s_stats(
+                    handle, out.ctypes.data_as(ctypes.c_void_p)
+                )
         stats = {
             "rpcs": int(out[0]),
             "windows": int(out[1]),
@@ -552,6 +649,10 @@ class H2FastFront:
             "native_items": int(out[4]),
             "feeder_front_rpcs": int(out[5]),
             "feeder_front_items": int(out[6]),
+            "conns_open": int(out[7]),
+            "conns_idle_reaped": int(out[8]),
+            "reactors": int(out[9]),
+            "event_front": bool(out[10]),
             "lanes": self.lanes,
         }
         if self.plane is not None:
@@ -562,12 +663,20 @@ class H2FastFront:
 
     def close(self) -> None:
         if self._handle:
+            # Null the public handle under _teardown_mu: the metrics
+            # collector's conn_stats() can race this teardown from the
+            # gateway thread, and h2s_stats on a freed server is a
+            # native use-after-free.  After this block any scrape sees
+            # None and reports zeros; an in-flight one finished before
+            # the handle is stopped/freed below.
+            with self._teardown_mu:
+                handle, self._handle = self._handle, None
             if self.plane is not None:
                 # Detach before stop: conn threads re-read the plane
                 # pointer per RPC, so no new native serves start; stop
                 # then joins/drains them before the ledger pulls its
                 # credit back and the table is freed.
-                self._lib.h2s_attach_plane(self._handle, None)
+                self._lib.h2s_attach_plane(handle, None)
             if self.feeder is not None:
                 # Feeder teardown is drain-then-close: detach (conn
                 # threads stop packing at the next RPC), stop (the
@@ -575,14 +684,13 @@ class H2FastFront:
                 # RPCs answer UNAVAILABLE through still-live conns —
                 # then joins), and free only after h2s_stop below has
                 # also joined the conn threads.
-                self._lib.h2s_attach_feeder(self._handle, None)
+                self._lib.h2s_attach_feeder(handle, None)
                 self.feeder.stop()
             if self._ring is not None:
                 # Same contract as the plane: detach first, free only
                 # after h2s_stop joined/drained the writer threads.
-                self._lib.h2s_attach_ring(self._handle, None)
-            self._lib.h2s_stop(self._handle)
-            self._handle = None
+                self._lib.h2s_attach_ring(handle, None)
+            self._lib.h2s_stop(handle)
             if self.plane is not None:
                 ledger = getattr(self.instance, "ledger", None)
                 if ledger is not None:

@@ -550,6 +550,23 @@ class InstanceCollector(Collector):
                 )
             yield s
 
+        # Connection plane of the native h2 front (h2_server.cpp):
+        # open connections and the idle reaper's cumulative kills —
+        # the C100K surface the event front exists for (PERF.md §26).
+        front = getattr(inst, "h2_front", None)
+        if front is not None:
+            cs = front.conn_stats()
+            g = GaugeMetricFamily(
+                "gubernator_h2_conns",
+                "Native h2 front connections by state: open = currently "
+                "held fds; idle_reaped = cumulative idle-timeout kills "
+                "(GUBER_H2_IDLE_TIMEOUT; GOAWAY + close).",
+                labels=["state"],
+            )
+            g.add_metric(["open"], float(cs["conns_open"]))
+            g.add_metric(["idle_reaped"], float(cs["conns_idle_reaped"]))
+            yield g
+
         # Hot-key attribution (utils/hotkeys.py space-saving sketch):
         # the top-K decision keys by estimated hit count, so load and
         # the p99 tail can be attributed to specific keys

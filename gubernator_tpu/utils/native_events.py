@@ -48,6 +48,14 @@ STAGES = {
     4: "feeder_pack",
     5: "feeder_ring_wait",
     6: "feeder_serve",
+    # Event front (PERF.md §26): one epoll wake's processing wall
+    # (items = ready events), one connection's budgeted read drain
+    # (items = bytes), and one EPOLLOUT writev resumption (items =
+    # bytes moved) — the egress backpressure path, not the common
+    # inline flush.
+    7: "reactor_wake",
+    8: "reactor_read",
+    9: "reactor_write",
 }
 
 # Span stubs recorded per drain tick, bounded: under a 9k/s native

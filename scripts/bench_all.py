@@ -135,6 +135,16 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "1",
         "BENCH_CAPACITY": str(1 << 17),
     },
+    # Connection scale through the epoll event front (PERF.md §26):
+    # 1k→10k held connections from the epoll connscale client, with
+    # the thread-per-conn A/B at equal load and the feeder-ring-wait
+    # starvation attribution per rung.  CPU-tier config (the front is
+    # host-side; no device involvement beyond the serve plane).
+    "connscale": {
+        "BENCH_MODE": "connscale",
+        "BENCH_KEYS": "1",
+        "BENCH_CAPACITY": str(1 << 17),
+    },
     # Throughput-optimal operating point: batch 32768 amortizes the
     # tunneled backend's per-RPC fixed costs 4x deeper than the
     # default-config batch 8192 (PERF.md §9 transport arithmetic).

@@ -125,6 +125,29 @@ def _extract(data: dict) -> dict | None:
             ):
                 if ab.get(k) is not None:
                     out[k] = ab[k]
+    # Connection-scale artifacts (connscale mode): fold the conns
+    # held, the reactor-front stage attribution (feeder ring wait p99
+    # under client load — the §26 starvation acceptance), and the
+    # event-vs-threaded equal-load delta with its fd footprint.
+    if data.get("conns_held") is not None:
+        out["conns_held"] = data["conns_held"]
+        if data.get("ring_wait_p99_ms_top") is not None:
+            out["ring_wait_p99_ms"] = data["ring_wait_p99_ms_top"]
+        if data.get("errors") is not None:
+            out["errors"] = data["errors"]
+        ab = data.get("ab_equal_load")
+        if isinstance(ab, dict):
+            if ab.get("event_delta_pct") is not None:
+                out["event_delta_pct"] = ab["event_delta_pct"]
+            if ab.get("threaded_rate") is not None:
+                out["threaded_rate"] = ab["threaded_rate"]
+        rungs = data.get("rungs")
+        if isinstance(rungs, list) and rungs:
+            top = rungs[-1]
+            if top.get("server_fd_peak") is not None:
+                out["server_fd_peak"] = top["server_fd_peak"]
+            if top.get("reactors") is not None:
+                out["reactors"] = top["reactors"]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its

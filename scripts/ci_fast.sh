@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly six things:
+# Runs exactly seven things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -22,18 +22,23 @@
 #      columnar decode for a multi-RPC window, plus the ring window
 #      lifecycle and drain-then-close teardown — jax-free, 30 s wall
 #      budget (cold .so rebuild included);
-#   4. the fused-kernel parity tier (tests/test_fused_parity.py,
+#   4. the event-front smoke (scripts/event_front_smoke.py): a few
+#      hundred concurrent connections through the epoll reactor plane
+#      from the connscale client — zero errors, reactor stages in the
+#      event ring, and a non-starved feeder ring wait — jax-free, 30 s
+#      wall budget (PERF.md section 26);
+#   5. the fused-kernel parity tier (tests/test_fused_parity.py,
 #      GUBER_FUSED=interpret, jax CPU only, 120 s wall budget): the
 #      Pallas decision kernel bit-equal to models/spec.py + the
 #      single-dispatch-per-batch invariant — the kernel stays
 #      CI-enforced without TPU hardware (PERF.md section 24);
-#   5. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   6. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants; the
 #      multi-cycle soaks are @slow);
-#   6. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#   7. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -92,6 +97,23 @@ if [ "${FEED_MS}" -gt 30000 ]; then
   echo "feeder smoke blew its 30 s budget — it must stay jax-free and" >&2
   echo "cheap enough to gate every native edit (a cold .so rebuild is" >&2
   echo "the only legitimate slow path)" >&2
+  exit 1
+fi
+
+echo "=== event-front smoke (epoll reactor plane, C10K canary) ===" >&2
+EVF_T0=$(date +%s%N)
+if ! timeout -k 10 60 python scripts/event_front_smoke.py; then
+  echo "event-front smoke: the reactor plane dropped RPCs, starved the" >&2
+  echo "serve thread (feeder ring wait p99 over the bar), or broke its" >&2
+  echo "teardown contract (scripts/event_front_smoke.py; PERF.md section 26)" >&2
+  exit 1
+fi
+EVF_MS=$(( ($(date +%s%N) - EVF_T0) / 1000000 ))
+echo "event-front smoke: ${EVF_MS} ms (budget 30000 ms)" >&2
+if [ "${EVF_MS}" -gt 30000 ]; then
+  echo "event-front smoke blew its 30 s budget — it must stay jax-free" >&2
+  echo "and cheap enough to gate every native edit (a cold .so rebuild" >&2
+  echo "is the only legitimate slow path)" >&2
   exit 1
 fi
 

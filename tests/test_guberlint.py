@@ -921,6 +921,76 @@ def test_native_pass_atomic_order_needs_reason(tmp_path):
     assert nativecheck.check_files([_csrc(tmp_path, ok, "ok.cpp")]) == []
 
 
+def test_native_pass_blocking_in_reactor(tmp_path):
+    """The epoll-root reachability rule: send/recv without
+    MSG_DONTWAIT and accept without SOCK_NONBLOCK flag anywhere in
+    the call graph under an epoll loop root — directly or
+    transitively."""
+    from tools.guberlint import nativecheck
+
+    code = """
+        #include <sys/socket.h>
+
+        void drain(int fd) {
+          char buf[64];
+          recv(fd, buf, sizeof(buf), 0);
+        }
+
+        // guberlint: epoll-root
+        void loop(int epfd, int lfd) {
+          int c = accept(lfd, nullptr, nullptr);
+          (void)c;
+          drain(lfd);
+        }
+    """
+    findings = nativecheck.check_files([_csrc(tmp_path, code)])
+    assert sorted(f.rule for f in findings) == [
+        "blocking-in-reactor", "blocking-in-reactor",
+    ]
+    details = sorted(f.detail for f in findings)
+    assert details == ["loop->accept", "loop->recv"]
+    assert all(f.scope == "loop" for f in findings)
+    # The transitive finding names the path and the real call site.
+    recv_f = [f for f in findings if f.detail == "loop->recv"][0]
+    assert "loop->drain" in recv_f.message
+
+
+def test_native_pass_reactor_nonblocking_and_suppression_ok(tmp_path):
+    """Nonblocking variants (MSG_DONTWAIT, accept4+SOCK_NONBLOCK) and
+    reasoned call-site suppressions pass; functions NOT under an
+    epoll root may block freely."""
+    from tools.guberlint import nativecheck
+
+    code = """
+        #include <sys/socket.h>
+
+        void drain(int fd) {
+          char buf[64];
+          recv(fd, buf, sizeof(buf), MSG_DONTWAIT);
+        }
+
+        void legacy_branch(int fd) {
+          char buf[64];
+          // guberlint: ok native — threaded-plane branch, runtime-gated off the reactor
+          send(fd, buf, sizeof(buf), 0);
+        }
+
+        // guberlint: epoll-root
+        void loop(int epfd, int lfd) {
+          int c = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+          (void)c;
+          drain(lfd);
+          legacy_branch(lfd);
+        }
+
+        void not_a_reactor(int fd) {
+          char buf[64];
+          recv(fd, buf, sizeof(buf), 0);  // blocking is fine here
+        }
+    """
+    assert nativecheck.check_files([_csrc(tmp_path, code)]) == []
+
+
 def test_native_pass_reasonless_c_suppression_is_a_finding(tmp_path):
     from tools.guberlint import nativecheck
 

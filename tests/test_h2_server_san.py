@@ -31,7 +31,7 @@ REPO = Path(__file__).resolve().parents[1]
 # run (fork from a TSan'd multithreaded process deadlocks), so the
 # unpreloaded pytest parent is the one that spawns the client hammer.
 _SERVER_SRC = r"""
-import ctypes, sys
+import ctypes, os, sys
 import numpy as np
 
 from gubernator_tpu.net import h2_fast
@@ -59,7 +59,11 @@ def window(buf, length, counts_ptr, lens_ptr, n_rpcs, total, out_ptr,
     return 0
 
 cb = h2_fast._CALLBACK(window)
-handle = lib.h2s_start(0, 500, 16384, 4096, 2, cb)  # 2 listener lanes
+# SAN_EVENT_FRONT=1: the epoll reactor plane (2 reactors racing the
+# dispatch/feeder threads through the shared Conn write side);
+# otherwise the thread-per-conn plane with 2 listener lanes.
+event = int(os.environ.get("SAN_EVENT_FRONT", "0"))
+handle = lib.h2s_start(0, 500, 16384, 4096, 2, event, 2, 0, cb)
 assert handle, "h2 server failed to bind"
 
 # Columnar feeder attached: the hammer's fall-through RPCs now run
@@ -87,7 +91,9 @@ print("PORT", int(lib.h2s_port(handle)), flush=True)
 sys.stdin.read()  # parent closes stdin when the hammer is done
 # Stats BEFORE stop: h2s_stop frees the server (TSan caught this
 # harness's original stats-after-stop as a heap-use-after-free).
-stats = np.zeros(8, dtype=np.int64)
+# 16 slots: h2s_stats writes eleven now (conn-plane fields) — an
+# 8-slot buffer here would be a 24-byte heap overflow.
+stats = np.zeros(16, dtype=np.int64)
 lib.h2s_stats(handle, stats.ctypes.data_as(ctypes.c_void_p))
 # Teardown order contract (net/h2_fast.close): detach, drain-stop the
 # feeder, stop the server, then free the ring.
@@ -147,7 +153,8 @@ print("client ok: %d rpcs" % (N_THREADS * N_RPCS))
 
 
 @pytest.mark.slow
-def test_h2_server_threaded_stress_under_tsan():
+@pytest.mark.parametrize("event_front", [0, 1], ids=["threaded", "reactor"])
+def test_h2_server_threaded_stress_under_tsan(event_front):
     if os.environ.get("GUBER_NATIVE_SAN", "") in ("", "0"):
         pytest.skip("set GUBER_NATIVE_SAN=1 to run the TSan stress")
     preload = sanitizer_preload("thread")
@@ -171,6 +178,7 @@ def test_h2_server_threaded_stress_under_tsan():
     supp = REPO / "tests" / "tsan_suppressions.txt"
     server_env = dict(
         env,
+        SAN_EVENT_FRONT=str(event_front),
         LD_PRELOAD=preload,
         TSAN_OPTIONS=(
             # Mutex-misuse reports are off: gcc-10's libtsan

@@ -70,6 +70,24 @@ NATIVE_BLOCKING_CALLS = (
 # CFUNCTYPE trampoline, so any call through it blocks on the GIL.
 NATIVE_GIL_CALLS = ("callback",)
 
+# Reactor discipline (nativecheck blocking-in-reactor): inside code
+# reachable from an `// guberlint: epoll-root` function, these socket
+# calls must carry the named nonblocking token in their argument list
+# — a reactor thread parked in a blocking syscall stalls EVERY
+# connection on its lane (h2_server.cpp reactor_loop owns thousands).
+# Plain accept() can never carry SOCK_NONBLOCK (it is accept4's
+# flag), so bare accept in a reactor always flags: use accept4.
+REACTOR_NONBLOCK_TOKENS = {
+    "send": "MSG_DONTWAIT",
+    "recv": "MSG_DONTWAIT",
+    "sendto": "MSG_DONTWAIT",
+    "recvfrom": "MSG_DONTWAIT",
+    "sendmsg": "MSG_DONTWAIT",
+    "recvmsg": "MSG_DONTWAIT",
+    "accept": "SOCK_NONBLOCK",
+    "accept4": "SOCK_NONBLOCK",
+}
+
 # ---------------------------------------------------------------------
 # Contract pass (tools/guberlint/contractcheck.py): the Python<->C
 # boundary, pinned bit-equal.

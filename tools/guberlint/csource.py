@@ -22,6 +22,12 @@ Annotation grammar mirrored from the Python side:
   API call and no GIL-acquiring trampoline (config.NATIVE_GIL_CALLS)
   may be reachable from it through functions defined in the scanned
   native sources.
+- ``// guberlint: epoll-root`` — on (or above) a function: it is an
+  event-loop body (epoll reactor); no blocking socket syscall —
+  ``send``/``recv`` without ``MSG_DONTWAIT``, ``accept`` without
+  ``SOCK_NONBLOCK`` (config.REACTOR_NONBLOCK_TOKENS) — may be
+  reachable from it: a reactor thread parked in the kernel stalls
+  every connection on its lane.
 - ``// guberlint: wire <Message> <field>=<num>:<kind> ...`` — on (or
   above) a codec function: declares the wire layout the body
   implements; the contract pass pins it against the .proto AND
@@ -59,6 +65,7 @@ _GUARD_STRUCT_RE = re.compile(
 )
 _HOLDS_RE = re.compile(r"//\s*guberlint:\s*holds\s+([\w.>-]+(?:\s*,\s*[\w.>-]+)*)")
 _GILFREE_RE = re.compile(r"//\s*guberlint:\s*gil-free\b")
+_EPOLLROOT_RE = re.compile(r"//\s*guberlint:\s*epoll-root\b")
 _WIRE_RE = re.compile(r"//\s*guberlint:\s*wire\s+(\w+)\s+(.*)$")
 _WIRE_FIELD_RE = re.compile(r"([A-Za-z_]\w*)=(\d+):(\w+)")
 
@@ -199,13 +206,21 @@ class CSourceFile:
         return out
 
     def gil_free(self, fn: CFunction) -> bool:
+        return self._annotated(fn, _GILFREE_RE)
+
+    def epoll_root(self, fn: CFunction) -> bool:
+        return self._annotated(fn, _EPOLLROOT_RE)
+
+    def _annotated(self, fn: CFunction, pattern) -> bool:
+        """True when `pattern` appears on the signature lines or the
+        contiguous // block above them."""
         lines = set(self._sig_lines(fn))
         ln = min(lines) - 1
         while ln >= 1 and self.line_text(ln).lstrip().startswith("//"):
             lines.add(ln)
             ln -= 1
         return any(
-            _GILFREE_RE.search(self.line_text(ln)) for ln in sorted(lines)
+            pattern.search(self.line_text(ln)) for ln in sorted(lines)
         )
 
     def wire_decls(self, fn: CFunction) -> List[Tuple[str, Dict[str, Tuple[int, str]], int]]:

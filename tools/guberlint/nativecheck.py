@@ -18,6 +18,14 @@ and limits):
   config.NATIVE_BLOCKING_CALLS (socket/sleep syscalls) while a mutex
   is lexically held: every thread contending that mutex convoys behind
   the kernel.  Designed exceptions carry reasoned suppressions.
+- ``native-blocking-in-reactor`` — a blocking socket syscall
+  (``send``/``recv`` without ``MSG_DONTWAIT``, ``accept`` without
+  ``SOCK_NONBLOCK`` — config.REACTOR_NONBLOCK_TOKENS) reachable
+  (transitively, through functions defined in the scanned sources)
+  from a function annotated ``// guberlint: epoll-root``: a reactor
+  thread parked in the kernel stalls every connection on its lane.
+  Suppressions live at the offending call site (e.g. the threaded-
+  plane branch a runtime guard keeps off the reactor path).
 - ``native-atomic-order`` — an explicit relaxed/acquire/release/
   acq_rel/consume memory order: each use must carry a reasoned
   suppression citing the happens-before argument it relies on (the
@@ -30,7 +38,11 @@ import re
 from typing import Dict, List, Set, Tuple
 
 from tools.guberlint.common import Finding
-from tools.guberlint.config import NATIVE_BLOCKING_CALLS, NATIVE_GIL_CALLS
+from tools.guberlint.config import (
+    NATIVE_BLOCKING_CALLS,
+    NATIVE_GIL_CALLS,
+    REACTOR_NONBLOCK_TOKENS,
+)
 from tools.guberlint.csource import CFunction, CSourceFile, _CALL_RE
 
 PASS = "native"
@@ -50,6 +62,7 @@ def check_files(srcs: List[CSourceFile]) -> List[Finding]:
         _check_blocking(src, findings)
         _check_atomics(src, findings)
     _check_gil(srcs, table, findings)
+    _check_reactor(srcs, table, findings)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 
@@ -156,6 +169,82 @@ def _check_atomics(src: CSourceFile, findings: List[Finding]) -> None:
                 "happens-before edge they rely on",
             )
         )
+
+
+# -- reactor discipline ------------------------------------------------
+
+_REACTOR_CALL_RE = re.compile(
+    r"\b(%s)\s*\("
+    % "|".join(re.escape(c) for c in REACTOR_NONBLOCK_TOKENS)
+)
+
+
+def _call_args(body: str, open_idx: int) -> str:
+    """The argument text of a call, from its '(' to the matching ')'
+    (blanked code: parens in strings/comments are already gone)."""
+    depth = 0
+    for i in range(open_idx, len(body)):
+        c = body[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return body[open_idx : i + 1]
+    return body[open_idx:]
+
+
+def _check_reactor(
+    srcs: List[CSourceFile],
+    table: Dict[str, Tuple[CSourceFile, CFunction]],
+    findings: List[Finding],
+) -> None:
+    """blocking-in-reactor: BFS the in-scan call graph from every
+    ``epoll-root`` function; any reached socket call missing its
+    nonblocking token (REACTOR_NONBLOCK_TOKENS) is a lane stall."""
+    for src in srcs:
+        for root in src.functions:
+            if not src.epoll_root(root):
+                continue
+            seen: Set[str] = {root.name}
+            emitted: Set[str] = set()
+            frontier: List[Tuple[CSourceFile, CFunction, str]] = [
+                (src, root, root.name)
+            ]
+            while frontier:
+                fsrc, fn, path = frontier.pop()
+                body = fsrc.code[fn.body_start:fn.body_end]
+                for m in _REACTOR_CALL_RE.finditer(body):
+                    callee = m.group(1)
+                    token = REACTOR_NONBLOCK_TOKENS[callee]
+                    if token in _call_args(body, m.end() - 1):
+                        continue
+                    line = fsrc.line_of(fn.body_start + m.start())
+                    if fsrc.suppressed(line, PASS):
+                        continue
+                    key = f"{root.name}->{callee}:{fsrc.rel}:{line}"
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    findings.append(
+                        Finding(
+                            PASS, "blocking-in-reactor", src.rel,
+                            root.name_line, root.name,
+                            f"{root.name}->{callee}",
+                            f"epoll-root {root.name} reaches blocking "
+                            f"{callee}() without {token} via {path} "
+                            f"({fsrc.rel}:{line}) — a reactor thread "
+                            "parked in the kernel stalls every "
+                            "connection on its lane",
+                        )
+                    )
+                for m in _CALL_RE.finditer(body):
+                    callee = m.group(1)
+                    if callee in seen or callee not in table:
+                        continue
+                    seen.add(callee)
+                    nsrc, nfn = table[callee]
+                    frontier.append((nsrc, nfn, f"{path}->{callee}"))
 
 
 # -- GIL discipline ----------------------------------------------------
