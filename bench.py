@@ -238,6 +238,15 @@ def main() -> int:
             # GUBER_H2_EVENT_FRONT=0 and the feeder-ring-wait p99
             # starvation attribution per rung.
             result = _run_connscale(np, platform)
+        elif MODE == "flashcrowd":
+            # Hot-key replication A/B (ROADMAP item 3): a time-varying
+            # zipf where the hot set ROTATES mid-run — with replication
+            # on, promotion keeps every node answering hot keys locally
+            # so the herd-style p99 stays flat across rotations; the
+            # BENCH_FLASH_REPL=0 arm shows the owner's per-key serve
+            # ceiling.  A finite-limit canary key measures admission
+            # against the N_replicas x lease bound in the same run.
+            result = _run_flashcrowd(np, platform)
         elif MODE == "herdtrace":
             # Same-session tracing A/B: the herdfast workload once with
             # tracing disabled and once with the in-memory recorder +
@@ -2030,6 +2039,210 @@ def _drive_herd(np, address: str, payloads, n_threads: int, seconds: float,
         "requests": int(sum(counts)),
         "errors": int(sum(errors)),
     }
+
+
+def _run_flashcrowd(np, platform: str) -> dict:
+    """Flash-crowd A/B (ISSUE 13 acceptance): single-item RPCs sprayed
+    across all nodes under a time-varying zipf — ~80% of traffic on a
+    small hot set that ROTATES every MEASURE_SECONDS/BENCH_FLASH_PHASES
+    — once with hot-key replication live (promotion keeps every node
+    answering hot keys from pre-debited credit leases) and once with
+    BENCH_FLASH_REPL=0 (consistent-hash-only: every non-owner request
+    pays the forward hop to the hot key's owner).
+
+    The artifact splits p99 into steady vs rotation windows (the first
+    second after each hot-set switch): the acceptance bar is rotation
+    p99 within 2x steady p99 with replication on.  A finite-limit
+    CANARY key rides every phase's hot set; its admitted count checks
+    the N_replicas x lease bound live (pre-debit => admitted <= limit
+    on a healthy owner)."""
+    from gubernator_tpu.cluster.harness import ClusterHarness
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+
+    import grpc
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 3))
+    n_threads = int(os.environ.get("BENCH_FLASH_THREADS", 8))
+    phases = max(2, int(os.environ.get("BENCH_FLASH_PHASES", 4)))
+    # ONE celebrity key per phase is the scenario (DualMap's
+    # affinity-vs-load-balance hard case): with replication off, ~75%
+    # of all traffic funnels through that key's single owner.
+    hot_n = int(os.environ.get("BENCH_FLASH_HOT", 1))
+    repl_on = os.environ.get("BENCH_FLASH_REPL", "1") != "0"
+    # Sized to EXHAUST during the run (canary traffic is ~10% of a few
+    # hundred req/s): admitted-vs-limit is only evidence if the bucket
+    # actually runs dry.
+    canary_limit = int(os.environ.get("BENCH_FLASH_CANARY_LIMIT", 150))
+    lease = int(os.environ.get("BENCH_FLASH_LEASE", 200))
+    phase_dur = MEASURE_SECONDS / phases
+    h = ClusterHarness().start(n_nodes, cache_size=CAPACITY)
+    try:
+        for d in h.daemons:
+            r = d.replication
+            assert r is not None
+            if repl_on:
+                # Sized to this harness: the in-process closed-loop
+                # cluster runs a few hundred req/s total, so a hot key
+                # (and the ~10%-share canary) sees ~10-40/s —
+                # promotion must engage well below that.
+                r.promote_rate = float(
+                    os.environ.get("BENCH_FLASH_PROMOTE_RATE", 8)
+                )
+                r.interval = 0.1
+                r.cooldown = max(0.5, phase_dur * 0.5)
+                r.lease = lease
+                r.lease_ttl = 0.5
+                d.instance.hotkeys.window_s = 0.5
+            else:
+                r.enabled = False
+        addrs = [d.grpc_address for d in h.daemons]
+
+        def payload(key, limit):
+            return pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="flash", unique_key=key, hits=1,
+                        limit=limit, duration=3_600_000,
+                    )
+                ]
+            ).SerializeToString()
+
+        # Keys vary a LEADING byte (FNV-1 trailing-byte collapse; see
+        # hash_ring.py) so hot keys spread across owners.
+        hot_payloads = [
+            [payload(f"{p}{j}_fc{p}", 10**9) for j in range(hot_n)]
+            for p in range(phases)
+        ]
+        cold_payloads = [payload(f"{i}_fcold", 10**9) for i in range(64)]
+        canary_payload = payload("9cy_fcanary", canary_limit)
+
+        stop = threading.Event()
+        barrier = threading.Barrier(n_threads + 1)
+        counts = [0] * n_threads
+        errors = [0] * n_threads
+        canary_admitted = [0] * n_threads
+        lats: list = [None] * n_threads
+        start_box = [0.0]
+        rng_seed = 1234
+
+        def worker(tid: int) -> None:
+            rng = np.random.default_rng(rng_seed + tid)
+            mylat = []
+            ch = grpc.insecure_channel(addrs[tid % len(addrs)])
+            call = ch.unary_unary(
+                f"/{V1_SERVICE}/GetRateLimits",
+                request_serializer=lambda raw: raw,
+                response_deserializer=lambda raw: raw,
+            )
+            try:
+                call(cold_payloads[0])
+            finally:
+                barrier.wait()
+            while not stop.is_set():
+                now = time.perf_counter()
+                rel = now - start_box[0]
+                p = min(int(rel / phase_dur), phases - 1)
+                u = rng.random()
+                if u < 0.1:
+                    body, is_canary = canary_payload, True
+                elif u < 0.85:
+                    body = hot_payloads[p][int(rng.integers(hot_n))]
+                    is_canary = False
+                else:
+                    body = cold_payloads[int(rng.integers(64))]
+                    is_canary = False
+                t0 = time.perf_counter()
+                try:
+                    raw = call(body)
+                    resp = pb.GetRateLimitsResp()
+                    resp.ParseFromString(raw)
+                    for rr in resp.responses:
+                        if rr.error:
+                            errors[tid] += 1
+                        elif is_canary and rr.status == 0:  # UNDER
+                            canary_admitted[tid] += 1
+                except grpc.RpcError:
+                    errors[tid] += 1
+                mylat.append((rel, time.perf_counter() - t0))
+                counts[tid] += 1
+            lats[tid] = mylat
+            ch.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        # Stamp BEFORE releasing the barrier: workers read the stamp
+        # right after their own wait returns, and a zero stamp would
+        # give the first samples garbage phase offsets that pollute
+        # the steady-p99 population.
+        start_box[0] = time.perf_counter()
+        barrier.wait()
+        time.sleep(MEASURE_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start_box[0]
+        all_lat = [x for ml in lats if ml for x in ml]
+        rel = np.asarray([t for t, _ in all_lat])
+        dur = np.asarray([d for _, d in all_lat])
+        # Rotation windows: the first second after each hot-set switch
+        # (phase 0's cold start is excluded from both populations).
+        rot_w = min(1.0, phase_dur / 2)
+        rot_mask = np.zeros(len(rel), dtype=bool)
+        for p in range(1, phases):
+            t0 = p * phase_dur
+            rot_mask |= (rel >= t0) & (rel < t0 + rot_w)
+        steady_mask = ~rot_mask & (rel >= min(1.0, phase_dur / 2))
+        p99 = lambda a: (  # noqa: E731
+            round(float(np.percentile(a, 99)) * 1e3, 3) if len(a) else None
+        )
+        repl_stats = {
+            k: sum(d.replication.stats()[k] for d in h.daemons)
+            for k in h.daemons[0].replication.stats()
+        }
+        admitted = int(sum(canary_admitted))
+        n_replicas = n_nodes - 1
+        steady_p99 = p99(dur[steady_mask])
+        rot_p99 = p99(dur[rot_mask])
+        return {
+            "metric": "rate-limit decisions/sec, flash crowd (hot set "
+            f"rotates every {phase_dur:.1f}s across {phases} phases, "
+            f"{n_threads} client threads spraying {n_nodes} nodes, "
+            f"replication {'on' if repl_on else 'off'})",
+            "value": round(sum(counts) / elapsed, 1),
+            "unit": "decisions/sec",
+            "vs_baseline": round(
+                sum(counts) / elapsed / BASELINE_DECISIONS_PER_SEC, 2
+            ),
+            "p50_ms": round(float(np.percentile(dur, 50)) * 1e3, 3),
+            "p99_ms": p99(dur),
+            "steady_p99_ms": steady_p99,
+            "rotation_p99_ms": rot_p99,
+            "rotation_over_steady": (
+                round(rot_p99 / steady_p99, 2)
+                if steady_p99 and rot_p99 else None
+            ),
+            "phases": phases,
+            "requests": int(sum(counts)),
+            "errors": int(sum(errors)),
+            "replication_on": repl_on,
+            "replication": repl_stats,
+            "canary": {
+                "limit": canary_limit,
+                "admitted": admitted,
+                "over_admission": max(0, admitted - canary_limit),
+                "bound": n_replicas * lease,
+                "lease": lease,
+                "replicas": n_replicas,
+            },
+            "platform": platform,
+        }
+    finally:
+        h.stop()
 
 
 def _run_deadpeer(np, platform: str) -> dict:

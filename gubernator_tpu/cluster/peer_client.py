@@ -139,6 +139,7 @@ class PeerClient:
         self._raw_get_peer = None
         self._raw_update_globals = None
         self._raw_transfer = None
+        self._raw_replicate = None
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
         self._queue_cv = threading.Condition(self._lock)
@@ -177,6 +178,11 @@ class PeerClient:
                 )
                 self._raw_transfer = self._channel.unary_unary(
                     f"/{PEERS_SERVICE}/TransferBuckets",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                self._raw_replicate = self._channel.unary_unary(
+                    f"/{PEERS_SERVICE}/ReplicateKeys",
                     request_serializer=lambda raw: raw,
                     response_deserializer=lambda raw: raw,
                 )
@@ -441,6 +447,40 @@ class PeerClient:
             self.health.record_success()
         except grpc.RpcError as e:
             err = f"TransferBuckets to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            self._observe_rpc_error(e)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
+    def replicate_keys_raw(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> bytes:
+        """Ship one hot-key replication message (grant or revoke) to
+        this peer and return the raw JSON response — the promotion
+        protocol (cluster/replication.py encodes both sides; the
+        response carries superseded leases' credit accounting).
+        Promotion-rate traffic, never the decision hot path."""
+        self._gate()
+        self._connect()
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            raw = self._raw_replicate
+            self._inflight += 1
+        try:
+            resp = raw(
+                payload, timeout=timeout or self.behaviors.global_timeout,
+                metadata=tracing.grpc_metadata(),
+            )
+            self.health.record_success()
+            return resp
+        except grpc.RpcError as e:
+            err = f"ReplicateKeys to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
             self._observe_rpc_error(e)
             raise PeerError(

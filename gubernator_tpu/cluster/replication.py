@@ -1,0 +1,891 @@
+"""Hot-key adaptive ownership: replicated credit leases for the
+measured hot set.
+
+Everything else in the cluster routes by consistent hash alone, so one
+celebrity key saturates its owner while its neighbors idle — the
+affinity-vs-load-balance tension DualMap (PAPERS.md) frames, and the
+skew hard case "Designing Scalable Rate Limiting Systems" names for
+distributed limiters.  This plane lets *observed load reshape
+ownership*:
+
+* **Measure** — the per-daemon space-saving top-K (utils/hotkeys.py)
+  now carries a windowed decay, so `top_rates()` is the *current*
+  offered rate per key with the last-seen (limit, duration) attached.
+* **Promote** — when a key THIS node owns crosses
+  ``GUBER_REPL_PROMOTE_RATE`` hits/sec, the owner splits the key's
+  remaining budget into per-replica credit leases: each local-DC peer
+  receives a PRE-DEBITED credit slice (the owner consumes the credit
+  on its own engine *before* granting — the ledger's lease machinery
+  bound carries over verbatim), shipped over a raw-JSON
+  ``PeersV1/ReplicateKeys`` RPC (the handoff plane's wire idiom).
+  Every replica then answers the key locally from its leased credit —
+  zero forward hops — installing the lease into the native decision
+  plane when one is attached, so promoted keys stay on the C fast
+  path (core/ledger.remote_install).
+* **Reconcile** — grants are refreshed ahead of their TTL; each grant
+  (and every revoke) response returns the superseded lease's
+  (consumed, unused) so the owner settles unused credit back onto its
+  engine as negative-hit return rows, exactly the ledger's settle
+  path.  Replica-drained hits need no reconciliation at all: they
+  were debited at grant time.
+* **Demote** — a key whose measured rate stays below half the promote
+  threshold for ``GUBER_REPL_COOLDOWN`` seconds is revoked
+  everywhere.  The demote window is the replication analog of the
+  membership plane's dual-ring cutover (old-or-new-never-third):
+  while revokes propagate, a request lands either on a replica still
+  holding live pre-debited credit or on the owner — both are
+  *acceptable* destinations, and because every replica answer drains
+  credit the owner already debited, the cutover has no correctness
+  gap, only the bounded credit outstanding.
+
+**Over-admission bound.**  Credit is debited before any replica may
+admit with it, so lease accounting alone can never over-admit.  The
+exposures are exactly the ledger's, scaled by the replica count:
+
+  - a replica that dies mid-lease strands its unused credit —
+    bounded UNDER-admission ≤ lease per replica;
+  - an owner that dies mid-promotion loses the debited state with its
+    engine; replicas keep answering from credit the restarted owner
+    no longer remembers — over-admission ≤ N_replicas × lease per
+    window, the same N × bound shape RESILIENCE.md derives for
+    degraded answering and handoff forfeits.
+
+**Health / epoch gating.**  Every grant and revoke passes the peer
+health plane (circuit-open replicas are skipped — their lease simply
+expires into the bound above, never blocking the owner), and carries
+the membership (boot, epoch, seq): receivers drop out-of-order
+messages per sender stream and reject grants from an epoch older than
+their own membership epoch, so a promotion racing a reshard loses to
+the reshard (epoch ordering wins) and leases are dropped when their
+grantor is no longer the key's ring owner.
+
+RESILIENCE.md §11 documents the semantics and the bound derivation;
+PERF.md §27 has the flashcrowd A/B this plane exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.ops.bucket_kernel import token_extras_host
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+log = logging.getLogger("gubernator_tpu.replication")
+
+_TOKEN = int(Algorithm.TOKEN_BUCKET)
+_OVER = int(Status.OVER_LIMIT)
+_UNDER = int(Status.UNDER_LIMIT)
+# The ledger's lease precondition breakers, plus MULTI_REGION (a
+# replica answer would skip the owner's region-hit queueing) and
+# SKETCH (node-local limiter): rows carrying any of these cannot be
+# answered from replicated leased credit.  service._LEASE_BREAKERS is
+# the same set — the two probes must not drift.
+_BREAKERS = (
+    int(Behavior.DURATION_IS_GREGORIAN)
+    | int(Behavior.RESET_REMAINING)
+    | int(Behavior.MULTI_REGION)
+    | int(Behavior.SKETCH)
+)
+
+
+class _GrantRefused(RuntimeError):
+    """The replica answered but refused the grant (replication
+    disabled there, or the message lost an ordering race): the slice
+    must be returned like any undeliverable grant."""
+
+
+def _k2s(key: bytes) -> str:
+    """Lossless bytes→JSON-string key encoding (hash keys are client
+    strings, but the wire decode hands us raw bytes)."""
+    return key.decode("utf-8", "surrogateescape")
+
+
+def _s2k(key: str) -> bytes:
+    return key.encode("utf-8", "surrogateescape")
+
+
+class _RemoteLease:
+    """One replica-held credit slice of a promoted key."""
+
+    __slots__ = (
+        "key", "limit", "duration", "reset", "rem", "credit",
+        "consumed", "expiry", "src", "epoch", "native",
+    )
+
+    def __init__(self, key, limit, duration, reset, rem, credit,
+                 expiry, src, epoch):
+        self.key = key
+        self.limit = limit
+        self.duration = duration
+        self.reset = reset
+        # Logical remaining at grant time (owner's post-debit remaining
+        # + this slice) — answers report rem - consumed, a conservative
+        # lower bound on the true cluster-wide remaining.
+        self.rem = rem
+        self.credit = credit
+        self.consumed = 0
+        self.expiry = expiry
+        self.src = src
+        self.epoch = epoch
+        # Delegated to the native decision plane: the C table is the
+        # drain point until a Python touch pulls it back.
+        self.native = False
+
+
+class _Promoted:
+    """Owner-side record of one replicated key."""
+
+    __slots__ = (
+        "key", "limit", "duration", "last_hot", "grants", "since",
+    )
+
+    def __init__(self, key: bytes, limit: int, duration: int, now: float):
+        self.key = key
+        self.limit = limit
+        self.duration = duration
+        self.last_hot = now
+        # addr -> (expiry_mono, credit) of the replica's live grant.
+        self.grants: Dict[str, Tuple[float, int]] = {}
+        self.since = now
+
+
+class ReplicationManager:
+    """Per-daemon promotion/demotion state machine + replica lease
+    table.  One instance plays BOTH roles: owner for keys this node
+    owns, replica for grants received from peers."""
+
+    # guberlint: guard _leases, _seq, _seen, counters by _lock
+    # _promoted is loop-thread-owned: only the manager thread (and
+    # close(), after joining it) iterates or keys into it; mutations
+    # still happen under _lock so stats() can read len() from scrape
+    # threads.  _n_leases is the intentionally lock-free serve-path
+    # gate (see has_leases).
+
+    def __init__(
+        self,
+        daemon,
+        *,
+        promote_rate: float = 2000.0,
+        cooldown: float = 10.0,
+        lease: int = 2048,
+        lease_ttl: float = 1.0,
+        interval: float = 0.5,
+        max_keys: int = 16,
+    ):
+        self._daemon = daemon
+        self.enabled = True
+        # Live-tunable knobs (the flashcrowd bench and the chaos tests
+        # re-point them on a running cluster; the loop re-reads each
+        # tick).
+        self.promote_rate = promote_rate
+        self.cooldown = cooldown
+        self.lease = max(1, lease)
+        self.lease_ttl = lease_ttl
+        self.interval = interval
+        self.max_keys = max(1, max_keys)
+        self._lock = threading.Lock()
+        # Replica side: key bytes -> _RemoteLease.
+        self._leases: Dict[bytes, _RemoteLease] = {}
+        # Lock-free fast-path gate: plain int read per request when no
+        # leases are held (the idle cost of the whole plane).
+        self._n_leases = 0
+        # Owner side: key bytes -> _Promoted.
+        self._promoted: Dict[bytes, _Promoted] = {}
+        # Monotonic per-process message sequence (stream ordering).
+        self._seq = 0
+        # Receiver-side stream guard: src -> (boot, last seq seen).
+        self._seen: Dict[str, Tuple[str, int]] = {}
+        self.counters: Dict[str, int] = {
+            "promoted": 0,
+            "demoted": 0,
+            "grants_sent": 0,
+            "grants_failed": 0,
+            "grants_received": 0,
+            "revokes_received": 0,
+            "stale_dropped": 0,
+            "expired": 0,
+            "answered": 0,
+            "credit_granted": 0,
+            "credit_returned": 0,
+            "credit_forfeited": 0,
+        }
+        self._count_kw: Optional[bool] = None  # feature-detect lazily
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="guber-replication", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # Best-effort demote of everything we promoted (returns unused
+        # replica credit while peers are still up), then drop replica
+        # leases — their unused credit expires into the bound.
+        try:
+            for key in list(self._promoted):
+                self._demote(key, rpc_timeout=0.5)
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("replication.close_demote")
+            log.exception("replication close-time demote failed")
+        with self._lock:
+            for lease in self._leases.values():
+                self._pull_native_locked(lease)
+            self._leases.clear()
+            self._n_leases = 0
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            if not self.enabled:
+                continue
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the plane must not die
+                from gubernator_tpu.utils.metrics import record_swallowed
+
+                record_swallowed("replication.tick")
+                log.exception("replication tick failed")
+
+    # -- shared plumbing -----------------------------------------------
+
+    def _instance(self):
+        return self._daemon.instance
+
+    def _engine_apply(self, rows: List[tuple], *, decisions: bool):
+        """One columnar engine apply of [(key, hits, limit, duration)]
+        rows; returns (status, limit, remaining, reset) columns."""
+        engine = self._instance().engine
+        if self._count_kw is None:
+            import inspect
+
+            try:
+                self._count_kw = "count_decisions" in inspect.signature(
+                    engine.apply_columnar
+                ).parameters
+            except (TypeError, ValueError):
+                self._count_kw = False
+        m = len(rows)
+        cols = (
+            [r[0] for r in rows],
+            np.zeros(m, dtype=np.int32),
+            np.zeros(m, dtype=np.int32),
+            np.asarray([r[1] for r in rows], dtype=np.int64),
+            np.asarray([r[2] for r in rows], dtype=np.int64),
+            np.asarray([r[3] for r in rows], dtype=np.int64),
+            np.zeros(m, dtype=np.int64),
+        )
+        if self._count_kw and not decisions:
+            return engine.apply_columnar(*cols, count_decisions=False)
+        return engine.apply_columnar(*cols)
+
+    def _membership_stamp(self) -> Tuple[str, int]:
+        mem = self._daemon.membership
+        if mem is None:
+            return "", 0
+        return mem.boot_id, mem.epoch()
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[counter] += n
+
+    # ------------------------------------------------------------------
+    # Owner side: the promotion/demotion state machine.
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        self._expire_replica_leases(now)
+        instance = self._instance()
+        if instance is None:
+            return
+        hk = instance.hotkeys
+        if hk is None:
+            return
+        demote_rate = self.promote_rate * 0.5
+        # Refresh / demote what is already promoted.
+        for key in list(self._promoted):
+            p = self._promoted.get(key)
+            if p is None:
+                continue
+            rate = hk.rate(key)
+            if rate >= demote_rate:
+                p.last_hot = now
+            if now - p.last_hot > self.cooldown or not self._owns(key):
+                # Cooled past the hysteresis window, or a reshard moved
+                # the key off this node: converge back to single-owner.
+                self._demote(key)
+                continue
+            self._refresh(p, now)
+        # Promote new entrants.
+        if len(self._promoted) >= self.max_keys:
+            return
+        for key, rate, limit, duration in hk.top_rates(self.max_keys * 2):
+            if len(self._promoted) >= self.max_keys:
+                break
+            if (
+                rate < self.promote_rate
+                or key in self._promoted
+                or limit <= 0
+                or duration <= 0
+                or not self._owns(key)
+            ):
+                continue
+            self._promote(key, limit, duration, now)
+
+    def _owns(self, key: bytes) -> bool:
+        instance = self._instance()
+        try:
+            owner = instance.get_peer(_k2s(key))
+        except Exception:  # noqa: BLE001 — empty pool during teardown
+            return False
+        return owner is None or owner.info.is_owner
+
+    def _replica_peers(self) -> List:
+        """Local-DC peers that should hold a lease (everyone but us,
+        circuit permitting — a broken replica is skipped and its lease
+        expires into the bound, never blocking the owner)."""
+        return [
+            p
+            for p in self._instance().get_peer_list()
+            if not p.info.is_owner and p.health.would_allow()
+        ]
+
+    def _promote(self, key: bytes, limit: int, duration: int,
+                 now: float) -> None:
+        from gubernator_tpu.utils import tracing
+
+        peers = self._replica_peers()
+        if not peers:
+            return
+        with tracing.span(
+            "replication.promote", key=_k2s(key), replicas=len(peers)
+        ):
+            p = _Promoted(key, limit, duration, now)
+            granted = self._grant_round(p, peers, now)
+            if not granted:
+                return  # nothing debited, nothing to track
+            with self._lock:
+                self._promoted[key] = p
+                self.counters["promoted"] += 1
+            log.info(
+                "promoted hot key %r to %d replicas", _k2s(key), granted
+            )
+
+    def _refresh(self, p: _Promoted, now: float) -> None:
+        """Re-grant leases that would expire within two ticks (and
+        cover replicas that joined since promotion)."""
+        horizon = now + 2.0 * self.interval
+        peers = [
+            peer
+            for peer in self._replica_peers()
+            if p.grants.get(peer.info.grpc_address, (0.0, 0))[0] < horizon
+        ]
+        if peers:
+            self._grant_round(p, peers, now)
+
+    def _grant_round(self, p: _Promoted, peers: List, now: float) -> int:
+        """Pre-debit one credit slice per peer on OUR engine, then ship
+        the grants; failed sends return their slice immediately.
+        Returns the number of grants delivered."""
+        instance = self._instance()
+        key_s = _k2s(p.key)
+        # The probe/debit rows run on the engine WITHOUT settling the
+        # owner's own ledger lease for this key: revoking it every
+        # refresh would strip the owner's hot-key fast path exactly on
+        # the hottest keys.  Safe because the debit only CONSUMES
+        # device remaining (the ledger's pre-debited credit is
+        # untouched; its rem snapshot merely goes conservative), and
+        # the probe is a status read; an over-ask is rejected without
+        # consuming.  The grant's reported remaining under-reports by
+        # the owner's outstanding lease credit — the same bounded
+        # staleness the GLOBAL broadcast carries.
+        st, _lim, rem, rst = self._engine_apply(
+            [(p.key, 0, p.limit, p.duration)], decisions=False
+        )
+        now_ms = instance.engine.clock.now_ms()
+        remaining = int(rem[0])
+        reset = int(rst[0])
+        n = len(peers)
+        if int(st[0]) != _UNDER or remaining <= n or reset <= now_ms:
+            return 0  # exhausted / expiring bucket: nothing to split
+        # Leave the owner its own 1/(n+1) share of what remains.
+        budget = remaining * n // (n + 1)
+        per = min(self.lease, budget // n)
+        if per < 1:
+            return 0
+        st, _lim, rem, rst = self._engine_apply(
+            [(p.key, per * n, p.limit, p.duration)], decisions=False
+        )
+        if int(st[0]) != _UNDER:
+            return 0  # raced below the ask; the engine consumed nothing
+        remaining = int(rem[0])
+        reset = int(rst[0])
+        self._bump("credit_granted", per * n)
+        expiry_ms = now_ms + int(self.lease_ttl * 1000)
+        boot, epoch = self._membership_stamp()
+        delivered = 0
+        for peer in peers:
+            addr = peer.info.grpc_address
+            doc = {
+                "op": "grant",
+                "src": self._daemon.peer_info().grpc_address,
+                "boot": boot,
+                "epoch": epoch,
+                "seq": self._next_seq(),
+                "grants": [[
+                    key_s, p.limit, p.duration, reset,
+                    remaining + per, per, expiry_ms,
+                ]],
+            }
+            try:
+                raw = peer.replicate_keys_raw(
+                    json.dumps(doc, separators=(",", ":")).encode(),
+                    timeout=self._daemon.conf.behaviors.global_timeout,
+                )
+                # A transport-delivered refusal (replication disabled
+                # on the peer, or our message lost an ordering race)
+                # is a failed grant too: the replica installed NOTHING
+                # and will never return the slice — treating it as
+                # delivered would leak `per` credit on every refresh.
+                resp = json.loads(raw) if raw else {}
+                if resp.get("disabled") or resp.get("stale"):
+                    raise _GrantRefused(
+                        "disabled" if resp.get("disabled") else "stale"
+                    )
+            except Exception as e:  # noqa: BLE001 — PeerError + transport
+                # Undeliverable slice: return it to the engine NOW (the
+                # replica never saw it; holding it would under-admit).
+                self._return_credit([(p.key, per, p.limit, p.duration,
+                                      reset)])
+                self._bump("grants_failed")
+                p.grants.pop(addr, None)
+                log.debug("grant of %r to %s failed: %s", key_s, addr, e)
+                continue
+            delivered += 1
+            self._bump("grants_sent")
+            p.grants[addr] = (now + self.lease_ttl, per)
+            self._apply_returns(raw)
+        return delivered
+
+    def _demote(self, key: bytes, rpc_timeout: Optional[float] = None) -> None:
+        from gubernator_tpu.utils import tracing
+
+        with self._lock:
+            p = self._promoted.pop(key, None)
+            if p is None:
+                return
+            self.counters["demoted"] += 1
+        with tracing.span(
+            "replication.demote", key=_k2s(key), replicas=len(p.grants)
+        ):
+            boot, epoch = self._membership_stamp()
+            instance = self._instance()
+            timeout = (
+                rpc_timeout
+                if rpc_timeout is not None
+                else self._daemon.conf.behaviors.global_timeout
+            )
+            peers = {
+                peer.info.grpc_address: peer
+                for peer in instance.get_peer_list()
+            }
+            for addr, (_expiry, credit) in list(p.grants.items()):
+                peer = peers.get(addr)
+                doc = {
+                    "op": "revoke",
+                    "src": self._daemon.peer_info().grpc_address,
+                    "boot": boot,
+                    "epoch": epoch,
+                    "seq": self._next_seq(),
+                    "revokes": [_k2s(key)],
+                }
+                try:
+                    if peer is None or not peer.health.would_allow():
+                        raise RuntimeError("replica unreachable")
+                    raw = peer.replicate_keys_raw(
+                        json.dumps(doc, separators=(",", ":")).encode(),
+                        timeout=timeout,
+                    )
+                except Exception:  # noqa: BLE001 — PeerError + transport
+                    # The replica keeps draining until its lease TTL;
+                    # its unused credit is forfeited — bounded, and the
+                    # demote window stays old-owner-or-replica-never-
+                    # third exactly like the dual-ring cutover.
+                    self._bump("credit_forfeited", credit)
+                    continue
+                self._apply_returns(raw)
+
+    def _apply_returns(self, raw: bytes) -> None:
+        """Settle a response's returned lease remainders back onto the
+        engine: [[key, consumed, unused, reset, limit, duration]...] —
+        unused credit rides back as negative-hit rows, guarded by the
+        bucket window (a return landing on a FRESH window would
+        overfill it), the ledger settle contract verbatim.  The rows
+        carry their own limit/duration: a demote's revoke responses
+        arrive AFTER the promoted entry is gone."""
+        try:
+            doc = json.loads(raw) if raw else {}
+        except ValueError:
+            return
+        rows = doc.get("returns") or []
+        if not rows:
+            return
+        instance = self._instance()
+        now_ms = instance.engine.clock.now_ms()
+        hk = instance.hotkeys
+        returns: List[tuple] = []
+        for key_s, consumed, unused, reset, limit, duration in rows:
+            if consumed > 0 and hk is not None:
+                # Replica-answered drains never reach the owner's
+                # request path, so without this the owner's measured
+                # rate collapses to its 1/N share the moment
+                # promotion succeeds — and a genuinely hot key would
+                # oscillate promote/demote on every cooldown.  Each
+                # superseded lease's consumed count is exactly the
+                # drains since the last refresh: credit them to the
+                # owner's sketch so demotion sees the key's TRUE
+                # cluster-wide rate.
+                hk.offer(_s2k(key_s), consumed)
+            if unused > 0 and now_ms <= reset:
+                returns.append(
+                    (_s2k(key_s), -unused, limit, duration, reset)
+                )
+        if returns:
+            self._return_credit(returns_rows=returns)
+
+    def _return_credit(self, rows: List[tuple] = None, *,
+                       returns_rows: List[tuple] = None) -> None:
+        """Apply positive-credit returns: `rows` is
+        [(key, credit, limit, duration, reset)] (negated here);
+        `returns_rows` is pre-negated [(key, -unused, limit, duration,
+        reset)]."""
+        out = returns_rows or [
+            (k, -c, lim, dur, rst) for k, c, lim, dur, rst in rows
+        ]
+        total = sum(-r[1] for r in out)
+        try:
+            self._engine_apply(
+                [(k, h, lim, dur) for k, h, lim, dur, _rst in out],
+                decisions=False,
+            )
+            self._bump("credit_returned", total)
+        except Exception:  # noqa: BLE001 — credit loss is bounded
+            from gubernator_tpu.utils.metrics import record_swallowed
+
+            record_swallowed("replication.credit_return")
+            self._bump("credit_forfeited", total)
+            log.exception("replication credit return failed")
+
+    # ------------------------------------------------------------------
+    # Replica side: the remote-lease table + serve probes.
+
+    def receive(self, raw: bytes) -> bytes:
+        """One inbound ReplicateKeys message (grant or revoke); returns
+        the JSON response bytes.  Raises ValueError on malformed input
+        (the RPC adapter maps it to INVALID_ARGUMENT)."""
+        doc = json.loads(raw)
+        op = doc.get("op")
+        if op not in ("grant", "revoke"):
+            raise ValueError(f"unknown replication op {op!r}")
+        src = str(doc.get("src", ""))
+        boot = str(doc.get("boot", ""))
+        seq = int(doc.get("seq", 0))
+        epoch = int(doc.get("epoch", 0))
+        if not self.enabled:
+            return b'{"disabled":true,"returns":[]}'
+        with self._lock:
+            last = self._seen.get(src)
+            if last is not None and last[0] == boot and seq <= last[1]:
+                self.counters["stale_dropped"] += 1
+                return b'{"stale":true,"returns":[]}'
+            self._seen[src] = (boot, seq)
+        returns: List[list] = []
+        if op == "grant":
+            mem = self._daemon.membership
+            if mem is not None and epoch < mem.epoch():
+                # The grant predates a reshard this node already
+                # observed: ownership may have moved — epoch ordering
+                # wins, the owner's next refresh re-grants under the
+                # new epoch (or stops owning the key entirely).
+                self._bump("stale_dropped")
+                return b'{"stale":true,"returns":[]}'
+            for g in doc.get("grants") or []:
+                key_s, limit, duration, reset, rem, credit, expiry = g
+                prev = self._install(
+                    _s2k(key_s), int(limit), int(duration), int(reset),
+                    int(rem), int(credit), int(expiry), src, epoch,
+                )
+                if prev is not None:
+                    returns.append([key_s, *prev])
+            self._bump("grants_received", len(doc.get("grants") or []))
+        else:
+            for key_s in doc.get("revokes") or []:
+                prev = self._remove(_s2k(key_s))
+                if prev is not None:
+                    returns.append([key_s, *prev])
+            self._bump("revokes_received", len(doc.get("revokes") or []))
+        return json.dumps(
+            {"returns": returns}, separators=(",", ":")
+        ).encode()
+
+    def _native_ledger(self):
+        instance = self._instance()
+        led = instance.ledger if instance is not None else None
+        return led if led is not None and led.native_plane() is not None else None
+
+    def _install(self, key, limit, duration, reset, rem, credit,
+                 expiry, src, epoch) -> Optional[Tuple]:
+        """Install/replace a remote lease; returns the superseded
+        lease's _close_locked accounting for the grant response."""
+        lease = _RemoteLease(
+            key, limit, duration, reset, rem, credit, expiry, src, epoch
+        )
+        with self._lock:
+            prev = self._leases.get(key)
+            ret = self._close_locked(prev) if prev is not None else None
+            self._leases[key] = lease
+            self._n_leases = len(self._leases)
+            led = self._native_ledger()
+            if led is not None and led.remote_install(
+                key, limit, duration, reset, rem, credit, 0, expiry
+            ):
+                lease.native = True
+        return ret
+
+    def _remove(self, key) -> Optional[Tuple]:
+        with self._lock:
+            lease = self._leases.pop(key, None)
+            self._n_leases = len(self._leases)
+            if lease is None:
+                return None
+            return self._close_locked(lease)
+
+    def _pull_native_locked(self, lease: _RemoteLease) -> None:
+        """Pull a delegated lease back from the C plane, merging the
+        natively drained count (linearizes native answers before
+        whatever the caller does next)."""
+        if not lease.native:
+            return
+        lease.native = False
+        led = self._native_ledger()
+        if led is None:
+            return
+        pulled = led.remote_pull(lease.key)
+        if pulled is not None and pulled > lease.consumed:
+            # Credit the natively drained delta to the hot-key sketch
+            # (the C tier's per-key counts surface only at pull time) —
+            # replica-answered keys must keep reading hot or demotion
+            # would fire while the native plane is still serving them.
+            instance = self._instance()
+            hk = instance.hotkeys if instance is not None else None
+            if hk is not None:
+                hk.offer(lease.key, pulled - lease.consumed)
+            lease.consumed = pulled
+
+    def _close_locked(
+        self, lease: _RemoteLease
+    ) -> Tuple[int, int, int, int, int]:
+        """Final accounting for a lease leaving the table:
+        (consumed, unused, reset, limit, duration) — everything the
+        owner's settle row needs, self-contained."""
+        self._pull_native_locked(lease)
+        unused = max(0, lease.credit - lease.consumed)
+        return lease.consumed, unused, lease.reset, lease.limit, lease.duration
+
+    def _expire_replica_leases(self, now: float) -> None:
+        instance = self._instance()
+        now_ms = (
+            instance.engine.clock.now_ms() if instance is not None else 0
+        )
+        with self._lock:
+            dead = [
+                k
+                for k, l in self._leases.items()
+                if now_ms > l.expiry or now_ms > l.reset
+                or (instance is not None and self._owner_changed(l))
+            ]
+            for k in dead:
+                lease = self._leases.pop(k)
+                self._pull_native_locked(lease)
+                self.counters["expired"] += 1
+            if dead:
+                self._n_leases = len(self._leases)
+
+    def _owner_changed(self, lease: _RemoteLease) -> bool:
+        """True when the granting owner no longer owns the key under
+        the current ring (a reshard moved it — the lease's pre-debited
+        credit may describe a bucket that no longer lives there)."""
+        try:
+            owner = self._instance().get_peer(_k2s(lease.key))
+        except Exception:  # noqa: BLE001 — empty pool during teardown
+            return False
+        if owner is None:
+            return False
+        if owner.info.is_owner:
+            return True  # WE own it now: serve from our engine
+        return owner.info.grpc_address != lease.src
+
+    # -- serve probes ---------------------------------------------------
+
+    @property
+    def has_leases(self) -> bool:
+        # guberlint: ok lock — the lock-free idle gate: one stale int
+        # read per batch; a racing install is picked up next request.
+        return self._n_leases > 0
+
+    def try_answer(
+        self, key: bytes, algo: int, behavior: int, hits: int,
+        limit: int, duration: int, now_ms: int,
+    ) -> Optional[Tuple[int, int, int]]:
+        """Answer one peer-owned row from a live remote lease:
+        (status, remaining, reset), or None (caller forwards to the
+        owner).  Exhausted credit falls through — the owner decides;
+        the lease stays for the next refresh."""
+        # guberlint: ok lock — lock-free idle gate (see has_leases).
+        if self._n_leases == 0:
+            return None
+        if (
+            algo != _TOKEN
+            or (behavior & _BREAKERS) != 0
+            or hits < 0
+            or limit <= 0
+        ):
+            return None
+        with self._lock:
+            lease = self._leases.get(key)
+            if lease is None:
+                return None
+            if (
+                now_ms > lease.reset
+                or now_ms > lease.expiry
+                or limit != lease.limit
+                or duration != lease.duration
+            ):
+                return None
+            if lease.native:
+                # A Python-path touch of a delegated key: pull the
+                # drained count back, answer here, re-delegate below.
+                self._pull_native_locked(lease)
+            if hits == 0:
+                out = (_UNDER, lease.rem - lease.consumed, lease.reset)
+            else:
+                avail = lease.credit - lease.consumed
+                admitted, _, _ = token_extras_host(avail, hits, 1)
+                if not admitted:
+                    return None  # exhausted / over-ask: owner decides
+                lease.consumed += hits
+                out = (_UNDER, lease.rem - lease.consumed, lease.reset)
+            self.counters["answered"] += 1
+            led = self._native_ledger()
+            if led is not None and led.remote_install(
+                lease.key, lease.limit, lease.duration, lease.reset,
+                lease.rem, lease.credit, lease.consumed, lease.expiry,
+            ):
+                lease.native = True
+        return out
+
+    def try_answer_columns(self, dec, idx, now_ms: int):
+        """Columnar variant over a decoded wire batch: answer the rows
+        in `idx` (all peer-owned) from remote leases.  ALL-or-nothing
+        and TRANSACTIONAL — a validate pass under one lock checks
+        every row (cumulative per-key consumption for duplicate keys)
+        before a commit pass mutates anything, so a declined batch
+        leaves the leases untouched and the pb-path replay cannot
+        double-debit credit the first attempt already consumed."""
+        # guberlint: ok lock — lock-free idle gate (see has_leases).
+        if self._n_leases == 0:
+            return None
+        rows = idx.tolist()
+        raw = dec.key_buf.tobytes()
+        offs = np.asarray(dec.key_offsets).tolist()
+        algo = np.asarray(dec.algo).tolist()
+        beh = np.asarray(dec.behavior).tolist()
+        hits = np.asarray(dec.hits).tolist()
+        lim = np.asarray(dec.limit).tolist()
+        dur = np.asarray(dec.duration).tolist()
+        n = len(rows)
+        st = np.zeros(n, dtype=np.int64)
+        rem = np.zeros(n, dtype=np.int64)
+        rst = np.zeros(n, dtype=np.int64)
+        with self._lock:
+            # Validate: no mutation (a native pull only moves the
+            # drained count up to Python — non-debiting), tentative
+            # consumption tracked per key across duplicate rows.
+            tentative: Dict[bytes, int] = {}
+            plan: List[tuple] = []
+            for j, row in enumerate(rows):
+                hi = hits[row]
+                if (
+                    algo[row] != _TOKEN
+                    or (beh[row] & _BREAKERS) != 0
+                    or hi < 0
+                    or lim[row] <= 0
+                ):
+                    return None
+                key = raw[offs[row]:offs[row + 1]]
+                lease = self._leases.get(key)
+                if lease is None:
+                    return None
+                if (
+                    now_ms > lease.reset
+                    or now_ms > lease.expiry
+                    or lim[row] != lease.limit
+                    or dur[row] != lease.duration
+                ):
+                    return None
+                if lease.native:
+                    self._pull_native_locked(lease)
+                taken = tentative.get(key, 0)
+                if hi:
+                    avail = lease.credit - lease.consumed - taken
+                    admitted, _, _ = token_extras_host(avail, hi, 1)
+                    if not admitted:
+                        return None
+                    tentative[key] = taken + hi
+                plan.append((j, lease, hi))
+            # Commit: every row validated — drain and answer.
+            for j, lease, hi in plan:
+                if hi:
+                    lease.consumed += hi
+                st[j] = _UNDER
+                rem[j] = lease.rem - lease.consumed
+                rst[j] = lease.reset
+            self.counters["answered"] += n
+            led = self._native_ledger()
+            if led is not None:
+                for key in tentative:
+                    lease = self._leases[key]
+                    if led.remote_install(
+                        lease.key, lease.limit, lease.duration,
+                        lease.reset, lease.rem, lease.credit,
+                        lease.consumed, lease.expiry,
+                    ):
+                        lease.native = True
+        return st, rem, rst
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["promoted_keys"] = len(self._promoted)
+            out["replica_leases"] = len(self._leases)
+        return out

@@ -192,6 +192,7 @@ KNOWN_ENV_KNOBS = (
     "GUBER_TRACE_TAIL_CAP",      # utils/flight_recorder.py: ring size
     "GUBER_HOTKEYS",             # utils/hotkeys.py: top-K sketch on/off
     "GUBER_HOTKEYS_K",           # utils/hotkeys.py: counter capacity
+    "GUBER_HOTKEYS_WINDOW",      # utils/hotkeys.py: rate decay window, s
     "GUBER_NATIVE_EVENTS",       # net/h2_fast.py: C event ring on/off
     "GUBER_NATIVE_EVENTS_CAP",   # net/h2_fast.py: ring record capacity
     "GUBER_NATIVE_EVENTS_INTERVAL",  # utils/native_events.py: drain period
@@ -421,6 +422,34 @@ class DaemonConfig:
     # forfeited rows well inside it.
     drain_deadline: float = 30.0
 
+    # ---- hot-key replication plane (cluster/replication.py;
+    # RESILIENCE.md §11) ----------------------------------------------
+    # Master switch (GUBER_REPLICATION, default on): promote the
+    # measured hottest keys to replicated ownership — the owner splits
+    # the limit into per-replica PRE-DEBITED credit leases, every
+    # replica answers locally, demotion on cooldown.  Off restores
+    # consistent-hash-only routing exactly.
+    replication: bool = True
+    # Observed hits/sec (hotkeys windowed rate) before the owner
+    # promotes a key (GUBER_REPL_PROMOTE_RATE).  Demotion arms at half
+    # this rate.
+    repl_promote_rate: float = 2000.0
+    # Seconds a promoted key must stay below the demote rate before it
+    # converges back to single-owner (GUBER_REPL_COOLDOWN hysteresis).
+    repl_cooldown: float = 10.0
+    # Per-replica credit slice per grant — also the per-replica term
+    # of the N_replicas × lease over-admission bound
+    # (GUBER_REPL_LEASE).
+    repl_lease: int = 2048
+    # Replica lease lifetime, seconds (GUBER_REPL_LEASE_TTL); the
+    # owner refreshes ahead of it, and a broken replica's lease
+    # expires into the bound.
+    repl_lease_ttl: float = 1.0
+    # Promotion/demotion scan period, seconds (GUBER_REPL_INTERVAL).
+    repl_interval: float = 0.5
+    # Max concurrently replicated keys per owner (GUBER_REPL_MAX_KEYS).
+    repl_max_keys: int = 16
+
     # Native decision plane (GUBER_NATIVE_LEDGER, default on): delegate
     # the ledger's exact fast path (sticky over-limit + lease drains)
     # into the C front so hot-key RPCs never enter Python.  Only
@@ -585,6 +614,18 @@ def setup_daemon_config(
         global_serve_window=_env_float_seconds(
             d, "GUBER_GLOBAL_SERVE_WINDOW", 0.002
         ),
+        replication=_env(d, "GUBER_REPLICATION", "1").strip().lower()
+        not in ("0", "false", "no", "off"),
+        repl_promote_rate=float(
+            _env(d, "GUBER_REPL_PROMOTE_RATE") or 2000.0
+        ),
+        repl_cooldown=_env_float_seconds(d, "GUBER_REPL_COOLDOWN", 10.0),
+        repl_lease=_env_int(d, "GUBER_REPL_LEASE", 2048),
+        repl_lease_ttl=_env_float_seconds(
+            d, "GUBER_REPL_LEASE_TTL", 1.0
+        ),
+        repl_interval=_env_float_seconds(d, "GUBER_REPL_INTERVAL", 0.5),
+        repl_max_keys=_env_int(d, "GUBER_REPL_MAX_KEYS", 16),
         membership_epoch_timeout=_env_float_seconds(
             d, "GUBER_MEMBERSHIP_EPOCH_TIMEOUT", 30.0
         ),

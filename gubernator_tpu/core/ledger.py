@@ -394,6 +394,9 @@ class LedgerPlan:
                     e.consumed -= delta
             for s in self.settles:
                 led._pending[s[4]] = s
+                # Back in the pending queue: the _pending guard covers
+                # sticky inserts from here on.
+                led._returning.discard(s[4])
             for a in self.acquires:
                 e = led._items.get(a[4])
                 if e is not None:
@@ -441,6 +444,13 @@ class DecisionLedger:
         # same key pulls its return into the synchronous batch; the
         # flusher drains the rest.
         self._pending: Dict[int, tuple] = {}  # guberlint: guarded-by _lock
+        # Hashes whose credit return is IN FLIGHT on the engine (the
+        # async settle apply runs outside this lock): a sticky-OVER
+        # insert for such a key would capture the device's PRE-return
+        # (OVER, remaining=0) snapshot and then answer OVER until the
+        # reset while the returned credit sits unservable — the
+        # small-hot-bucket starvation the flashcrowd canary surfaced.
+        self._returning: set = set()  # guberlint: guarded-by _lock
         self._lock = threading.Lock()
         # Counters (exported via utils.metrics + bench artifacts).
         # _Entry fields ride the same lock: entries are only reachable
@@ -508,6 +518,61 @@ class DecisionLedger:
                     self._undelegate_locked(e)
             self._native = None
             plane.clear()
+
+    def native_plane(self):
+        """The attached native decision plane (None when detached).
+        The replication plane (cluster/replication.py) probes this to
+        decide whether replica-held remote leases can ride the C fast
+        path; it never caches the handle — every native op goes
+        through remote_install/remote_pull below, under this ledger's
+        lock, so the plane cannot be freed out from under a call."""
+        with self._lock:
+            return self._native
+
+    def remote_install(
+        self,
+        key: bytes,
+        limit: int,
+        duration: int,
+        reset: int,
+        rem: int,
+        credit: int,
+        consumed: int,
+        expiry: int,
+    ) -> bool:
+        """Install a replica-held REMOTE lease (a credit slice granted
+        by another node's owner — cluster/replication.py) into the
+        native plane, so promoted keys answer inside the C connection
+        threads on replicas too.  The key is foreign by construction
+        (owners never grant to themselves), so it collides with no
+        ledger entry; the ledger only provides the locked bridge."""
+        with self._lock:
+            if self._native is None:
+                return False
+            self._native.set_clock_offset(self.engine.clock.now_ms())
+            return bool(
+                self._native.install_lease(
+                    key, limit, duration, reset, rem, credit, consumed,
+                    expiry,
+                )
+            )
+
+    def remote_pull(self, key: bytes) -> Optional[int]:
+        """Pull a remote lease back from the plane; returns the drained
+        consumed count (None when absent/detached).  Linearizes every
+        native answer for the key before the caller's next step —
+        the replication plane settles off this exact count."""
+        with self._lock:
+            if self._native is None:
+                return None
+            res = self._native.pull(key)
+            if res is None or res[0] != 2:
+                return None
+            # The CALLER credits hotkeys with the drained delta — it
+            # alone knows the consumed count at the last install, and
+            # crediting the total here would double-count across
+            # pull/re-install cycles.
+            return int(res[1])
 
     def _undelegate_locked(self, e: _Entry) -> None:
         """Pull a delegated lease back: the plane atomically stops
@@ -662,6 +727,15 @@ class DecisionLedger:
                 admitted, _, _ = token_extras_host(avail, hi, 1)
                 if admitted:
                     e.consumed += hi
+                    # Activity extends the lease: the TTL exists to
+                    # reclaim IDLE credit, not to churn a hot key
+                    # through revoke/re-acquire cycles — each async
+                    # revoke opens a window where a racing hit can
+                    # flip the device bucket sticky-OVER while the
+                    # unused credit is mid-return, starving a
+                    # small-limit bucket until its reset (the
+                    # flashcrowd canary's failure shape).
+                    e.expiry = now + self.lease_ttl_ms
                     plan._consumed_log.append((h, hi))
                     answered_rows.append(row)
                     ans_st.append(_UNDER)
@@ -696,8 +770,17 @@ class DecisionLedger:
                 # engine rows — in a serialized history the acquisition
                 # then never over-asks, so it cannot perturb state (the
                 # engine rejects over-asks without consuming anyway).
+                # Take at most HALF of it: between this debit landing
+                # and the lease installing, concurrent plans still fall
+                # through to the engine, and a near-total debit leaves
+                # a sliver racing hits can exhaust — flipping the
+                # bucket's stored status sticky-OVER while the credit
+                # is in flight, which starves a small-limit bucket
+                # until its reset (the flashcrowd canary's failure
+                # shape; big buckets are unaffected — lease_size caps
+                # first).
                 avail = e.rem_hint - plan._batch_hits.get(h, 0)
-                acq = min(self.lease_size, avail)
+                acq = min(self.lease_size, avail // 2)
                 if acq < 1:
                     continue
                 e.acq_inflight = t_mono
@@ -759,10 +842,13 @@ class DecisionLedger:
                 e.rem_hint = -1
         # Pull this key's pending return (if any) into the synchronous
         # batch so the engine sees the reconciled state for this
-        # request; drop it if its bucket window already ended.
+        # request; drop it if its bucket window already ended.  The
+        # key is marked returning until this plan's learn: a racing
+        # plan's fall must not sticky-insert off the pre-return state.
         s = self._pending.pop(h, None)
         if s is not None and now <= s[6]:
             plan.settles.append(s)
+            self._returning.add(h)
 
     def _bump_locked(self, e: _Entry, now: int) -> None:
         if now - e.win_start > self.hot_window_ms:
@@ -796,6 +882,7 @@ class DecisionLedger:
                 (e.key, -unused, e.limit, e.duration, h,
                  time.monotonic(), e.reset)
             )
+            self._returning.add(h)
         # The next acquisition sizes off the post-revoke remaining.
         e.rem_hint = e.rem - e.consumed
         self.leases_revoked += 1
@@ -830,12 +917,20 @@ class DecisionLedger:
         rem_l = np.asarray(rem).tolist()
         rst_l = np.asarray(rst).tolist()
         with self._lock:
+            items = self._items
             # Returns (negative hits) always land — the engine's
-            # consume branch adds them back unconditionally.
+            # consume branch adds them back unconditionally.  Each
+            # applied return also clears its in-flight mark and
+            # demotes any sticky-OVER a racing plan installed off the
+            # pre-return snapshot (the recorded OVER no longer binds).
             for s in plan.settles:
                 self.settles += 1
                 self.settle_lag.observe(time.monotonic() - s[5])
-            items = self._items
+                hs = s[4]
+                self._returning.discard(hs)
+                es = items.get(hs)
+                if es is not None and es.kind == _K_OVER and es.key == s[0]:
+                    self._demote_locked(es, hs)
             dec = plan.dec
             hh = np.asarray(dec.fnv1a)
             lim_a = np.asarray(dec.limit)
@@ -889,6 +984,13 @@ class DecisionLedger:
                         # A plan raced in after us (possibly a config
                         # change): our OVER observation may describe a
                         # replaced bucket — insert nothing.
+                        continue
+                    if h in self._pending or h in self._returning:
+                        # A revoked lease's unused credit is queued or
+                        # mid-apply for this key: the (OVER, 0) we saw
+                        # is the pre-return snapshot, not a sticky
+                        # state — inserting it would starve the bucket
+                        # until its reset.
                         continue
                     if not plan.fall_dur_ok[j]:
                         # Duration changed (or first observation): the
@@ -1084,36 +1186,58 @@ class DecisionLedger:
 
     def _apply_settles(self, rows: List[tuple]) -> None:
         engine = self.engine
-        for lo in range(0, len(rows), 4096):
-            chunk = rows[lo:lo + 4096]
-            m = len(chunk)
-            cols = (
-                [s[0] for s in chunk],
-                np.zeros(m, dtype=np.int32),
-                np.zeros(m, dtype=np.int32),
-                np.asarray([s[1] for s in chunk], dtype=np.int64),
-                np.asarray([s[2] for s in chunk], dtype=np.int64),
-                np.asarray([s[3] for s in chunk], dtype=np.int64),
-                np.zeros(m, dtype=np.int64),
-            )
-            try:
-                if self._count_kw:
-                    # Returns are reconciliation, not decisions — keep
-                    # them out of the decision counters where the
-                    # engine supports it.
-                    engine.apply_columnar(*cols, count_decisions=False)
-                else:
-                    engine.apply_columnar(*cols)
-            except Exception:  # noqa: BLE001
-                from gubernator_tpu.utils.metrics import record_swallowed
+        # Mark every key's return as in flight so a racing plan's
+        # fall-through cannot install a sticky OVER off the device's
+        # pre-return snapshot (see _returning above); afterwards,
+        # demote any sticky entry that slipped in before the mark —
+        # its recorded (OVER, 0) no longer binds.
+        with self._lock:
+            self._returning.update(s[4] for s in rows)
+        try:
+            for lo in range(0, len(rows), 4096):
+                chunk = rows[lo:lo + 4096]
+                m = len(chunk)
+                cols = (
+                    [s[0] for s in chunk],
+                    np.zeros(m, dtype=np.int32),
+                    np.zeros(m, dtype=np.int32),
+                    np.asarray([s[1] for s in chunk], dtype=np.int64),
+                    np.asarray([s[2] for s in chunk], dtype=np.int64),
+                    np.asarray([s[3] for s in chunk], dtype=np.int64),
+                    np.zeros(m, dtype=np.int64),
+                )
+                try:
+                    if self._count_kw:
+                        # Returns are reconciliation, not decisions —
+                        # keep them out of the decision counters where
+                        # the engine supports it.
+                        engine.apply_columnar(*cols, count_decisions=False)
+                    else:
+                        engine.apply_columnar(*cols)
+                except Exception:  # noqa: BLE001
+                    from gubernator_tpu.utils.metrics import record_swallowed
 
-                record_swallowed("ledger.return_apply")
-                log.exception("ledger return apply failed (%d rows)", m)
-                continue
+                    record_swallowed("ledger.return_apply")
+                    log.exception(
+                        "ledger return apply failed (%d rows)", m
+                    )
+                    continue
+                with self._lock:
+                    self.settles += m
+                for s in chunk:
+                    self.settle_lag.observe(time.monotonic() - s[5])
+        finally:
             with self._lock:
-                self.settles += m
-            for s in chunk:
-                self.settle_lag.observe(time.monotonic() - s[5])
+                for s in rows:
+                    h = s[4]
+                    self._returning.discard(h)
+                    e = self._items.get(h)
+                    if (
+                        e is not None
+                        and e.kind == _K_OVER
+                        and e.key == s[0]
+                    ):
+                        self._demote_locked(e, h)
 
     # ------------------------------------------------------------------
 

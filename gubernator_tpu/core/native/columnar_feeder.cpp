@@ -377,7 +377,16 @@ void serve_loop(Feeder* f) {
       std::unique_lock<std::mutex> lock(f->mu);
       f->cv.wait(lock, [&] {
         if (f->closing.load() || f->kick.load()) return true;
-        return cur_rows(f->slots[f->open.load()].cursor.load()) != 0;
+        if (cur_rows(f->slots[f->open.load()].cursor.load()) != 0)
+          return true;
+        // A sealed NON-open window must also wake the loop: a flush
+        // racing the rotation (seal lands just after `open` moved
+        // past the slot) or a consumed kick would otherwise strand
+        // its rows until the next pack — the PR-12 teardown
+        // row-conservation race.
+        for (int64_t i = 0; i < f->n_slots; ++i)
+          if (f->slots[i].cursor.load() & kClosedBit) return true;
+        return false;
       });
       f->kick.store(false);
     }
@@ -411,9 +420,14 @@ void serve_loop(Feeder* f) {
       if (!(ncur & kClosedBit) && cur_rows(ncur) == 0 && next != idx)
         f->open.store(next);
       serve_window(f, idx);
-      // The loop re-checks the open slot every iteration, so a window
-      // sealed while rotation was blocked is picked up next pass —
-      // nothing strands.
+      // Sweep sealed windows the open cursor already rotated past
+      // (a flush can seal ANY slot with claims, not just the open
+      // one) — serving is single-consumer, so serving them out of
+      // ring order is safe, and without the sweep they would wait on
+      // the next wake instead of draining now.
+      for (int64_t i = 0; i < f->n_slots; ++i)
+        if (i != idx && (f->slots[i].cursor.load() & kClosedBit))
+          serve_window(f, i);
     }
   }
   // Drain-then-close: serve every window that still has claims so no
@@ -620,20 +634,28 @@ int64_t cf_pack(void* handle, const uint8_t* body, int64_t len,
 // been served and recycled (tests/bench; NOT part of the serve path).
 void cf_flush(void* handle) {
   auto* f = static_cast<Feeder*>(handle);
-  for (int64_t i = 0; i < f->n_slots; ++i) {
-    CfWindow& w = f->slots[i];
-    const uint64_t cur = w.cursor.load();
-    if (!(cur & kClosedBit) && cur_rows(cur) != 0)
-      w.cursor.fetch_or(kClosedBit);
-  }
-  wake_serve(f);
   // Bounded wait (~5 s): a wedged Python callback must not hang the
-  // caller forever; tests assert on the stats either way.
+  // caller forever; tests assert on the stats either way.  The
+  // seal scan repeats INSIDE the wait loop: a producer whose claim
+  // landed after one scan (the cf_pack CAS racing the scan's load)
+  // is observed and sealed by the next pass, so at quiesce — the
+  // teardown contract — no RPC can remain packed-but-unserved.  The
+  // serve thread is re-woken every iteration too: a kick consumed by
+  // an earlier pass must not strand a window this flush just sealed.
   for (int spins = 0; spins < 5000 && !f->closing.load(); ++spins) {
     bool busy = false;
-    for (int64_t i = 0; i < f->n_slots; ++i)
-      if (f->slots[i].cursor.load() & kClosedBit) busy = true;
+    for (int64_t i = 0; i < f->n_slots; ++i) {
+      CfWindow& w = f->slots[i];
+      const uint64_t cur = w.cursor.load();
+      if (!(cur & kClosedBit) && cur_rows(cur) != 0) {
+        w.cursor.fetch_or(kClosedBit);
+        busy = true;
+      } else if (cur & kClosedBit) {
+        busy = true;
+      }
+    }
     if (!busy) return;
+    wake_serve(f);
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
 }

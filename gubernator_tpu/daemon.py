@@ -64,6 +64,7 @@ class Daemon:
         self._tls_bundle = None
         self._discovery = None
         self.membership = None
+        self.replication = None
         self._closed = False
 
     # ------------------------------------------------------------------
@@ -201,6 +202,26 @@ class Daemon:
             drain_deadline=conf.drain_deadline,
         )
         self.instance.membership = self.membership
+        # Hot-key replication plane (cluster/replication.py): observed
+        # load reshapes ownership — the hottest measured keys promote
+        # to replicated credit leases, demote on cooldown.  Needs the
+        # hot-key sketch for its rate source; inert without it.
+        if conf.replication and self.instance.hotkeys is not None:
+            from gubernator_tpu.cluster.replication import (
+                ReplicationManager,
+            )
+
+            self.replication = ReplicationManager(
+                self,
+                promote_rate=conf.repl_promote_rate,
+                cooldown=conf.repl_cooldown,
+                lease=conf.repl_lease,
+                lease_ttl=conf.repl_lease_ttl,
+                interval=conf.repl_interval,
+                max_keys=conf.repl_max_keys,
+            )
+            self.instance.replication = self.replication
+            self.replication.start()
         # Tail flight recorder (utils/flight_recorder.py): when the
         # in-memory tracer is live (GUBER_TRACING=memory or a harness
         # set_tracer), retain full span trees of tail decisions for
@@ -504,6 +525,16 @@ class Daemon:
             return {}
         return self.membership.stats()
 
+    def replication_stats(self) -> dict:
+        """This node's hot-key replication view: promotion/demotion
+        counters, grant traffic, credit accounting, and the live
+        promoted/replica-lease key counts — the same numbers /metrics
+        exports as gubernator_replication_* (bench artifacts embed
+        it, like membership_stats())."""
+        if self.replication is None:
+            return {}
+        return self.replication.stats()
+
     def drain(self, deadline: Optional[float] = None) -> dict:
         """Planned leave: ship EVERY held bucket to its owner under
         the ring-without-self (cluster/membership.py), bounded by
@@ -548,6 +579,11 @@ class Daemon:
             # Join any in-flight epoch transition before tearing the
             # engine down under its snapshot/ship pass.
             self.membership.close()
+        if self.replication is not None:
+            # Demote what we promoted (returns replica credit while
+            # peers are still up) and drop replica leases BEFORE the
+            # native front frees the decision plane below.
+            self.replication.close()
         if self.instance is not None and self.instance.native_events is not None:
             # Stop the drain thread BEFORE the front frees the ring
             # (single-consumer contract; a drain into a freed ring is

@@ -46,6 +46,8 @@ class PeersV1Servicer(Protocol):
 
     def TransferBuckets(self, request, context) -> bytes: ...
 
+    def ReplicateKeys(self, request, context) -> bytes: ...
+
 
 def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
     return grpc.unary_unary_rpc_method_handler(
@@ -103,6 +105,13 @@ def add_peers_v1_to_server(servicer: PeersV1Servicer, server: grpc.Server) -> No
                     # messages (no grpc_python_plugin in this image).
                     "TransferBuckets": _unary_raw(
                         servicer.TransferBuckets
+                    ),
+                    # Hot-key replication protocol
+                    # (cluster/replication.py): raw JSON grant/revoke
+                    # messages for replica credit leases, same wire
+                    # idiom as the handoff plane.
+                    "ReplicateKeys": _unary_raw(
+                        servicer.ReplicateKeys
                     ),
                 },
             ),

@@ -233,3 +233,20 @@ class GrpcPeersV1Adapter:
                 f"malformed bucket transfer: {e}",
             )
         return b""
+
+    def ReplicateKeys(self, request, context):
+        with _handler_span("rpc.replicate_keys", context):
+            return self._replicate_keys(request, context)
+
+    def _replicate_keys(self, request, context):
+        # Hot-key replication (cluster/replication.py): install/revoke
+        # replica credit leases.  Raw JSON in, raw JSON out (the
+        # response carries superseded leases' credit accounting for
+        # the owner's reconciliation).
+        try:
+            return self.instance.receive_replication(bytes(request))
+        except (ValueError, KeyError, IndexError, TypeError) as e:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"malformed replication message: {e}",
+            )

@@ -41,6 +41,7 @@ from gubernator_tpu.cluster.peer_client import PeerClient, PeerError
 from gubernator_tpu.config import BehaviorConfig, Config
 from gubernator_tpu.types import (
     MAX_BATCH_SIZE,
+    Algorithm,
     Behavior,
     HealthCheckResp,
     PeerInfo,
@@ -58,6 +59,18 @@ log = logging.getLogger("gubernator_tpu.service")
 _GLOBAL_I = int(Behavior.GLOBAL)
 _MULTI_REGION_I = int(Behavior.MULTI_REGION)
 _SKETCH_I = int(Behavior.SKETCH)
+_TOKEN_I = int(Algorithm.TOKEN_BUCKET)
+# Rows carrying these can never be answered from replicated leased
+# credit: the ledger's precondition breakers, plus MULTI_REGION (a
+# replica answer would skip the owner's region-hit queueing) and
+# SKETCH (node-local approximate limiter — ownership doesn't apply).
+# cluster/replication.py pins the same set on its serve probes.
+_LEASE_BREAKERS = (
+    int(Behavior.DURATION_IS_GREGORIAN)
+    | int(Behavior.RESET_REMAINING)
+    | _MULTI_REGION_I
+    | _SKETCH_I
+)
 
 # Behaviors that need the dataclass path: GLOBAL (status cache + async
 # queues), MULTI_REGION (region queues), Gregorian durations (per-item
@@ -341,6 +354,10 @@ class V1Instance:
             # GUBER_DEGRADED_LOCAL).  Each one is availability bought
             # with bounded over-admission — RESILIENCE.md.
             "degraded_answers": 0,
+            # Peer-owned items answered LOCALLY from a replica credit
+            # lease (cluster/replication.py) — the forward hops the
+            # hot-key replication plane removed.
+            "replicated_local": 0,
         }
         # Ownership-handoff traffic (cluster/handoff.py), exported as
         # gubernator_handoff_keys{event}: rows shipped to new owners,
@@ -436,6 +453,12 @@ class V1Instance:
         from gubernator_tpu.utils import hotkeys as _hotkeys
 
         self.hotkeys = _hotkeys.from_env()
+        # Hot-key replication plane (cluster/replication.py), attached
+        # by the daemon: peer-owned keys with a live replica lease
+        # answer locally from pre-debited credit — zero forward hops.
+        # None for bare library instances (one attribute check per
+        # batch when absent).
+        self.replication = None
         if self.ledger is not None and self.hotkeys is not None:
             # Native-plane drains surface per-key counts only at pull
             # time (core/ledger._undelegate_locked) — credit them so
@@ -528,8 +551,21 @@ class V1Instance:
         # 2. one vectorized owner lookup for the batch
         keys = [requests[i].hash_key() for i in candidates]
         if self.hotkeys is not None and keys:
-            self.hotkeys.offer_many(
-                (k.encode(), max(requests[i].hits, 1))
+            self.hotkeys.offer_many_params(
+                (
+                    k.encode(),
+                    max(requests[i].hits, 1),
+                    # Lease-sizing aux: only rows the lease algebra
+                    # could cover stamp their params (the promotion
+                    # plane skips keys whose last limit reads 0).
+                    requests[i].limit
+                    if (
+                        int(requests[i].algorithm) == _TOKEN_I
+                        and not int(requests[i].behavior) & _LEASE_BREAKERS
+                    )
+                    else 0,
+                    requests[i].duration,
+                )
                 for k, i in zip(keys, candidates)
             )
         with self._peer_lock:
@@ -543,11 +579,38 @@ class V1Instance:
         forward: Dict[str, Tuple[PeerClient, List[int]]] = {}
         global_items: List[Tuple[int, PeerClient]] = []
         global_miss: List[Tuple[int, PeerClient]] = []
-        for i, owner in zip(candidates, owners):
+        repl = self.replication
+        repl_live = repl is not None and repl.has_leases
+        for k, i, owner in zip(keys, candidates, owners):
             r = requests[i]
             if owner is None or owner.info.is_owner:
                 local_idx.append(i)
-            elif int(r.behavior) & _GLOBAL_I:
+                continue
+            if repl_live:
+                # Hot-key replication override (cluster/replication.py):
+                # a peer-owned key with a live replica lease answers
+                # HERE from pre-debited credit — no forward hop, and
+                # (for GLOBAL items) no async hit queue either: the
+                # owner already debited these hits at grant time.
+                # (`k` is the hash key step 2 already built.)
+                ans = repl.try_answer(
+                    k.encode(), int(r.algorithm),
+                    int(r.behavior), r.hits, r.limit, r.duration,
+                    now_ms,
+                )
+                if ans is not None:
+                    st, rem, rst = ans
+                    self.counters["replicated_local"] += 1
+                    responses[i] = RateLimitResp(
+                        status=Status(st), limit=r.limit, remaining=rem,
+                        reset_time=rst,
+                        metadata={
+                            "owner": owner.info.grpc_address,
+                            "replicated": "true",
+                        },
+                    )
+                    continue
+            if int(r.behavior) & _GLOBAL_I:
                 # reference: gubernator.go:276-287, 426-466
                 global_items.append((i, owner))
             else:
@@ -830,19 +893,71 @@ class V1Instance:
     # DecisionEngine.apply_columnar — VERDICT r1 item 2: the served path
     # must be the same program as the benched one).
 
+    def _owned_mask(self, dec):
+        """Per-row local-ownership bool mask for a decoded wire batch,
+        or None when the picker is empty (single-node: everything is
+        ours)."""
+        with self._peer_lock:
+            picker = self.local_picker
+        n_peers = picker.size()
+        if n_peers == 0:
+            return None
+        if n_peers == 1:
+            return np.full(dec.n, bool(picker.peers()[0].info.is_owner))
+        owners = picker.get_batch_dual_hashed(dec.fnv1, dec.fnv1a)
+        return np.fromiter((o.info.is_owner for o in owners), bool, dec.n)
+
     def all_locally_owned(self, dec) -> bool:
         """True when every key in a decoded wire batch is owned by this
         node (the columnar fast paths' gate; shared with the native h2
         front so the ownership semantics cannot drift between them)."""
-        with self._peer_lock:
-            picker = self.local_picker
-        n_peers = picker.size()
-        if n_peers == 1:
-            return bool(picker.peers()[0].info.is_owner)
-        if n_peers > 1:
-            owners = picker.get_batch_dual_hashed(dec.fnv1, dec.fnv1a)
-            return all(o.info.is_owner for o in owners)
-        return True
+        owned = self._owned_mask(dec)
+        return owned is None or bool(owned.all())
+
+    def _serve_wire_replicated(self, dec) -> Optional[bytes]:
+        """Columnar serve of an all-peer-owned batch from replica
+        credit leases (cluster/replication.py): every row must have a
+        live lease covering it, or the whole batch declines to the pb
+        path (which answers leased rows there and forwards the rest).
+        The common shape — a flash crowd's single-hot-key RPCs — is
+        all-or-nothing by construction."""
+        repl = self.replication
+        if repl is None or not repl.has_leases:
+            return None
+        from gubernator_tpu.net import wire_codec
+
+        now_ms = self.engine.clock.now_ms()
+        idx = np.arange(dec.n, dtype=np.int64)
+        out = repl.try_answer_columns(dec, idx, now_ms)
+        if out is None:
+            return None
+        st, rem, rst = out
+        self.counters["replicated_local"] += dec.n
+        self.counters["columnar"] += dec.n
+        self._offer_hotkeys(dec)
+        return wire_codec.encode_resps(
+            st.astype(np.int32), np.asarray(dec.limit, dtype=np.int64),
+            rem, rst,
+        )
+
+    def _offer_hotkeys(self, dec, idx=None) -> None:
+        """Columnar hot-key accounting with the lease-sizing aux
+        params: rows the lease algebra could never cover stamp limit 0
+        so the promotion plane skips them."""
+        hk = self.hotkeys
+        if hk is None:
+            return
+        lim = np.asarray(dec.limit)
+        elig = (
+            (np.asarray(dec.algo) == _TOKEN_I)
+            & ((np.asarray(dec.behavior) & _LEASE_BREAKERS) == 0)
+            & (lim > 0)
+        )
+        hk.offer_columns(
+            dec.key_buf, dec.key_offsets, dec.hits, idx=idx,
+            hashes=dec.fnv1a, limit=np.where(elig, lim, 0),
+            duration=dec.duration,
+        )
 
     def serve_decoded_local(self, dec):
         """Shared post-decode columnar serve for the native fronts —
@@ -865,11 +980,7 @@ class V1Instance:
         # listener's forward path.
         if not self.all_locally_owned(dec):
             return None
-        if self.hotkeys is not None:
-            self.hotkeys.offer_columns(
-                dec.key_buf, dec.key_offsets, dec.hits,
-                hashes=dec.fnv1a,
-            )
+        self._offer_hotkeys(dec)
         if self.ledger is not None:
             return self._serve_decoded_ledger(dec)
         from gubernator_tpu.core.engine import PackedKeys
@@ -974,15 +1085,17 @@ class V1Instance:
                 return None
             return self._serve_wire_global(dec, check_ownership)
         if check_ownership:
-            if not self.all_locally_owned(dec):
-                return None
+            owned = self._owned_mask(dec)
+            if owned is not None and not bool(owned.all()):
+                if not owned.any():
+                    # Entirely peer-owned: a flash-crowd hot-key batch
+                    # may answer from replica leases without touching
+                    # the pb path at all.
+                    return self._serve_wire_replicated(dec)
+                return None  # mixed ownership → pb path partitions it
             self.counters["local"] += dec.n
         self.counters["columnar"] += dec.n
-        if self.hotkeys is not None:
-            self.hotkeys.offer_columns(
-                dec.key_buf, dec.key_offsets, dec.hits,
-                hashes=dec.fnv1a,
-            )
+        self._offer_hotkeys(dec)
 
         if self.ledger is not None:
             return self._serve_columnar_ledger(dec)
@@ -1296,11 +1409,7 @@ class V1Instance:
                 seq=apply_seq,
             )
         self.counters["columnar"] += n
-        if self.hotkeys is not None:
-            self.hotkeys.offer_columns(
-                dec.key_buf, dec.key_offsets, dec.hits,
-                hashes=dec.fnv1a,
-            )
+        self._offer_hotkeys(dec)
         if owner_strs:
             return wire_codec.encode_resps_owner(
                 status, limit, remaining, reset, owner_meta_idx, owner_strs
@@ -1416,6 +1525,20 @@ class V1Instance:
         from gubernator_tpu.cluster.handoff import receive_transfer
 
         return receive_transfer(self, raw)
+
+    def receive_replication(self, raw: bytes) -> bytes:
+        """Hot-key replication receiver (PeersV1/ReplicateKeys): install
+        or revoke replica credit leases granted by a key's owner;
+        returns the JSON response bytes carrying superseded leases'
+        (consumed, unused) for the owner's reconciliation
+        (cluster/replication.py documents the protocol and its
+        N_replicas × lease over-admission bound)."""
+        repl = self.replication
+        if repl is None:
+            # No replication plane on this node: the owner reads this
+            # as a failed grant and returns the credit immediately.
+            return b'{"disabled":true,"returns":[]}'
+        return repl.receive(raw)
 
     def health_check(self) -> HealthCheckResp:
         """Aggregate recent peer errors. reference: gubernator.go:562-619."""

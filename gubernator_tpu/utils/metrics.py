@@ -219,6 +219,9 @@ class InstanceCollector(Collector):
         c.add_metric(["forward"], inst.counters["forward"])
         c.add_metric(["global"], inst.counters["global"])
         c.add_metric(["sketch"], inst.counters.get("sketch", 0))
+        c.add_metric(
+            ["replicated"], inst.counters.get("replicated_local", 0)
+        )
         yield c
 
         c = CounterMetricFamily(
@@ -325,6 +328,59 @@ class InstanceCollector(Collector):
             c.add_metric(["shipped"], hoff["shipped"])
             c.add_metric(["forfeited"], hoff["forfeited"])
             c.add_metric(["received"], hoff["received"])
+            yield c
+
+        # ---- hot-key replication plane (cluster/replication.py;
+        # RESILIENCE.md §11): promotion/demotion lifecycle, grant
+        # traffic, replica-answered decisions, and credit accounting
+        # under the N_replicas × lease bound.
+        repl = getattr(inst, "replication", None)
+        if repl is not None:
+            rs = repl.stats()
+            g = GaugeMetricFamily(
+                "gubernator_replication_keys",
+                "Live hot-key replication state by role: promoted = "
+                "keys THIS node (as owner) currently replicates; "
+                "replica_leases = remote credit leases held here.",
+                labels=["role"],
+            )
+            g.add_metric(["promoted"], rs["promoted_keys"])
+            g.add_metric(["replica_leases"], rs["replica_leases"])
+            yield g
+            c = CounterMetricFamily(
+                "gubernator_replication_events",
+                "Hot-key replication lifecycle events by kind "
+                "(promoted | demoted | grants_sent | grants_failed | "
+                "grants_received | revokes_received | stale_dropped | "
+                "expired).",
+                labels=["event"],
+            )
+            for ev_name in (
+                "promoted", "demoted", "grants_sent", "grants_failed",
+                "grants_received", "revokes_received", "stale_dropped",
+                "expired",
+            ):
+                c.add_metric([ev_name], rs[ev_name])
+            yield c
+            c = CounterMetricFamily(
+                "gubernator_replication_answered",
+                "Peer-owned decisions answered locally from a replica "
+                "credit lease (the forward hops replication removed; "
+                "natively answered drains fold in at pull time).",
+            )
+            c.add_metric([], rs["answered"])
+            yield c
+            c = CounterMetricFamily(
+                "gubernator_replication_credit",
+                "Replication credit flow in hits, by event: granted "
+                "(pre-debited onto replica leases), returned (unused "
+                "credit settled back), forfeited (lost to unreachable "
+                "replicas — bounded by N_replicas × lease per window).",
+                labels=["event"],
+            )
+            c.add_metric(["granted"], rs["credit_granted"])
+            c.add_metric(["returned"], rs["credit_returned"])
+            c.add_metric(["forfeited"], rs["credit_forfeited"])
             yield c
 
         c = CounterMetricFamily(

@@ -135,6 +135,25 @@ CONFIGS: dict[str, dict] = {
         "BENCH_KEYS": "1",
         "BENCH_CAPACITY": str(1 << 17),
     },
+    # Flash crowd through the hot-key replication plane (ISSUE 13 /
+    # RESILIENCE §11): a time-varying zipf whose hot set rotates
+    # mid-run across a 3-node cluster — promotion keeps every node
+    # answering hot keys locally; the _repl0 arm below is the
+    # consistent-hash-only A/B.  A finite-limit canary key checks the
+    # N_replicas x lease admission bound in the same run.
+    "flashcrowd": {
+        "BENCH_MODE": "flashcrowd",
+        "BENCH_KEYS": "1000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_SECONDS": "12",
+    },
+    "flashcrowd_repl0": {
+        "BENCH_MODE": "flashcrowd",
+        "BENCH_KEYS": "1000",
+        "BENCH_CAPACITY": str(1 << 17),
+        "BENCH_SECONDS": "12",
+        "BENCH_FLASH_REPL": "0",
+    },
     # Connection scale through the epoll event front (PERF.md §26):
     # 1k→10k held connections from the epoll connscale client, with
     # the thread-per-conn A/B at equal load and the feeder-ring-wait

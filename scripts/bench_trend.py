@@ -148,6 +148,28 @@ def _extract(data: dict) -> dict | None:
                 out["server_fd_peak"] = top["server_fd_peak"]
             if top.get("reactors") is not None:
                 out["reactors"] = top["reactors"]
+    # Flash-crowd replication artifacts (flashcrowd mode): fold the
+    # hot-set-rotation p99 vs steady p99 (the flat-while-moving bar),
+    # the replica-answered count, and the canary key's measured
+    # over-admission against the N_replicas x lease bound.
+    if data.get("rotation_p99_ms") is not None:
+        out["rotation_p99_ms"] = data["rotation_p99_ms"]
+        if data.get("steady_p99_ms") is not None:
+            out["steady_p99_ms"] = data["steady_p99_ms"]
+        if data.get("rotation_over_steady") is not None:
+            out["rotation_over_steady"] = data["rotation_over_steady"]
+        repl = data.get("replication")
+        if isinstance(repl, dict):
+            if repl.get("answered") is not None:
+                out["replicated_answered"] = repl["answered"]
+            if repl.get("promoted") is not None:
+                out["keys_promoted"] = repl["promoted"]
+        can = data.get("canary")
+        if isinstance(can, dict) and can.get("over_admission") is not None:
+            out["over_admission"] = can["over_admission"]
+            out["over_admission_bound"] = can.get("bound")
+        if data.get("errors") is not None:
+            out["errors"] = data["errors"]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly seven things:
+# Runs exactly eight things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -32,13 +32,18 @@
 #      Pallas decision kernel bit-equal to models/spec.py + the
 #      single-dispatch-per-batch invariant — the kernel stays
 #      CI-enforced without TPU hardware (PERF.md section 24);
-#   6. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   6. the replication smoke (tests/test_replication.py promote/demote
+#      round trip on a live 3-node cluster): a measured-hot key
+#      promotes to replica credit leases, answers go local, cooldown
+#      demotes and the credit reconciles — the hot-key adaptive
+#      ownership gate (RESILIENCE.md section 11), 120 s wall budget;
+#   7. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants; the
 #      multi-cycle soaks are @slow);
-#   7. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#   8. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -133,6 +138,24 @@ if [ "${PAR_MS}" -gt 120000 ]; then
   echo "fused parity blew its 120 s wall budget — the interpret-mode" >&2
   echo "kernel must stay cheap enough to gate every commit without" >&2
   echo "TPU hardware" >&2
+  exit 1
+fi
+
+echo "=== replication smoke (promote/demote round trip) ===" >&2
+REPL_T0=$(date +%s%N)
+if ! timeout -k 10 150 env JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_replication.py::test_promote_demote_smoke \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly; then
+  echo "replication smoke: the hot-key promote/demote round trip broke" >&2
+  echo "(tests/test_replication.py; RESILIENCE.md section 11)" >&2
+  exit 1
+fi
+REPL_MS=$(( ($(date +%s%N) - REPL_T0) / 1000000 ))
+echo "replication smoke: ${REPL_MS} ms (budget 120000 ms)" >&2
+if [ "${REPL_MS}" -gt 120000 ]; then
+  echo "replication smoke blew its 120 s budget — promotion must engage" >&2
+  echo "within seconds on a test-timescale cluster or the plane is" >&2
+  echo "too slow to matter in a real flash crowd" >&2
   exit 1
 fi
 

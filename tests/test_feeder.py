@@ -329,6 +329,81 @@ def test_teardown_drains_claimed_windows():
     assert packed > 0
 
 
+def test_flush_observes_late_claims_row_conservation():
+    """Regression for the PR-12 teardown flake: a cf_pack claim
+    landing AFTER cf_flush's seal scan (or a window sealed by a flush
+    just after the serve loop's rotation passed it) could leave one
+    RPC packed-but-unserved past the flush's bounded wait.  The fix
+    repeats the seal scan inside the wait loop, re-kicks the serve
+    thread each iteration, and makes the serve loop sweep sealed
+    non-open windows — so at quiesce every packed row is served.
+    Producers hammer packs while other threads hammer flushes; the
+    final flush must account for every row."""
+    from gubernator_tpu.core.native_plane import NativeColumnarFeeder
+
+    served = [0]
+    lock = threading.Lock()
+
+    def handler(slot, n_rows, n_rpcs, key_bytes):
+        with lock:
+            served[0] += n_rows
+        slot.out_status[:n_rows] = 0
+        slot.out_limit[:n_rows] = 9
+        slot.out_remaining[:n_rows] = 8
+        slot.out_reset[:n_rows] = 0
+        slot.rpc_status[:n_rpcs] = 0
+        return 0
+
+    # Small windows + a tiny group-commit so seals, rotations, and
+    # flushes interleave densely.
+    feeder = NativeColumnarFeeder(
+        n_slots=3, max_rows=64, flush_rows=8, window_s=0.0005,
+        window_handler=handler,
+    )
+    try:
+        body = _payload(
+            [dict(name="fl", unique_key=f"y{i}abc", hits=1, limit=9,
+                  duration=1000) for i in range(4)]
+        )
+        n_packers, reps = 4, 150
+        packed = [0] * n_packers
+        stop = threading.Event()
+
+        def packer(t):
+            for _ in range(reps):
+                rc = feeder.pack(body)
+                if rc > 0:
+                    packed[t] += rc
+
+        def flusher():
+            while not stop.is_set():
+                feeder.flush()
+
+        ts = [
+            threading.Thread(target=packer, args=(t,))
+            for t in range(n_packers)
+        ]
+        fs = [threading.Thread(target=flusher) for _ in range(2)]
+        for t in ts + fs:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        for t in fs:
+            t.join()
+        # The teardown contract: after the final flush with no
+        # producers in flight, NOTHING may remain packed-but-unserved.
+        feeder.flush()
+        st = feeder.stats()
+        total = sum(packed)
+        assert total > 0
+        assert st["feeder_rows"] == total
+        assert st["feeder_served_rows"] == total, (st, total)
+        assert served[0] == total
+    finally:
+        feeder.close()
+
+
 def test_concurrent_pack_parity():
     """Many Python threads pack concurrently; every packed row must
     appear exactly once across the captured windows (claim/commit

@@ -725,6 +725,75 @@ def test_net_pass_handoff_with_timeout_and_backoff_ok(tmp_path):
     assert netcheck.check_file(_src(tmp_path, code)) == []
 
 
+# Replication RPC discipline (the hot-key promotion plane): the
+# ReplicateKeys call sites are held to the same rules — an unbudgeted
+# grant stalls the owner's whole promotion tick, and a backoff-free
+# grant-retry loop would hammer a broken replica the health plane
+# already refused.
+
+REPLICATION_BAD = """
+    from gubernator_tpu.cluster.peer_client import PeerError
+
+    def grant_all(peers, payload):
+        retry = list(peers)
+        while retry:
+            for peer in list(retry):
+                try:
+                    peer.replicate_keys_raw(payload)
+                except PeerError as e:
+                    if e.not_ready:
+                        retry.append(peer)
+                        continue
+                retry.remove(peer)
+"""
+
+
+def test_net_pass_catches_replication_rpc_without_timeout(tmp_path):
+    from tools.guberlint import netcheck
+
+    findings = netcheck.check_file(_src(tmp_path, REPLICATION_BAD))
+    assert any(
+        f.rule == "net-rpc-no-timeout"
+        and "replicate_keys_raw" in f.message
+        for f in findings
+    )
+
+
+def test_net_pass_catches_replication_retry_without_backoff(tmp_path):
+    from tools.guberlint import netcheck
+
+    findings = netcheck.check_file(_src(tmp_path, REPLICATION_BAD))
+    assert any(f.rule == "net-retry-no-backoff" for f in findings)
+
+
+def test_net_pass_replication_with_timeout_and_backoff_ok(tmp_path):
+    from tools.guberlint import netcheck
+
+    code = """
+        import time
+        from gubernator_tpu.cluster.health import backoff_delay
+        from gubernator_tpu.cluster.peer_client import PeerError
+
+        def grant_all(peers, payload, conf):
+            retry = list(peers)
+            attempt = 0
+            while retry:
+                for peer in list(retry):
+                    try:
+                        peer.replicate_keys_raw(
+                            payload, timeout=conf.global_timeout
+                        )
+                    except PeerError as e:
+                        if e.not_ready:
+                            retry.append(peer)
+                            continue
+                    retry.remove(peer)
+                time.sleep(backoff_delay(attempt, 0.01, 0.25))
+                attempt += 1
+    """
+    assert netcheck.check_file(_src(tmp_path, code)) == []
+
+
 # -------------------------------------------------------------- native
 # The C tier (tools/guberlint/csource.py + nativecheck.py): each rule
 # proves it fires on a seeded bad fixture and that the escape hatches

@@ -1,0 +1,121 @@
+"""Hot-key sketch: space-saving invariants + the windowed rate decay.
+
+The decay tests drive an injected clock, pinning the demotion
+contract the replication plane depends on (cluster/replication.py): a
+key hot an hour ago must read ~0 in `top_rates()` even though its
+cumulative count still ranks it in `top()`.
+"""
+
+import numpy as np
+
+from gubernator_tpu.utils.hotkeys import SpaceSaving
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_space_saving_counts_and_error_bounds():
+    ss = SpaceSaving(capacity=4)
+    for i in range(8):
+        ss.offer(f"k{i}".encode(), i + 1)
+    top = ss.top(4)
+    assert len(top) == 4
+    # Every reported count over-estimates by at most its error bound.
+    for _key, count, err in top:
+        assert count >= 1
+        assert err <= count
+    assert ss.stats()["tracked"] == 4
+
+
+def test_rate_reflects_current_window_only():
+    clk = _Clock()
+    ss = SpaceSaving(capacity=16, window_s=1.0, now=clk)
+    ss.offer(b"hot", 500)
+    assert ss.rate(b"hot") == 500.0
+    # Next window: the previous window's mass decays with the elapsed
+    # fraction of the new one.
+    clk.t = 1.5
+    assert 0 < ss.rate(b"hot") <= 500.0
+    # Two windows later: a key nobody offers reads 0, cumulative count
+    # untouched.
+    clk.t = 3.0
+    assert ss.rate(b"hot") == 0.0
+    assert ss.top(1)[0][:2] == (b"hot", 500)
+
+
+def test_top_rates_tracks_a_moving_zipf_hot_set():
+    """Rotate the hot set across three windows; top_rates must follow
+    the CURRENT hot keys while top() stays dominated by history."""
+    clk = _Clock()
+    rng = np.random.default_rng(3)
+    ss = SpaceSaving(capacity=64, window_s=1.0, now=clk)
+    phases = [b"alpha", b"beta", b"gamma"]
+    for p, hot in enumerate(phases):
+        clk.t = p * 2.0  # two windows apart: the old hot set decays out
+        # Zipf-ish: the phase's hot key takes ~90% of offers.
+        for _ in range(200):
+            if rng.random() < 0.9:
+                ss.offer(hot, 5)
+            else:
+                ss.offer(b"cold%d" % rng.integers(0, 20), 1)
+        rates = ss.top_rates(3)
+        assert rates[0][0] == hot, (p, rates)
+        # Earlier phases' hot keys must have decayed out of the rate
+        # ranking entirely.
+        for earlier in phases[:p]:
+            assert all(k != earlier or r < 1.0 for k, r, _l, _d in rates)
+    # Cumulative top() still remembers phase 0's mass.
+    assert b"alpha" in [k for k, _c, _e in ss.top(5)]
+
+
+def test_rate_params_carry_last_limit_duration():
+    clk = _Clock()
+    ss = SpaceSaving(capacity=8, window_s=1.0, now=clk)
+    ss.offer_many_params([(b"k", 10, 1000, 60_000)])
+    (key, rate, limit, duration), = ss.top_rates(1)
+    assert (key, limit, duration) == (b"k", 1000, 60_000)
+    assert rate == 10.0
+    # A params-less offer must not clobber the stored params.
+    ss.offer(b"k", 3)
+    (_k, _r, limit, duration), = ss.top_rates(1)
+    assert (limit, duration) == (1000, 60_000)
+
+
+def test_offer_columns_masks_ineligible_params():
+    """offer_columns with a masked limit column (the service stamps 0
+    for rows the lease algebra can't cover) must keep those keys'
+    params at 0 so the promotion plane skips them."""
+    clk = _Clock()
+    ss = SpaceSaving(capacity=8, window_s=1.0, now=clk)
+    keys = [b"aaa", b"bbb"]
+    buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    offs = np.array([0, 3, 6], dtype=np.int64)
+    ss.offer_columns(
+        buf, offs, np.array([4, 4]),
+        hashes=np.array([11, 22], dtype=np.uint64),
+        limit=np.array([100, 0]), duration=np.array([60_000, 60_000]),
+    )
+    by_key = {k: (lim, dur) for k, _r, lim, dur in ss.top_rates(4)}
+    assert by_key[b"aaa"] == (100, 60_000)
+    # limit 0 is the "never promotable" stamp the replication plane
+    # keys off; duration alone is inert.
+    assert by_key[b"bbb"][0] == 0
+
+
+def test_eviction_resets_window_counters():
+    """A newcomer that evicts a counter inherits the cumulative error
+    bound but NOT the old key's rate — rates carry no inherited
+    error."""
+    clk = _Clock()
+    ss = SpaceSaving(capacity=2, window_s=1.0, now=clk)
+    ss.offer(b"a", 10)
+    ss.offer(b"b", 20)
+    ss.offer(b"c", 1)  # evicts the min (a): inherits count 10
+    top = {k: (c, e) for k, c, e in ss.top(2)}
+    assert top[b"c"] == (11, 10)
+    assert ss.rate(b"c") == 1.0  # window counter started fresh

@@ -1,0 +1,516 @@
+"""Hot-key replication chaos suite: promote / demote / kill / reshard.
+
+Pins the replication plane's state machine on real in-process
+clusters (cluster/replication.py + RESILIENCE.md §11):
+
+- PROMOTE: a measured-hot key owned elsewhere starts answering
+  LOCALLY on every replica from pre-debited credit leases — the
+  forward counter stalls while replicated_local grows (zero forward
+  hops);
+- DEMOTE on cooldown: traffic stops, the owner revokes, replicas
+  empty, and the unused credit settles back onto the owner's bucket
+  (the probe reads the reconciled remaining);
+- replica killed mid-lease: per-key admission stays within the
+  N_replicas × lease bound (pre-debit makes the over-admission side
+  exactly zero on a healthy owner; the dead replica's unused slice is
+  bounded under-admission);
+- owner killed mid-promotion: replicas drain their leases, then
+  converge through the health plane (degraded local answering) with
+  zero error responses; leases expire out;
+- promotion racing a membership reshard: epoch ordering wins — stale
+  epochs and out-of-order sequence numbers are dropped, and a lease
+  whose grantor is no longer the key's ring owner is expired by
+  housekeeping;
+- the metrics surface: gubernator_replication_keys/events/answered/
+  credit on /metrics, mirrored by Daemon.replication_stats().
+
+The smoke case doubles as the ci_fast.sh promotion/demotion gate.
+"""
+
+import json
+import time
+
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster.harness import ClusterHarness
+from gubernator_tpu.types import RateLimitReq, Status
+
+
+def _req(name, key, limit=1_000_000, hits=1, duration=60_000):
+    return RateLimitReq(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=duration,
+    )
+
+
+def _key_owned_by(h, daemon_idx, name, prefix):
+    """One key whose owner is daemons[daemon_idx] (leading-byte
+    variation: FNV-1 does not avalanche trailing bytes)."""
+    want = h.daemons[daemon_idx].peer_info().grpc_address
+    for i in range(50_000):
+        key = f"{i}_{prefix}"
+        if (
+            h.daemons[0].instance.get_peer(f"{name}_{key}").info.grpc_address
+            == want
+        ):
+            return key
+    raise AssertionError("ring never mapped a key to the target")
+
+
+def _tune(h, *, promote_rate=30.0, cooldown=1.0, lease=64,
+          lease_ttl=1.0, interval=0.05, hk_window=0.5):
+    """Re-point every daemon's replication knobs to a test timescale
+    (the manager re-reads them each tick)."""
+    for d in h.daemons:
+        assert d.replication is not None
+        r = d.replication
+        r.promote_rate = promote_rate
+        r.cooldown = cooldown
+        r.lease = lease
+        r.lease_ttl = lease_ttl
+        r.interval = interval
+        d.instance.hotkeys.window_s = hk_window
+
+
+def _drive_until(clients, req, deadline_s, cond, *, collect=None):
+    """Round-robin single-item requests through `clients` until `cond`
+    (polled between rounds) or the deadline; returns (admitted,
+    cond_met)."""
+    admitted = 0
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for c in clients:
+            r = c.get_rate_limits([req], timeout=15)[0]
+            assert r.error == ""
+            if r.status == Status.UNDER_LIMIT:
+                admitted += 1
+            if collect is not None:
+                collect.append(r)
+        if cond():
+            return admitted, True
+    return admitted, False
+
+
+def test_promote_demote_smoke():
+    """The fast promotion/demotion round trip (the ci_fast gate):
+    replica traffic promotes the key, answers go local, cooldown
+    demotes, unused credit returns to the owner's bucket."""
+    h = ClusterHarness().start(3)
+    try:
+        _tune(h)
+        name = "replsmoke"
+        key = _key_owned_by(h, 0, name, "rsm")
+        limit = 100_000
+        req = _req(name, key, limit=limit)
+        owner, ra, rb = h.daemons[0], h.daemons[1], h.daemons[2]
+        clients = [V1Client(d.grpc_address) for d in (ra, rb)]
+        try:
+            admitted, ok = _drive_until(
+                clients, req, 15.0,
+                lambda: owner.replication.stats()["promoted_keys"] >= 1
+                and ra.replication.stats()["replica_leases"] >= 1
+                and rb.replication.stats()["replica_leases"] >= 1,
+            )
+            assert ok, (
+                "promotion never engaged: "
+                f"{[d.replication_stats() for d in h.daemons]}"
+            )
+            # Zero forward hops while the leases are live: the
+            # replicas answer locally (small slack for a refresh gap).
+            f0 = ra.instance.counters["forward"]
+            rl0 = ra.instance.counters["replicated_local"]
+            for _ in range(50):
+                r = clients[0].get_rate_limits([req], timeout=15)[0]
+                assert r.error == ""
+                if r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+            assert ra.instance.counters["replicated_local"] > rl0
+            assert ra.instance.counters["forward"] <= f0 + 5
+            ostats = owner.replication_stats()
+            assert ostats["grants_sent"] >= 2
+            assert ostats["credit_granted"] > 0
+            # Cooldown: traffic stops → the owner demotes and the
+            # replicas' leases drain out (revoked or expired).
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if (
+                    owner.replication.stats()["demoted"] >= 1
+                    and ra.replication.stats()["replica_leases"] == 0
+                    and rb.replication.stats()["replica_leases"] == 0
+                ):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "demotion never converged: "
+                    f"{[d.replication_stats() for d in h.daemons]}"
+                )
+            # Reconciliation: the unused replica credit settled back —
+            # the owner's logical remaining accounts every admitted
+            # hit, give or take one in-flight refresh slice.
+            probe = _req(name, key, limit=limit, hits=0)
+            r = clients[0].get_rate_limits([probe], timeout=15)[0]
+            assert r.error == ""
+            admitted_floor = limit - admitted - owner.replication.lease
+            assert r.remaining >= admitted_floor, (
+                r.remaining, admitted, owner.replication_stats(),
+            )
+        finally:
+            for c in clients:
+                c.close()
+    finally:
+        h.stop()
+
+
+def test_replica_killed_mid_lease_admission_within_bound():
+    """Kill a replica holding a live lease; total admission on the key
+    stays within limit (pre-debit: zero over-admission on a healthy
+    owner) and within N_replicas × lease of it from below (the dead
+    slice is bounded under-admission)."""
+    h = ClusterHarness().start(3)
+    try:
+        lease = 50
+        _tune(h, lease=lease, lease_ttl=2.0, cooldown=30.0)
+        name = "replkill"
+        key = _key_owned_by(h, 0, name, "rkl")
+        limit = 2_000
+        req = _req(name, key, limit=limit)
+        owner, ra, rb = h.daemons[0], h.daemons[1], h.daemons[2]
+        ca = V1Client(ra.grpc_address)
+        cb = V1Client(rb.grpc_address)
+        co = V1Client(owner.grpc_address)
+        try:
+            admitted, ok = _drive_until(
+                [ca, cb], req, 15.0,
+                lambda: ra.replication.stats()["replica_leases"] >= 1
+                and rb.replication.stats()["replica_leases"] >= 1,
+            )
+            assert ok, "replicas never leased"
+            h.kill(2)  # rb dies holding pre-debited credit
+            # Consume the rest through the owner and the survivor
+            # until the bucket is dry everywhere.
+            over_streak = 0
+            deadline = time.monotonic() + 30.0
+            while over_streak < 30 and time.monotonic() < deadline:
+                for c in (ca, co):
+                    r = c.get_rate_limits([req], timeout=15)[0]
+                    assert r.error == ""
+                    if r.status == Status.UNDER_LIMIT:
+                        admitted += 1
+                        over_streak = 0
+                    else:
+                        over_streak += 1
+            n_replicas = 2
+            # Over-admission side of the bound: pre-debited credit can
+            # never admit past the limit.
+            assert admitted <= limit, (admitted, limit)
+            # Under-admission side: only outstanding slices (the dead
+            # replica's + in-flight refreshes) may go unserved.
+            assert admitted >= limit - 2 * n_replicas * lease, (
+                admitted, limit, owner.replication_stats(),
+            )
+        finally:
+            ca.close()
+            cb.close()
+            co.close()
+    finally:
+        h.stop()
+
+
+def test_owner_lost_mid_promotion_replicas_converge():
+    """Cut the owner off (seeded isolation — the abrupt-death shape;
+    a graceful kill would deliver close-time revokes) while its
+    grants are live: replicas keep answering from pre-debited credit,
+    then converge through the health plane (degraded local answers)
+    with zero error responses; the orphaned leases expire out."""
+    h = ClusterHarness().start(3)
+    try:
+        _tune(h, lease=64, lease_ttl=0.8, cooldown=30.0)
+        name = "replokill"
+        key = _key_owned_by(h, 0, name, "rok")
+        req = _req(name, key, limit=1_000_000)
+        ra, rb = h.daemons[1], h.daemons[2]
+        ca = V1Client(ra.grpc_address)
+        try:
+            _admitted, ok = _drive_until(
+                [ca], req, 15.0,
+                lambda: ra.replication.stats()["replica_leases"] >= 1,
+            )
+            assert ok, "replica never leased"
+            h.install_faults(seed=11)
+            h.isolate(0)
+            # Every post-kill answer must be error-free: lease first,
+            # degraded-local once the circuit opens.
+            for _ in range(10):
+                r = ca.get_rate_limits([req], timeout=15)[0]
+                assert r.error == "", r.error
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if (
+                    ra.replication.stats()["replica_leases"] == 0
+                    and rb.replication.stats()["replica_leases"] == 0
+                ):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "orphaned leases never expired: "
+                    f"{ra.replication_stats()} {rb.replication_stats()}"
+                )
+            assert (
+                ra.replication.stats()["expired"] >= 1
+                or rb.replication.stats()["expired"] >= 1
+            )
+        finally:
+            ca.close()
+    finally:
+        h.stop()
+
+
+def test_promotion_racing_reshard_epoch_ordering():
+    """Epoch ordering wins every promotion/reshard race: stale-epoch
+    grants and out-of-order sequence numbers are dropped, and a lease
+    whose grantor is no longer the key's ring owner is expired by the
+    next housekeeping tick."""
+    h = ClusterHarness().start(2)
+    try:
+        _tune(h)
+        a, b = h.daemons[0], h.daemons[1]
+        now_ms = b.instance.engine.clock.now_ms()
+        src = a.peer_info().grpc_address
+        boot = a.membership.boot_id
+        epoch = b.membership.epoch()
+
+        def grant(key, *, epoch, seq, src=src, boot=boot):
+            return b.instance.receive_replication(json.dumps({
+                "op": "grant", "src": src, "boot": boot,
+                "epoch": epoch, "seq": seq,
+                "grants": [[key, 100, 60_000, now_ms + 60_000,
+                            80, 40, now_ms + 60_000]],
+            }).encode())
+
+        # Leases must name keys their grantor actually owns, or the
+        # grantor-changed housekeeping (the thing under test below)
+        # would drop them as superseded.
+        name = "replrace"
+        key = f"{name}_{_key_owned_by(h, 0, name, 'rc')}"
+        key2 = f"{name}_{_key_owned_by(h, 0, name, 'rcb')}"
+        resp = json.loads(grant(key, epoch=epoch, seq=1))
+        assert not resp.get("stale") and not resp.get("disabled")
+        assert b.replication.stats()["replica_leases"] == 1
+        # Stale epoch: the reshard already observed here wins (the
+        # message still consumes its stream slot).
+        resp = json.loads(grant("1_race", epoch=epoch - 1, seq=2))
+        assert resp["stale"]
+        # Out-of-order sequence within the same (src, boot) stream.
+        resp = json.loads(grant(key2, epoch=epoch, seq=3))
+        assert not resp.get("stale")
+        resp = json.loads(grant("3_race", epoch=epoch, seq=1))
+        assert resp["stale"]
+        assert b.replication.stats()["stale_dropped"] >= 2
+        # A revoke settles the lease and reports its accounting.
+        resp = json.loads(b.instance.receive_replication(json.dumps({
+            "op": "revoke", "src": src, "boot": boot, "epoch": epoch,
+            "seq": 4, "revokes": [key],
+        }).encode()))
+        assert resp["returns"] and resp["returns"][0][0] == key
+        # A lease from a grantor that is NOT the key's ring owner
+        # (a superseded owner after a reshard) is dropped by
+        # housekeeping; key2's lease — grantor still the owner —
+        # survives.
+        bogus = json.dumps({
+            "op": "grant", "src": "198.51.100.9:81", "boot": "zz",
+            "epoch": epoch, "seq": 1,
+            "grants": [["4_race", 100, 60_000, now_ms + 60_000,
+                        80, 40, now_ms + 60_000]],
+        }).encode()
+        json.loads(b.instance.receive_replication(bogus))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            st = b.replication.stats()
+            if st["replica_leases"] == 1 and st["expired"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"superseded-owner lease never dropped: "
+                f"{b.replication_stats()}"
+            )
+    finally:
+        h.stop()
+
+
+def test_refused_grant_returns_credit():
+    """A replica that answers but refuses (replication disabled there)
+    must count as a FAILED grant: the pre-debited slice returns to the
+    owner's engine instead of leaking on every refresh."""
+    h = ClusterHarness().start(2)
+    try:
+        _tune(h, cooldown=30.0)
+        h.daemons[1].replication.enabled = False  # refuses all grants
+        name = "replref"
+        key = _key_owned_by(h, 0, name, "rrf")
+        limit = 10_000
+        req = _req(name, key, limit=limit)
+        owner = h.daemons[0]
+        c = V1Client(h.daemons[1].grpc_address)
+        try:
+            admitted, _ok = _drive_until(
+                [c], req, 8.0,
+                lambda: owner.replication.stats()["grants_failed"] >= 1,
+            )
+            st = owner.replication_stats()
+            assert st["grants_failed"] >= 1, st
+            assert st["grants_sent"] == 0, st
+            # Every refused slice flowed back: granted == returned.
+            assert st["credit_granted"] == st["credit_returned"], st
+            # And the bucket's remaining accounts only real admits.
+            probe = _req(name, key, limit=limit, hits=0)
+            r = c.get_rate_limits([probe], timeout=15)[0]
+            assert r.remaining >= limit - admitted - 5, (r, admitted, st)
+        finally:
+            c.close()
+    finally:
+        h.stop()
+
+
+def test_columnar_answer_is_transactional():
+    """try_answer_columns must not debit anything when it declines:
+    a batch mixing a leased and an unleased row returns None with the
+    lease untouched (the pb-path replay would otherwise double-debit
+    the leased rows)."""
+    import numpy as np
+
+    from gubernator_tpu.hashing import fnv1a_64
+
+    h = ClusterHarness().start(2)
+    try:
+        _tune(h)
+        b = h.daemons[1]
+        now_ms = b.instance.engine.clock.now_ms()
+        src = h.daemons[0].peer_info().grpc_address
+        boot = h.daemons[0].membership.boot_id
+        name = "repltx"
+        key = f"{name}_{_key_owned_by(h, 0, name, 'rtx')}"
+        b.instance.receive_replication(json.dumps({
+            "op": "grant", "src": src, "boot": boot,
+            "epoch": b.membership.epoch(), "seq": 1,
+            "grants": [[key, 100, 60_000, now_ms + 60_000,
+                        80, 40, now_ms + 60_000]],
+        }).encode())
+        repl = b.replication
+
+        def dec_for(rows):
+            class D:  # the decoded-batch column shape
+                pass
+
+            d = D()
+            keys = [r[0] for r in rows]
+            d.n = len(rows)
+            d.key_buf = np.frombuffer(b"".join(keys), np.uint8).copy()
+            off = np.zeros(d.n + 1, np.int64)
+            np.cumsum([len(k) for k in keys], out=off[1:])
+            d.key_offsets = off
+            d.algo = np.zeros(d.n, np.int32)
+            d.behavior = np.zeros(d.n, np.int32)
+            d.hits = np.asarray([r[1] for r in rows], np.int64)
+            d.limit = np.asarray([r[2] for r in rows], np.int64)
+            d.duration = np.full(d.n, 60_000, np.int64)
+            d.burst = np.zeros(d.n, np.int64)
+            d.fnv1a = np.asarray(
+                [fnv1a_64(k) for k in keys], np.uint64
+            )
+            return d
+
+        kb = key.encode()
+        # Mixed batch: leased row first, unleased row second → decline
+        # with ZERO mutation.
+        dec = dec_for([(kb, 3, 100), (b"repltx_absent", 1, 100)])
+        out = repl.try_answer_columns(
+            dec, np.arange(2, dtype=np.int64), now_ms
+        )
+        assert out is None
+        with repl._lock:
+            assert repl._leases[kb].consumed == 0
+        assert repl.stats()["answered"] == 0
+        # All-leased batch (duplicate rows) commits cumulatively.
+        dec = dec_for([(kb, 3, 100), (kb, 2, 100)])
+        out = repl.try_answer_columns(
+            dec, np.arange(2, dtype=np.int64), now_ms
+        )
+        assert out is not None
+        st, rem, _rst = out
+        assert st.tolist() == [0, 0] and rem.tolist() == [77, 75]
+        with repl._lock:
+            assert repl._leases[kb].consumed == 5
+    finally:
+        h.stop()
+
+
+def test_replication_metrics_exported():
+    """gubernator_replication_* on /metrics, mirrored by
+    Daemon.replication_stats()."""
+    import urllib.request
+
+    h = ClusterHarness().start(2)
+    try:
+        d = h.daemons[0]
+        stats = d.replication_stats()
+        assert stats["promoted_keys"] == 0
+        body = urllib.request.urlopen(
+            f"http://{d.http_address}/metrics", timeout=10
+        ).read().decode()
+        for series in (
+            "gubernator_replication_keys",
+            "gubernator_replication_events",
+            "gubernator_replication_answered",
+            "gubernator_replication_credit",
+        ):
+            assert series in body, series
+    finally:
+        h.stop()
+
+
+def test_remote_lease_rides_native_plane():
+    """Replica-held remote leases delegate to the C decision plane
+    (core/ledger.remote_install): the plane answers drains natively
+    and remote_pull linearizes the consumed count back."""
+    from gubernator_tpu.core import native_plane
+
+    if native_plane.load() is None:
+        pytest.skip("native decision plane unavailable")
+    from gubernator_tpu.core.ledger import DecisionLedger
+
+    class _Clock:
+        @staticmethod
+        def now_ms():
+            return int(time.time() * 1000)
+
+    class _Engine:
+        clock = _Clock()
+
+        @staticmethod
+        def apply_columnar(*cols):  # pragma: no cover - never called
+            raise AssertionError("remote leases never touch the engine")
+
+    led = DecisionLedger(_Engine(), settle_interval=0)
+    plane = native_plane.NativeDecisionPlane(max_keys=64)
+    try:
+        led.attach_native(plane)
+        now = _Clock.now_ms()
+        assert led.remote_install(
+            b"repl_nk", 100, 60_000, now + 60_000, 80, 40, 0,
+            now + 60_000,
+        )
+        out = plane.probe(b"repl_nk", 0, 0, 5, 100, 60_000, now)
+        assert out is not None
+        st, rem, _rst = out
+        # UNDER, remaining = rem 80 - 5 drained
+        assert (st, rem) == (int(Status.UNDER_LIMIT), 75)
+        assert led.remote_pull(b"repl_nk") == 5
+        assert led.remote_pull(b"repl_nk") is None  # pulled = gone
+    finally:
+        led.detach_native()
+        plane.close()
+        led.close()

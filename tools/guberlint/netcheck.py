@@ -34,6 +34,9 @@ PASS = "net"
 # RPC (cluster/handoff.py) is held to the same discipline: an epoch
 # commit waits on the sender, so an unbudgeted TransferBuckets call
 # would let one slow peer stall a membership transition indefinitely.
+# The replication RPC (cluster/replication.py) likewise: an unbudgeted
+# grant would let one slow replica stall the owner's promotion tick —
+# and with it every other promoted key's lease refresh.
 PEER_RPC_METHODS = {
     "get_peer_rate_limit",
     "get_peer_rate_limits",
@@ -43,6 +46,8 @@ PEER_RPC_METHODS = {
     "update_peer_globals_raw",
     "transfer_buckets",
     "transfer_buckets_raw",
+    "replicate_keys",
+    "replicate_keys_raw",
 }
 
 # Backoff-shaped calls that satisfy net-retry-no-backoff.
