@@ -247,6 +247,16 @@ def main() -> int:
             # ceiling.  A finite-limit canary key measures admission
             # against the N_replicas x lease bound in the same run.
             result = _run_flashcrowd(np, platform)
+        elif MODE == "crossregion":
+            # Multi-region federation A/B (ROADMAP item 4): a 2×2
+            # region×peer cluster under injected inter-region latency
+            # — same-session healthy control, then a full inter-region
+            # partition phase (0 errors: every answer is region-local,
+            # flagged degraded_region; a finite-limit canary measures
+            # drift against the N_regions × limit bound), then heal →
+            # requeued deltas converge (drops == 0, convergence time
+            # recorded).  RESILIENCE.md §12 / PERF.md §28.
+            result = _run_crossregion(np, platform)
         elif MODE == "herdtrace":
             # Same-session tracing A/B: the herdfast workload once with
             # tracing disabled and once with the in-memory recorder +
@@ -2238,6 +2248,262 @@ def _run_flashcrowd(np, platform: str) -> dict:
                 "bound": n_replicas * lease,
                 "lease": lease,
                 "replicas": n_replicas,
+            },
+            "platform": platform,
+        }
+    finally:
+        h.stop()
+
+
+def _run_crossregion(np, platform: str) -> dict:
+    """Multi-region federation A/B (ISSUE 14 acceptance): a 2×2
+    region×peer in-process cluster (two datacenters, two daemons
+    each) with deterministic injected inter-region link latency.
+
+    Three phases in ONE session:
+      1. healthy control — client herds drive MULTI_REGION single-item
+         RPCs into BOTH regions; cross-region deltas converge live.
+      2. partition — every inter-region link cut (asymmetric rules,
+         both directions).  The acceptance bar: ZERO errors (answers
+         are region-local; convergence defers into the requeue
+         backlog), answers flagged degraded_region once the region
+         circuits open, and a finite-limit canary driven from both
+         regions admits ≤ N_regions × limit (the §12 drift bound,
+         measured live).
+      3. heal — the requeued deltas deliver; the artifact records the
+         convergence time and asserts drops == 0 inside the age cap.
+
+    The artifact embeds the per-stage cross-region hop budget
+    (multiregion window wait + region-push RPC quantiles from the
+    stitched-trace stage timers) so PERF.md §28 can attribute the DCN
+    cost."""
+    import grpc
+
+    from dataclasses import replace as dc_replace
+
+    from gubernator_tpu.cluster.harness import (
+        ClusterHarness,
+        cluster_behaviors,
+    )
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+    from gubernator_tpu.types import Behavior
+
+    regions = ["", "dc-west"]
+    n_per_region = int(os.environ.get("BENCH_XR_PEERS", 2))
+    n_threads = int(os.environ.get("BENCH_XR_THREADS", 8))
+    link_ms = float(os.environ.get("BENCH_XR_LINK_MS", 10.0))
+    # Sized to EXHAUST in both regions during the partition phase
+    # (~10% canary share of a few-hundred-req/s closed-loop herd):
+    # admitted-vs-limit is only drift evidence if the bucket actually
+    # runs dry on each side of the cut.
+    canary_limit = int(os.environ.get("BENCH_XR_CANARY_LIMIT", 40))
+    datacenters = [r for r in regions for _ in range(n_per_region)]
+    # The requeue age cap must outlive the partition phase, or the
+    # "drops == 0" acceptance would be measuring the cap, not the
+    # convergence.
+    behaviors = dc_replace(
+        cluster_behaviors(),
+        multi_region_requeue_age=max(60.0, 6.0 * MEASURE_SECONDS),
+    )
+    h = ClusterHarness().start(
+        len(datacenters), datacenters=datacenters,
+        behaviors=behaviors, cache_size=CAPACITY,
+    )
+    try:
+        h.install_faults(seed=5)
+        if link_ms > 0:
+            # Deterministic DCN RTT on every inter-region link — the
+            # cross-region hop pays it, decisions never do.
+            h.region_link_latency(regions[0], regions[1], link_ms / 1e3)
+        entry = {
+            r: next(
+                d
+                for d, dc in zip(h.daemons, h._datacenters)
+                if dc == r
+            )
+            for r in regions
+        }
+        mrb = int(Behavior.MULTI_REGION)
+
+        def payload(key, limit, hits=1):
+            return pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="xr", unique_key=key, hits=hits,
+                        limit=limit, duration=3_600_000, behavior=mrb,
+                    )
+                ]
+            ).SerializeToString()
+
+        # Keys vary a LEADING byte (FNV-1 trailing-byte collapse; see
+        # hash_ring.py) so every owner in every region gets a share.
+        payloads = [payload(f"{i}_xr", 10**9) for i in range(256)]
+        canary_payload = payload("9xy_xrcanary", canary_limit)
+
+        def drive(seconds: float, canary: bool):
+            """Closed-loop herd split across BOTH regions' entry
+            nodes; optional ~10% canary share.  Returns {value, p50,
+            p99, requests, errors, canary_admitted}."""
+            addrs = [entry[regions[t % len(regions)]].grpc_address
+                     for t in range(n_threads)]
+            stop = threading.Event()
+            barrier = threading.Barrier(n_threads + 1)
+            counts = [0] * n_threads
+            errors = [0] * n_threads
+            admitted = [0] * n_threads
+            lats: list = [None] * n_threads
+
+            def worker(tid: int) -> None:
+                rng = np.random.default_rng(100 + tid)
+                mylat = []
+                ch = grpc.insecure_channel(addrs[tid])
+                call = ch.unary_unary(
+                    f"/{V1_SERVICE}/GetRateLimits",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                try:
+                    call(payloads[tid % len(payloads)])
+                finally:
+                    barrier.wait()
+                i = tid
+                while not stop.is_set():
+                    is_canary = canary and rng.random() < 0.1
+                    body = (
+                        canary_payload
+                        if is_canary
+                        else payloads[i % len(payloads)]
+                    )
+                    t0 = time.perf_counter()
+                    try:
+                        raw = call(body)
+                        resp = pb.GetRateLimitsResp()
+                        resp.ParseFromString(raw)
+                        for rr in resp.responses:
+                            if rr.error:
+                                errors[tid] += 1
+                            elif is_canary and rr.status == 0:  # UNDER
+                                admitted[tid] += 1
+                    except grpc.RpcError:
+                        errors[tid] += 1
+                    mylat.append(time.perf_counter() - t0)
+                    counts[tid] += 1
+                    i += n_threads
+                lats[tid] = mylat
+                ch.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(t,), daemon=True)
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            start = time.perf_counter()
+            time.sleep(seconds)
+            stop.set()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - start
+            all_lat = np.asarray(
+                [x for ml in lats if ml for x in ml]
+            )
+            pct = lambda q: (  # noqa: E731
+                round(float(np.percentile(all_lat, q)) * 1e3, 3)
+                if all_lat.size else None
+            )
+            return {
+                "value": round(sum(counts) / elapsed, 1),
+                "p50_ms": pct(50),
+                "p99_ms": pct(99),
+                "requests": int(sum(counts)),
+                "errors": int(sum(errors)),
+                "canary_admitted": int(sum(admitted)),
+            }
+
+        def mr_sum(field: str) -> int:
+            return sum(
+                d.multiregion_stats()[field] for d in h.daemons
+            )
+
+        def degraded_sum() -> int:
+            return sum(
+                d.instance.counters["degraded_region_answers"]
+                for d in h.daemons
+            )
+
+        def settle(timeout: float = 30.0) -> float:
+            """Force-deliver the retry backlog on every node; returns
+            seconds until pending_retry hits 0 everywhere."""
+            t0 = time.perf_counter()
+            deadline = t0 + timeout
+            while time.perf_counter() < deadline:
+                for d in h.daemons:
+                    d.instance.multi_region_mgr.retry_now()
+                if all(
+                    d.instance.multi_region_mgr.pending_retry() == 0
+                    for d in h.daemons
+                ):
+                    break
+                time.sleep(0.05)
+            return round(time.perf_counter() - t0, 3)
+
+        # -- phase 1: healthy control ---------------------------------
+        healthy = drive(MEASURE_SECONDS, canary=False)
+        settle(10.0)
+        healthy["region_sends"] = mr_sum("region_sends")
+
+        # -- phase 2: full inter-region partition ---------------------
+        h.partition_regions(regions[0], regions[1])
+        degraded_before = degraded_sum()
+        sends_before_heal = mr_sum("region_sends")
+        part = drive(MEASURE_SECONDS, canary=True)
+        part["degraded_region_answers"] = degraded_sum() - degraded_before
+        part["hits_requeued"] = mr_sum("hits_requeued")
+
+        # -- phase 3: heal → converge ---------------------------------
+        h.heal()
+        heal_s = settle(30.0)
+        admitted = part["canary_admitted"]
+        dropped = mr_sum("hits_dropped")
+        states = h.multiregion_states()
+        hop = entry[regions[0]].instance.multi_region_mgr
+        return {
+            "metric": "rate-limit decisions/sec, MULTI_REGION traffic "
+            f"across a {len(regions)}x{n_per_region} region x peer "
+            f"cluster with the inter-region links CUT ({n_threads} "
+            f"client threads split across both regions, {link_ms:g}ms "
+            "injected inter-region link latency; value = partitioned "
+            "phase)",
+            "value": part["value"],
+            "unit": "decisions/sec",
+            "vs_baseline": round(
+                part["value"] / BASELINE_DECISIONS_PER_SEC, 2
+            ),
+            "p50_ms": part["p50_ms"],
+            "p99_ms": part["p99_ms"],
+            "requests": part["requests"],
+            "errors": part["errors"],
+            "healthy": healthy,
+            "partitioned": part,
+            "canary": {
+                "limit": canary_limit,
+                "admitted": admitted,
+                "over_admission": max(0, admitted - canary_limit),
+                "bound": len(regions) * canary_limit,
+                "within_bound": admitted <= len(regions) * canary_limit,
+                "regions": len(regions),
+            },
+            "heal_convergence_s": heal_s,
+            "hits_dropped": dropped,
+            "region_sends_post_heal": mr_sum("region_sends")
+            - sends_before_heal,
+            "link_latency_ms": link_ms,
+            "multiregion": {
+                "window_wait": hop.window_wait.snapshot_ms(),
+                "region_rpc": hop.region_rpc.snapshot_ms(),
+                "states": states,
             },
             "platform": platform,
         }

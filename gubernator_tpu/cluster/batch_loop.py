@@ -133,6 +133,18 @@ class IntervalBatcher(Generic[K, V]):
         self._flush = flush
         self._wait_stat = wait_stat
         self._age_stat = age_stat
+        # Deferred re-admission (requeue_many delay=): failed-flush
+        # items HELD until a due time, invisible to the drain until
+        # they come due — the damped-retry primitive.  A flush that
+        # re-queues toward a broken peer with a backoff delay must not
+        # spin the loop (re-admitted items would drain again
+        # immediately) and must not sleep on a flush worker (a parked
+        # worker is exactly the stall the health plane exists to
+        # prevent); held batches instead bound the loop's wait, so the
+        # retry fires at its due time even with zero fresh traffic —
+        # which is what lets a healed peer converge after the clients
+        # go quiet.  Entries: (due_monotonic, pairs, oldest_ts).
+        self._held: list = []  # guberlint: guarded-by _lock
         # chunked=True: the flush callable accepts (dict, chunks) and
         # add_chunk is available — the columnar wire path queues whole
         # column slices in O(1) instead of per-item dict merges, and
@@ -191,9 +203,14 @@ class IntervalBatcher(Generic[K, V]):
             # Admit only when the WHOLE batch fits (a 1000-item chunk
             # must not slip past the cap through one free slot) — but
             # an oversized batch is always admitted into an empty
-            # queue, or it could never be admitted at all.
+            # queue, or it could never be admitted at all.  Held
+            # (deferred-retry) items occupy pending space: the memory
+            # bound covers the retry backlog too.
             while not self._closing:
-                pending = len(self._items) + self._chunk_count
+                pending = (
+                    len(self._items) + self._chunk_count
+                    + self._held_count_locked()
+                )
                 if pending == 0 or pending + incoming <= self._max_pending:
                     break
                 self._space.wait(timeout=1.0)
@@ -239,18 +256,61 @@ class IntervalBatcher(Generic[K, V]):
             self._cv.notify()
 
     def pending(self) -> int:
-        """Items currently queued for the next flush (metrics gauge)."""
+        """Items currently queued for the next flush, INCLUDING held
+        deferred-retry batches (metrics gauge)."""
         with self._lock:
-            return len(self._items) + self._chunk_count
+            return (
+                len(self._items) + self._chunk_count
+                + self._held_count_locked()
+            )
 
     def backlog_age(self) -> float:
         """Seconds since the oldest still-queued item arrived (metrics
         gauge: a healthy batcher keeps this near sync_wait; growth
-        means flushes cannot keep up with enqueues)."""
+        means flushes cannot keep up with enqueues).  Held retry
+        batches count with their ORIGINAL enqueue time — the failure
+        episode they carry is exactly what this gauge exists to
+        expose."""
         with self._lock:
-            if not self._items and not self._chunks:
+            oldest = None
+            if self._items or self._chunks:
+                oldest = self._oldest_ts
+            for _due, _pairs, held_oldest in self._held:
+                if held_oldest and (oldest is None or held_oldest < oldest):
+                    oldest = held_oldest
+            if oldest is None:
                 return 0.0
-            return time.monotonic() - self._oldest_ts
+            return time.monotonic() - oldest
+
+    def _held_count_locked(self) -> int:  # guberlint: holds _lock
+        return sum(len(pairs) for _due, pairs, _ts in self._held)
+
+    def _promote_held_locked(self, force: bool = False):
+        """Move held batches whose due time arrived (all of them when
+        `force`) into the live queue; returns the earliest remaining
+        due time, or None when nothing is held.  Caller holds the
+        lock."""  # guberlint: holds _lock
+        if not self._held:
+            return None
+        now = time.monotonic()
+        keep = []
+        earliest = None
+        for due, pairs, oldest_ts in self._held:
+            if not force and due > now:
+                keep.append((due, pairs, oldest_ts))
+                if earliest is None or due < earliest:
+                    earliest = due
+                continue
+            if not self._items and not self._chunks:
+                self._oldest_ts = oldest_ts if oldest_ts else now
+            elif oldest_ts and oldest_ts < self._oldest_ts:
+                self._oldest_ts = oldest_ts
+            items = self._items
+            combine = self._combine
+            for key, item in pairs:
+                items[key] = combine(items.get(key), item)
+        self._held = keep
+        return earliest
 
     def current_wait(self) -> float:
         """The wait the next window will use (sync_wait when the
@@ -278,7 +338,12 @@ class IntervalBatcher(Generic[K, V]):
                 items[key] = combine(items.get(key), item)
             self._cv.notify()
 
-    def requeue_many(self, pairs, oldest_ts: float | None = None) -> int:
+    def requeue_many(
+        self,
+        pairs,
+        oldest_ts: float | None = None,
+        delay: float = 0.0,
+    ) -> int:
         """Re-enqueue failed-flush items WITHOUT blocking: flush
         threads must never wait on producer admission (a blocked flush
         worker is exactly the stall the health plane exists to
@@ -287,12 +352,38 @@ class IntervalBatcher(Generic[K, V]):
         items' ORIGINAL first-enqueue time: re-queued items already
         waited at least one window, and re-anchoring backlog age at
         now() would hide exactly the failure-episode backlog the gauge
-        exists to expose."""
+        exists to expose.
+
+        `delay` > 0 defers re-admission: the batch is HELD invisible
+        to the drain until `delay` seconds pass (the capped-backoff
+        retry cycle toward a broken peer — re-admitting immediately
+        would spin the loop against an open circuit, and sleeping on
+        the flush worker would stall healthy traffic).  The loop's
+        idle wait is bounded by the earliest held due time, so the
+        retry fires on schedule even with zero fresh traffic."""
         pairs = list(pairs)
         admitted = 0
         with self._lock:
             if self._closing:
                 return 0
+            if delay > 0:
+                if self._max_pending is not None:
+                    space = self._max_pending - (
+                        len(self._items) + self._chunk_count
+                        + self._held_count_locked()
+                    )
+                    if space < len(pairs):
+                        self.dropped += len(pairs) - max(0, space)
+                        pairs = pairs[: max(0, space)]
+                if not pairs:
+                    return 0
+                self._held.append(
+                    (time.monotonic() + delay, pairs, oldest_ts or 0.0)
+                )
+                # Wake the loop so its idle wait re-arms with the new
+                # due time (a plain cv.wait() would sleep past it).
+                self._cv.notify()
+                return len(pairs)
             if not self._items and not self._chunks:
                 self._oldest_ts = (
                     oldest_ts if oldest_ts else time.monotonic()
@@ -337,8 +428,23 @@ class IntervalBatcher(Generic[K, V]):
                 # a handoff limbo the gauges can't see.
                 self._flush_slots.acquire()
             with self._lock:
-                while not self._items and not self._chunks and not self._closing:
-                    self._cv.wait()
+                while True:
+                    # Promote due held retries first (forced on close:
+                    # the final drain must deliver-or-fail the whole
+                    # retry backlog, not strand it); an undue backlog
+                    # bounds the idle wait so retries fire on schedule
+                    # without fresh traffic.
+                    earliest = self._promote_held_locked(
+                        force=self._closing
+                    )
+                    if self._items or self._chunks or self._closing:
+                        break
+                    if earliest is None:
+                        self._cv.wait()
+                    else:
+                        self._cv.wait(
+                            max(0.0, earliest - time.monotonic())
+                        )
                 if self._closing and not self._items and not self._chunks:
                     if self._flush_slots is not None:
                         self._flush_slots.release()
@@ -526,12 +632,16 @@ class IntervalBatcher(Generic[K, V]):
                     max(0.0, time.monotonic() - drained_oldest)
                 )
 
-    def flush_now(self) -> None:
+    def flush_now(self, force_held: bool = False) -> None:
         """Flush everything queued immediately, on the caller's thread
         (operational drains + deterministic tests).  Returns only after
         every OLDER snapshot's flush AND this drain complete; producers
-        never wait on flush execution."""
+        never wait on flush execution.  `force_held=True` also promotes
+        not-yet-due held retry batches into this drain (convergence
+        probes after a heal: deliver the backlog NOW instead of waiting
+        out the backoff)."""
         with self._lock:
+            self._promote_held_locked(force=force_held)
             drained_oldest = self._oldest_ts
             batch, chunks = self._drain_locked(limit=None)
             turn = self._take_turn()

@@ -67,6 +67,11 @@ class FaultInjector:
         self._lock = threading.Lock()
         # Directed blocks: (src, dst); "*" wildcards one side.
         self._partitions: Set[Tuple[str, str]] = set()  # guberlint: guarded-by _lock
+        # Directed ALWAYS-ON latency links: (src, dst) -> seconds.
+        # Unlike the rate-based latency_rate (a random spike model),
+        # these emulate a link's deterministic RTT — the inter-region
+        # DCN hop the crossregion bench injects (RESILIENCE.md §12).
+        self._latency_links: Dict[Tuple[str, str], float] = {}  # guberlint: guarded-by _lock
         self.injected: Dict[str, int] = {}  # guberlint: guarded-by _lock
 
     # -- partitions ----------------------------------------------------
@@ -103,6 +108,41 @@ class FaultInjector:
                 )
             }
 
+    # -- directed latency links ----------------------------------------
+
+    def add_latency(self, src: str, dst: str, seconds: float) -> None:
+        """Inject a deterministic per-send delay on src→dst (one
+        direction; "*" wildcards a side) — inter-region RTT emulation.
+        Stacks with the rate-based latency model; the largest matching
+        link wins when wildcards overlap."""
+        with self._lock:
+            self._latency_links[(src, dst)] = seconds
+
+    def clear_latency(
+        self, src: Optional[str] = None, dst: Optional[str] = None
+    ) -> None:
+        """Remove latency links matching (src, dst); None wildcards
+        that side (argument-side only, like heal())."""
+        with self._lock:
+            self._latency_links = {
+                (s, d): v
+                for (s, d), v in self._latency_links.items()
+                if not (
+                    (src is None or s == src)
+                    and (dst is None or d == dst)
+                )
+            }
+
+    def _link_delay_locked(self, src: str, dst: str) -> float:  # guberlint: holds _lock
+        links = self._latency_links
+        if not links:
+            return 0.0
+        return max(
+            links.get((src, dst), 0.0),
+            links.get((src, "*"), 0.0),
+            links.get(("*", dst), 0.0),
+        )
+
     def _partitioned(self, src: str, dst: str) -> bool:  # guberlint: holds _lock
         p = self._partitions
         return (
@@ -133,6 +173,10 @@ class FaultInjector:
             if self.latency_rate > 0 and self._rng.random() < self.latency_rate:
                 self._count("latency")
                 delay = self.latency_s
+            link = self._link_delay_locked(src, dst)
+            if link > 0:
+                self._count("link_latency")
+                delay += link
         if delay > 0:
             time.sleep(delay)
 

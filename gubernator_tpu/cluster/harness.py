@@ -28,6 +28,14 @@ def cluster_behaviors() -> BehaviorConfig:
         batch_wait=0.005,
         multi_region_sync_wait=0.05,
         multi_region_timeout=1.0,
+        # Multi-region federation on a test timescale (RESILIENCE.md
+        # §12): the fan-out barrier, requeue age cap and per-region
+        # retry backoff all shrink so partition-heal-converge arcs
+        # settle in seconds.
+        multi_region_fanout_deadline=1.0,
+        multi_region_requeue_age=3.0,
+        multi_region_backoff=0.02,
+        multi_region_backoff_cap=0.2,
         # Health plane on a test timescale: circuits open after the
         # same 3 failures but re-probe quickly, and the fan-out
         # barrier / requeue age shrink to keep chaos cases fast.
@@ -197,9 +205,21 @@ class ClusterHarness:
             raise ValueError(f"no peers in datacenter {datacenter!r}")
         return random.choice(options)
 
-    def owner_of(self, key: str) -> Daemon:
-        """The daemon that owns `key` on the default-DC ring."""
-        peer = self.daemons[0].instance.get_peer(key)
+    def owner_of(self, key: str, datacenter: str = "") -> Daemon:
+        """The daemon that owns `key` on `datacenter`'s ring (each
+        region routes the key independently on its own local ring —
+        the MULTI_REGION federation topology)."""
+        entry = next(
+            (
+                d
+                for d, dc in zip(self.daemons, self._datacenters)
+                if dc == datacenter
+            ),
+            None,
+        )
+        if entry is None:
+            raise ValueError(f"no daemons in datacenter {datacenter!r}")
+        peer = entry.instance.get_peer(key)
         addr = peer.info.grpc_address
         for d in self.daemons:
             if d.peer_info().grpc_address == addr:
@@ -317,6 +337,53 @@ class ClusterHarness:
         """Remove every partition rule (the injector stays installed —
         rate-based faults keep flowing if configured)."""
         self._injector.heal()
+
+    # -- region-level fault veneer (multi-region federation,
+    # RESILIENCE.md §12) ----------------------------------------------
+
+    def _region_addrs(self, datacenter: str) -> list:
+        addrs = [
+            d.peer_info().grpc_address
+            for d, dc in zip(self.daemons, self._datacenters)
+            if dc == datacenter
+        ]
+        if not addrs:
+            raise ValueError(f"no daemons in datacenter {datacenter!r}")
+        return addrs
+
+    def partition_regions(
+        self, dc_a: str, dc_b: str, both: bool = True
+    ) -> None:
+        """Block every inter-region link dc_a→dc_b (and the reverse
+        with `both` — the full DCN cut); `both=False` is the
+        asymmetric half-partition.  Requires install_faults()."""
+        for a in self._region_addrs(dc_a):
+            for b in self._region_addrs(dc_b):
+                self._injector.partition(a, b)
+                if both:
+                    self._injector.partition(b, a)
+
+    def region_link_latency(
+        self, dc_a: str, dc_b: str, seconds: float, both: bool = True
+    ) -> None:
+        """Inject deterministic per-send latency on every dc_a→dc_b
+        link (and the reverse with `both`) — inter-region RTT
+        emulation for the crossregion bench."""
+        for a in self._region_addrs(dc_a):
+            for b in self._region_addrs(dc_b):
+                self._injector.add_latency(a, b, seconds)
+                if both:
+                    self._injector.add_latency(b, a, seconds)
+
+    def multiregion_states(self) -> dict:
+        """{daemon_addr: {region: healthy|degraded|open}} across the
+        cluster — the federation suite's degradation oracle."""
+        return {
+            d.peer_info().grpc_address:
+                d.instance.multi_region_mgr.region_states()
+            for d in self.daemons
+            if d.instance is not None
+        }
 
     # -- health introspection ------------------------------------------
 

@@ -43,6 +43,46 @@ HALF_OPEN = "half-open"
 
 STATES = (HEALTHY, SUSPECT, BROKEN, HALF_OPEN)
 
+# Aggregate circuit states for one REMOTE REGION, derived from the
+# per-peer breakers of the region's members (cluster/multiregion.py;
+# RESILIENCE.md §12).
+REGION_HEALTHY = "healthy"
+REGION_DEGRADED = "degraded"
+REGION_OPEN = "open"
+
+
+def aggregate_region_state(healths) -> str:
+    """Fold member PeerHealth breakers into one region-level state:
+
+    - ``open``    — not a single member would accept a send right now
+      (every circuit is open inside its period / probing): the region
+      is unreachable, MULTI_REGION answers carry
+      ``metadata.degraded_region=true``, and the §12 drift bound
+      (over-admission ≤ N_regions × limit per window) is the active
+      guarantee until a probe heals a member.
+    - ``degraded`` — some members are broken/half-open but at least
+      one accepts sends: pushes still flow (the region ring re-routes
+      nothing — per-key owners are fixed — but the region is not yet
+      lost, and answers stay unflagged).
+    - ``healthy`` — every member's circuit is closed.
+
+    An empty region reads healthy: no members means nothing to push
+    and no drift to bound."""
+    any_member = False
+    any_allow = False
+    any_broken = False
+    for h in healths:
+        any_member = True
+        if h.would_allow():
+            any_allow = True
+        if h.state() in (BROKEN, HALF_OPEN):
+            any_broken = True
+    if not any_member:
+        return REGION_HEALTHY
+    if not any_allow:
+        return REGION_OPEN
+    return REGION_DEGRADED if any_broken else REGION_HEALTHY
+
 # Process-wide jitter source for backoff_delay callers that don't
 # thread their own rng.  Deterministic tests pass a seeded Random.
 _jitter_rng = random.Random()

@@ -650,3 +650,11 @@ class PeerClient:
     def queue_length(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def inflight(self) -> int:
+        """Load signal toward this peer: RPCs currently in flight plus
+        queued batch items awaiting a flush.  The replica-count policy
+        (cluster/replication.py, GUBER_REPL_MAX_REPLICAS) sorts on it
+        to grant hot-key leases to the least-loaded peers."""
+        with self._lock:
+            return self._inflight + len(self._queue)

@@ -177,6 +177,7 @@ class ReplicationManager:
         lease_ttl: float = 1.0,
         interval: float = 0.5,
         max_keys: int = 16,
+        max_replicas: int = 0,
     ):
         self._daemon = daemon
         self.enabled = True
@@ -189,6 +190,11 @@ class ReplicationManager:
         self.lease_ttl = lease_ttl
         self.interval = interval
         self.max_keys = max(1, max_keys)
+        # Replica-count policy (GUBER_REPL_MAX_REPLICAS): grant each
+        # hot key to at most this many local-DC peers, chosen
+        # least-loaded; 0 = every peer (ROADMAP item 3's leftover —
+        # load-aware subsets cut grant fan-out on big clusters).
+        self.max_replicas = max(0, max_replicas)
         self._lock = threading.Lock()
         # Replica side: key bytes -> _RemoteLease.
         self._leases: Dict[bytes, _RemoteLease] = {}
@@ -361,12 +367,22 @@ class ReplicationManager:
     def _replica_peers(self) -> List:
         """Local-DC peers that should hold a lease (everyone but us,
         circuit permitting — a broken replica is skipped and its lease
-        expires into the bound, never blocking the owner)."""
-        return [
+        expires into the bound, never blocking the owner).  With
+        `max_replicas` set, fan-out caps at the N LEAST-LOADED peers
+        (load = in-flight RPCs + queued batch items, the signal the
+        peer client already tracks per address): a 50-node cluster
+        does not need 49 grant refreshes per key per TTL, and the
+        over-admission exposure tightens to ≤ max_replicas × lease
+        with it."""
+        peers = [
             p
             for p in self._instance().get_peer_list()
             if not p.info.is_owner and p.health.would_allow()
         ]
+        if self.max_replicas and len(peers) > self.max_replicas:
+            peers.sort(key=lambda p: p.inflight())
+            peers = peers[: self.max_replicas]
+        return peers
 
     def _promote(self, key: bytes, limit: int, duration: int,
                  now: float) -> None:

@@ -38,10 +38,31 @@ class BehaviorConfig:
     global_sync_wait: float = 500 * MICROSECOND
     global_batch_limit: int = 1000
 
-    # Multi-region manager (reference: multiregion.go).
+    # Multi-region manager (reference: multiregion.go; grown into the
+    # federation plane of RESILIENCE.md §12).
     multi_region_timeout: float = 0.5
     multi_region_sync_wait: float = 500 * MICROSECOND
     multi_region_batch_limit: int = 1000
+    # Total wall budget for one cross-region fan-out barrier, seconds
+    # (GUBER_MULTI_REGION_FANOUT_DEADLINE): one slow/dead region must
+    # not stall a flush window past this, whatever the per-RPC
+    # timeout is.
+    multi_region_fanout_deadline: float = 2.0
+    # Cross-region deltas that failed to reach a region are re-queued
+    # (bound to that region) until this old, seconds; older deltas
+    # drop COUNTED (gubernator_multiregion_hits_dropped) — the healed
+    # region's buckets have moved on and replaying stale deltas would
+    # double-count (GUBER_MULTI_REGION_REQUEUE_AGE; 0 disables
+    # re-queueing, restoring the pre-§12 fire-and-forget drop — but
+    # still counted).
+    multi_region_requeue_age: float = 10.0
+    # Per-region retry backoff between failed push rounds — capped
+    # exponential with FULL jitter (cluster/health.backoff_delay;
+    # GUBER_MULTI_REGION_BACKOFF / _CAP).  Rides the batcher's
+    # deferred re-admission, so an open region circuit cannot spin a
+    # flush worker.
+    multi_region_backoff: float = 0.05
+    multi_region_backoff_cap: float = 2.0
 
     # Load-adaptive batching windows (GUBER_ADAPTIVE_WINDOWS, default
     # on): every *_wait above becomes a CAP — idle batchers flush
@@ -210,6 +231,19 @@ KNOWN_ENV_KNOBS = (
     # Discovery plane (read by the k8s watcher, not the daemon config).
     "GUBER_K8S_NAMESPACE",    # discovery/kubernetes.py
     "GUBER_K8S_POD_SELECTOR",  # discovery/kubernetes.py
+    # Multi-region federation plane (cluster/multiregion.py;
+    # RESILIENCE.md §12).  These are daemon knobs — they load in
+    # setup_daemon_config below like every BehaviorConfig field — and
+    # are ALSO indexed here because they define the cross-region
+    # resilience surface operators tune as one unit.
+    "GUBER_MULTI_REGION_FANOUT_DEADLINE",  # setup_daemon_config:
+                              # cross-region fan-out barrier budget
+    "GUBER_MULTI_REGION_REQUEUE_AGE",  # setup_daemon_config: retry
+                              # backlog age cap (drops counted past it)
+    "GUBER_MULTI_REGION_BACKOFF",  # setup_daemon_config: per-region
+                              # retry backoff base (full jitter)
+    "GUBER_MULTI_REGION_BACKOFF_CAP",  # setup_daemon_config: per-region
+                              # retry backoff ceiling
 )
 
 
@@ -449,6 +483,13 @@ class DaemonConfig:
     repl_interval: float = 0.5
     # Max concurrently replicated keys per owner (GUBER_REPL_MAX_KEYS).
     repl_max_keys: int = 16
+    # Replica-count policy (GUBER_REPL_MAX_REPLICAS): cap each hot
+    # key's grant fan-out to the N least-loaded local-DC peers (load =
+    # in-flight RPCs + queued batch items toward the peer) instead of
+    # every peer.  0 = unlimited (every local-DC peer, the pre-policy
+    # behavior).  Cuts grant-refresh fan-out on big clusters while the
+    # over-admission bound tightens with it (≤ N × lease).
+    repl_max_replicas: int = 0
 
     # Native decision plane (GUBER_NATIVE_LEDGER, default on): delegate
     # the ledger's exact fast path (sticky over-limit + lease drains)
@@ -485,6 +526,18 @@ def setup_daemon_config(
             d, "GUBER_MULTI_REGION_SYNC_WAIT", 500 * MICROSECOND
         ),
         multi_region_batch_limit=_env_int(d, "GUBER_MULTI_REGION_BATCH_LIMIT", 1000),
+        multi_region_fanout_deadline=_env_float_seconds(
+            d, "GUBER_MULTI_REGION_FANOUT_DEADLINE", 2.0
+        ),
+        multi_region_requeue_age=_env_float_seconds(
+            d, "GUBER_MULTI_REGION_REQUEUE_AGE", 10.0
+        ),
+        multi_region_backoff=_env_float_seconds(
+            d, "GUBER_MULTI_REGION_BACKOFF", 0.05
+        ),
+        multi_region_backoff_cap=_env_float_seconds(
+            d, "GUBER_MULTI_REGION_BACKOFF_CAP", 2.0
+        ),
         adaptive_windows=_env(d, "GUBER_ADAPTIVE_WINDOWS", "1").strip().lower()
         not in ("0", "false", "no", "off"),
         circuit_failures=_env_int(d, "GUBER_CIRCUIT_FAILURES", 3),
@@ -626,6 +679,7 @@ def setup_daemon_config(
         ),
         repl_interval=_env_float_seconds(d, "GUBER_REPL_INTERVAL", 0.5),
         repl_max_keys=_env_int(d, "GUBER_REPL_MAX_KEYS", 16),
+        repl_max_replicas=_env_int(d, "GUBER_REPL_MAX_REPLICAS", 0),
         membership_epoch_timeout=_env_float_seconds(
             d, "GUBER_MEMBERSHIP_EPOCH_TIMEOUT", 30.0
         ),

@@ -219,6 +219,7 @@ class Daemon:
                 lease_ttl=conf.repl_lease_ttl,
                 interval=conf.repl_interval,
                 max_keys=conf.repl_max_keys,
+                max_replicas=conf.repl_max_replicas,
             )
             self.instance.replication = self.replication
             self.replication.start()
@@ -534,6 +535,16 @@ class Daemon:
         if self.replication is None:
             return {}
         return self.replication.stats()
+
+    def multiregion_stats(self) -> dict:
+        """This node's cross-region federation view: window/push
+        counters, per-region sends and circuit states, the retry
+        backlog, and the window-wait / region-RPC hop budget — the
+        same numbers /metrics exports as gubernator_multiregion_*
+        (bench artifacts embed it, like peer_health())."""
+        if self.instance is None:
+            return {}
+        return self.instance.multi_region_mgr.stats()
 
     def drain(self, deadline: Optional[float] = None) -> dict:
         """Planned leave: ship EVERY held bucket to its owner under

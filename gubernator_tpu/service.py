@@ -358,6 +358,12 @@ class V1Instance:
             # lease (cluster/replication.py) — the forward hops the
             # hot-key replication plane removed.
             "replicated_local": 0,
+            # MULTI_REGION answers served while at least one remote
+            # region's aggregate circuit was OPEN (the answer is
+            # region-local as always, but cross-region convergence is
+            # deferred): flagged metadata.degraded_region=true, drift
+            # bounded at N_regions × limit (RESILIENCE.md §12).
+            "degraded_region_answers": 0,
         }
         # Ownership-handoff traffic (cluster/handoff.py), exported as
         # gubernator_handoff_keys{event}: rows shipped to new owners,
@@ -388,6 +394,11 @@ class V1Instance:
             "hits_window_wait": self.global_mgr.hits_window_wait,
             "owner_rpc": self.global_mgr.owner_rpc_duration,
             "broadcast_age": self.global_mgr.broadcast_age,
+            # Cross-region hop budget (RESILIENCE.md §12 / PERF.md
+            # §28): how long queued region deltas wait for their
+            # window, and the per-region push RPC itself.
+            "multiregion.window_wait": self.multi_region_mgr.window_wait,
+            "multiregion.region_rpc": self.multi_region_mgr.region_rpc,
         }
         # Device-plane budget (PERF.md §24, mirroring the §10b host
         # stages): device.step is the per-dispatch wall time of the
@@ -1575,9 +1586,14 @@ class V1Instance:
         g_items = [r for r in reqs if int(r.behavior) & _GLOBAL_I]
         if g_items:
             self.global_mgr.queue_updates_many(g_items)
-        mr_items = [r for r in reqs if int(r.behavior) & _MULTI_REGION_I]
-        for r in mr_items:
-            self.multi_region_mgr.queue_hits(r)
+        mr_idx = [
+            i for i, r in enumerate(reqs)
+            if int(r.behavior) & _MULTI_REGION_I
+        ]
+        if mr_idx:
+            self.multi_region_mgr.queue_hits_many(
+                reqs[i] for i in mr_idx
+            )
         if self.ledger is not None:
             # This batch runs on the engine outside the ledger: settle
             # and drop any ledger entry for its keys first, so the
@@ -1586,7 +1602,27 @@ class V1Instance:
             self.ledger.invalidate_keys(
                 [r.hash_key().encode() for r in reqs]
             )
-        return self.engine.get_rate_limits(reqs, now_ms=now_ms)
+        resps = self.engine.get_rate_limits(reqs, now_ms=now_ms)
+        if mr_idx:
+            # Honest degradation hints ("When Two is Worse Than One"):
+            # while a remote region's aggregate circuit is OPEN, this
+            # answer's cross-region convergence is deferred behind the
+            # requeue backlog — flag it so callers can tell a
+            # federated answer from a partition-local one.  The drift
+            # stays bounded: each region admits at most `limit` from
+            # local state, ≤ N_regions × limit cluster-wide
+            # (RESILIENCE.md §12).
+            open_regions = self.multi_region_mgr.open_regions()
+            if open_regions:
+                self.counters["degraded_region_answers"] += len(mr_idx)
+                joined = ",".join(open_regions)
+                for i in mr_idx:
+                    resp = resps[i]
+                    md = dict(resp.metadata) if resp.metadata else {}
+                    md["degraded_region"] = "true"
+                    md["degraded_regions"] = joined
+                    resp.metadata = md
+        return resps
 
     # ------------------------------------------------------------------
     # Peer management (reference: gubernator.go:657-765)

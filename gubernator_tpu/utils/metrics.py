@@ -429,11 +429,56 @@ class InstanceCollector(Collector):
         c.add_metric([], inst.global_mgr.broadcasts)
         yield c
 
+        # ---- multi-region federation (cluster/multiregion.py;
+        # RESILIENCE.md §12): window/push traffic, per-region circuit
+        # state, requeue-and-converge accounting, degraded answers.
+        mrs = inst.multi_region_mgr.stats()
         c = CounterMetricFamily(
-            "gubernator_multiregion_sends",
-            "The count of successful cross-region hit pushes.",
+            "gubernator_multiregion_windows",
+            "Cross-region hit windows flushed (each window fans out "
+            "to every remote region under the fan-out barrier).",
         )
-        c.add_metric([], inst.multi_region_mgr.region_sends)
+        c.add_metric([], mrs["windows"])
+        yield c
+        c = CounterMetricFamily(
+            "gubernator_multiregion_region_sends",
+            "Successful per-region delta pushes, by remote region.",
+            labels=["region"],
+        )
+        for region, n in sorted(mrs["region_sends_by"].items()):
+            c.add_metric([region], n)
+        yield c
+        c = CounterMetricFamily(
+            "gubernator_multiregion_hits_requeued",
+            "Cross-region deltas re-queued toward an unreachable "
+            "region (bounded, age-capped, delivered after heal).",
+        )
+        c.add_metric([], mrs["hits_requeued"])
+        yield c
+        c = CounterMetricFamily(
+            "gubernator_multiregion_hits_dropped",
+            "Cross-region deltas dropped at the requeue age/key cap "
+            "or toward a departed region — counted, never silent; the "
+            "drift bound covers what they would have reconciled.",
+        )
+        c.add_metric([], mrs["hits_dropped"])
+        yield c
+        g = GaugeMetricFamily(
+            "gubernator_multiregion_region_state",
+            "Aggregate circuit state per remote region (1 on the "
+            "current state's series): healthy | degraded | open.",
+            labels=["region", "state"],
+        )
+        for region, st in sorted(mrs["region_states"].items()):
+            g.add_metric([region, st], 1)
+        yield g
+        c = CounterMetricFamily(
+            "gubernator_multiregion_degraded_answers",
+            "MULTI_REGION answers served while a remote region's "
+            "circuit was open (metadata.degraded_region=true; "
+            "over-admission bounded at N_regions x limit per window).",
+        )
+        c.add_metric([], inst.counters.get("degraded_region_answers", 0))
         yield c
 
         c = CounterMetricFamily(

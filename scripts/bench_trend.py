@@ -170,6 +170,29 @@ def _extract(data: dict) -> dict | None:
             out["over_admission_bound"] = can.get("bound")
         if data.get("errors") is not None:
             out["errors"] = data["errors"]
+    # Multi-region federation artifacts (crossregion mode): fold the
+    # partitioned phase's error rate + degraded-region answers (the
+    # 0-errors acceptance), the drift canary's over-admission against
+    # its N_regions x limit bound, the post-heal convergence seconds,
+    # and the requeue drop count (0 inside the age cap).
+    if data.get("heal_convergence_s") is not None:
+        out["heal_convergence_s"] = data["heal_convergence_s"]
+        part = data.get("partitioned")
+        if isinstance(part, dict):
+            if part.get("requests"):
+                out["error_rate"] = round(
+                    part.get("errors", 0) / part["requests"], 4
+                )
+            if part.get("degraded_region_answers") is not None:
+                out["degraded_region_answers"] = part[
+                    "degraded_region_answers"
+                ]
+        can = data.get("canary")
+        if isinstance(can, dict) and can.get("over_admission") is not None:
+            out["over_admission"] = can["over_admission"]
+            out["over_admission_bound"] = can.get("bound")
+        if data.get("hits_dropped") is not None:
+            out["multiregion_hits_dropped"] = data["hits_dropped"]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly eight things:
+# Runs exactly nine things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -37,13 +37,21 @@
 #      promotes to replica credit leases, answers go local, cooldown
 #      demotes and the credit reconciles — the hot-key adaptive
 #      ownership gate (RESILIENCE.md section 11), 120 s wall budget;
-#   7. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   7. the crossregion smoke (scripts/crossregion_smoke.py): a
+#      jax-free 2×2 region×peer loopback harness driven through a
+#      full partition-heal-converge arc — failed cross-region deltas
+#      re-queue (counted, zero dropped), the region aggregate circuit
+#      reads `open`, and the healed region converges — the
+#      multi-region federation gate (RESILIENCE.md section 12), 30 s
+#      wall budget;
+#   8. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
-#      join/drain/kill-during-handoff reshard invariants; the
-#      multi-cycle soaks are @slow);
-#   8. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#      join/drain/kill-during-handoff reshard invariants;
+#      tests/test_multiregion.py: the full-stack 2×2 federation
+#      invariants; the multi-cycle soaks are @slow);
+#   9. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -156,6 +164,23 @@ if [ "${REPL_MS}" -gt 120000 ]; then
   echo "replication smoke blew its 120 s budget — promotion must engage" >&2
   echo "within seconds on a test-timescale cluster or the plane is" >&2
   echo "too slow to matter in a real flash crowd" >&2
+  exit 1
+fi
+
+echo "=== crossregion smoke (2x2 partition-heal-converge) ===" >&2
+XR_T0=$(date +%s%N)
+if ! timeout -k 10 60 python scripts/crossregion_smoke.py; then
+  echo "crossregion smoke: the multi-region federation plane dropped" >&2
+  echo "deltas, failed to re-queue across a partition, or did not" >&2
+  echo "converge after the heal (scripts/crossregion_smoke.py;" >&2
+  echo "RESILIENCE.md section 12)" >&2
+  exit 1
+fi
+XR_MS=$(( ($(date +%s%N) - XR_T0) / 1000000 ))
+echo "crossregion smoke: ${XR_MS} ms (budget 30000 ms)" >&2
+if [ "${XR_MS}" -gt 30000 ]; then
+  echo "crossregion smoke blew its 30 s budget — it must stay jax-free" >&2
+  echo "and cheap enough to gate every federation-plane edit" >&2
   exit 1
 fi
 

@@ -1,0 +1,230 @@
+"""Crossregion smoke: 2×2 federation partition-heal-converge, fast.
+
+ci_fast.sh stage (30 s wall budget, mirroring the feeder/event-front
+smoke pattern): drive the REAL MultiRegionManager + fault injector +
+per-peer circuit breakers through a full partition arc on a jax-free,
+grpc-server-free 2-region × 2-node loopback harness — the smoke
+budget is spent on the federation plane, not on XLA warmup or daemon
+bootstrap.  The full-stack 2×2 invariants (real daemons, wire RPCs,
+degraded metadata end to end) are pinned by tests/test_multiregion.py
+in the tier-1 suite.
+
+Asserts, in order:
+
+1. HEALTHY: queued MULTI_REGION deltas aggregate per window, push to
+   the remote region's per-key owners with the flag cleared, and the
+   remote "engines" converge onto the summed hits.
+2. PARTITION: cross-region sends fail into the breakers; failed
+   deltas RE-QUEUE (bounded, counted) instead of dropping; once every
+   remote member's circuit opens the region aggregate reads `open`.
+3. HEAL + CONVERGE: the retry backlog drains, the partition-era
+   deltas land remotely, and hits_dropped stays 0 — requeue-and-
+   converge, measured inside the budget.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    import logging
+    import threading
+
+    # The partition phase MEANS to fail sends; keep the smoke output
+    # to its one OK line.
+    logging.getLogger("gubernator_tpu.multiregion").setLevel(
+        logging.ERROR
+    )
+
+    from gubernator_tpu.cluster import faults
+    from gubernator_tpu.cluster.health import REGION_OPEN, PeerHealth
+    from gubernator_tpu.cluster.multiregion import MultiRegionManager
+    from gubernator_tpu.cluster.peer_client import PeerError
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.types import Behavior, PeerInfo, RateLimitReq
+
+    MR = int(Behavior.MULTI_REGION)
+
+    class Node:
+        """One federated 'daemon': an applied-hits ledger standing in
+        for the engine, plus its region tag."""
+
+        def __init__(self, addr: str, region: str):
+            self.addr = addr
+            self.region = region
+            self.applied: dict = {}
+            self._lock = threading.Lock()
+
+        def apply(self, reqs) -> None:
+            with self._lock:
+                for r in reqs:
+                    assert int(r.behavior) & MR == 0, (
+                        "forwarded copy must clear MULTI_REGION"
+                    )
+                    k = r.hash_key()
+                    self.applied[k] = self.applied.get(k, 0) + r.hits
+
+        def total(self) -> int:
+            with self._lock:
+                return sum(self.applied.values())
+
+    class LoopbackPeer:
+        """In-process PeerClient stand-in: the fault injector gates
+        the send at the same (src, dst) choke point, outcomes feed a
+        real PeerHealth breaker."""
+
+        def __init__(self, src: Node, dst: Node):
+            self.info = PeerInfo(
+                grpc_address=dst.addr, http_address="",
+                datacenter=dst.region,
+            )
+            self._src, self._dst = src, dst
+            self.health = PeerHealth(
+                dst.addr, failure_threshold=3, backoff=0.1,
+                backoff_cap=0.5,
+            )
+
+        def send_peer_hits(self, reqs, timeout=None):
+            if not self.health.allow():
+                raise PeerError(
+                    f"circuit open to {self.info.grpc_address}",
+                    not_ready=True, circuit_open=True,
+                )
+            inj = faults.active()
+            if inj is not None:
+                try:
+                    inj.check(self._src.addr, self._dst.addr)
+                except faults.FaultError as e:
+                    self.health.record_failure()
+                    raise PeerError(str(e), not_ready=True) from e
+            self._dst.apply(reqs)
+            self.health.record_success()
+
+    class Ring:
+        def __init__(self, peers):
+            self._peers = list(peers)
+
+        def get(self, key):
+            # Deterministic per-key owner inside the region.
+            return self._peers[sum(key.encode()) % len(self._peers)]
+
+        def peers(self):
+            return list(self._peers)
+
+    class Instance:
+        def __init__(self, regions):
+            self.regions = regions
+
+        def get_region_pickers(self):
+            return self.regions
+
+    conf = BehaviorConfig(
+        multi_region_sync_wait=0.005,
+        multi_region_timeout=0.2,
+        multi_region_batch_limit=100,
+        multi_region_fanout_deadline=0.5,
+        multi_region_requeue_age=20.0,
+        multi_region_backoff=0.01,
+        multi_region_backoff_cap=0.05,
+    )
+    east = [Node(f"10.0.0.{i}:81", "east") for i in (1, 2)]
+    west = [Node(f"10.0.1.{i}:81", "west") for i in (1, 2)]
+    mgrs = {}
+    for node, remote_region, remotes in (
+        (east[0], "west", west), (east[1], "west", west),
+        (west[0], "east", east), (west[1], "east", east),
+    ):
+        ring = Ring([LoopbackPeer(node, r) for r in remotes])
+        mgrs[node.addr] = MultiRegionManager(
+            conf, Instance({remote_region: ring})
+        )
+
+    def req(key, hits):
+        return RateLimitReq(
+            name="xr", unique_key=key, hits=hits, limit=10**9,
+            duration=3_600_000, behavior=MR,
+        )
+
+    inj = faults.install(faults.FaultInjector(seed=3))
+    try:
+        # -- phase 1: healthy push + converge --------------------------
+        mgrs[east[0].addr].queue_hits(req("a", 5))
+        mgrs[east[1].addr].queue_hits(req("b", 7))
+        for n in east:
+            mgrs[n.addr].retry_now()
+        assert sum(w.total() for w in west) == 12, [
+            w.applied for w in west
+        ]
+        st = mgrs[east[0].addr].stats()
+        assert st["windows"] >= 1 and st["region_sends"] >= 1, st
+
+        # -- phase 2: partition → requeue + open region ----------------
+        for e in east:
+            for w in west:
+                inj.partition(e.addr, w.addr)
+                inj.partition(w.addr, e.addr)
+        # Keys owned by BOTH west members (region `open` means every
+        # member refuses, so both circuits must see failures).
+        k_by_owner = {}
+        i = 0
+        while len(k_by_owner) < 2:
+            key = f"p{i}"
+            k_by_owner.setdefault(
+                sum(f"xr_{key}".encode()) % 2, key
+            )
+            i += 1
+        mgr0 = mgrs[east[0].addr]
+        for key in k_by_owner.values():
+            mgr0.queue_hits(req(key, 3))
+        for _ in range(4):  # breaker threshold 3 → both circuits open
+            mgr0.retry_now()
+            time.sleep(0.02)
+        st = mgr0.stats()
+        assert st["hits_requeued"] >= 2, st
+        assert st["hits_dropped"] == 0, st
+        assert st["region_states"].get("west") == REGION_OPEN, st
+        before = sum(w.total() for w in west)
+        assert before == 12, "partitioned deltas must not leak through"
+
+        # -- phase 3: heal → converge ----------------------------------
+        inj.heal()
+        t_heal = time.monotonic()
+        deadline = t_heal + 10.0
+        while time.monotonic() < deadline:
+            mgr0.retry_now()
+            if (
+                mgr0.pending_retry() == 0
+                and sum(w.total() for w in west) == 18
+            ):
+                break
+            time.sleep(0.05)
+        converge_s = time.monotonic() - t_heal
+        assert sum(w.total() for w in west) == 18, [
+            w.applied for w in west
+        ]
+        assert mgr0.pending_retry() == 0
+        assert mgr0.stats()["hits_dropped"] == 0, mgr0.stats()
+    finally:
+        faults.uninstall()
+        for m in mgrs.values():
+            m.close()
+
+    elapsed_ms = (time.monotonic() - t0) * 1e3
+    print(
+        "crossregion smoke OK: 2x2 partition-heal-converge "
+        f"(heal->converge {converge_s * 1e3:.0f} ms, 0 dropped) "
+        f"in {elapsed_ms:.0f} ms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

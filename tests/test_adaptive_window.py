@@ -141,6 +141,9 @@ STAGES = (
     "device.step",
     "device.readback",
     "device.window_wait",
+    # Cross-region hop budget (ISSUE 14 / RESILIENCE.md §12).
+    "multiregion.window_wait",
+    "multiregion.region_rpc",
 )
 
 

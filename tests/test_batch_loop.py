@@ -150,3 +150,66 @@ def test_backlog_age_reanchors_after_drop_oldest_shed():
         release.set()
     finally:
         b.close()
+
+
+def test_deferred_requeue_held_until_due_then_flushes():
+    """requeue_many(delay=): the held batch is invisible to the drain
+    until its due time, then re-admits and flushes WITHOUT any fresh
+    traffic — the multiregion damped-retry primitive (RESILIENCE.md
+    section 12): no flush-worker sleep, no spin against an open
+    circuit, and a healed peer converges even after clients go
+    quiet."""
+    flushes = []
+
+    def flush(batch):
+        flushes.append(dict(batch))
+
+    b = IntervalBatcher(0.001, 100, _combine, flush)
+    try:
+        t0 = time.monotonic()
+        assert b.requeue_many([("k", 3)], oldest_ts=t0 - 1.0, delay=0.25) == 1
+        assert b.pending() == 1  # held items count as pending
+        assert b.backlog_age() >= 0.9  # ...with their ORIGINAL age
+        time.sleep(0.1)
+        assert flushes == []  # not due yet: nothing drained
+        deadline = time.monotonic() + 5
+        while not flushes and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert flushes == [{"k": 3}]
+        # The retry fired at (roughly) its due time, unprompted.
+        assert time.monotonic() - t0 >= 0.24
+    finally:
+        b.close()
+
+
+def test_flush_now_force_held_promotes_early():
+    """flush_now(force_held=True) delivers a not-yet-due held batch
+    immediately (the post-heal convergence probe)."""
+    flushes = []
+
+    def flush(batch):
+        flushes.append(dict(batch))
+
+    b = IntervalBatcher(0.001, 100, _combine, flush)
+    try:
+        b.requeue_many([("k", 7)], delay=30.0)
+        b.flush_now()  # NOT forced: the held batch must stay held
+        assert flushes == []
+        b.flush_now(force_held=True)
+        assert flushes == [{"k": 7}]
+    finally:
+        b.close()
+
+
+def test_close_drains_held_batches():
+    """close() must deliver-or-fail the held retry backlog, not
+    strand it."""
+    flushes = []
+
+    def flush(batch):
+        flushes.append(dict(batch))
+
+    b = IntervalBatcher(0.001, 100, _combine, flush)
+    b.requeue_many([("k", 1)], delay=30.0)
+    b.close()
+    assert flushes == [{"k": 1}]

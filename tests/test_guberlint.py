@@ -614,6 +614,61 @@ def test_net_pass_log_and_continue_is_not_a_retry_loop(tmp_path):
     assert not [f for f in findings if f.rule == "net-retry-no-backoff"]
 
 
+def test_net_pass_flags_backoffless_crossregion_retry(tmp_path):
+    """ISSUE 14: the multiregion log-and-continue exemption is gone —
+    a cross-region push loop that RE-QUEUES failed deltas (a requeue
+    IS a retry decision, one window removed) without any backoff must
+    flag.  The live multiregion send path passes because its handler
+    computes a backoff_delay for the deferred requeue."""
+    from tools.guberlint import netcheck
+
+    code = """
+        from gubernator_tpu.cluster.peer_client import PeerError
+
+        def push_regions(self, by_region, conf):
+            for region, (peer, reqs) in by_region.items():
+                try:
+                    peer.send_peer_hits(
+                        reqs, timeout=conf.multi_region_timeout
+                    )
+                except PeerError as e:
+                    self._requeue_region(region, reqs)
+                    continue
+    """
+    findings = netcheck.check_file(_src(tmp_path, code))
+    assert any(f.rule == "net-retry-no-backoff" for f in findings), (
+        findings
+    )
+
+
+def test_net_pass_crossregion_retry_with_backoff_ok(tmp_path):
+    """The §12 multiregion shape: the handler computes a capped
+    full-jitter backoff_delay for the deferred requeue — clean."""
+    from tools.guberlint import netcheck
+
+    code = """
+        from gubernator_tpu.cluster.peer_client import PeerError
+        from gubernator_tpu.cluster.health import backoff_delay
+
+        def push_regions(self, by_region, conf):
+            for region, (peer, reqs) in by_region.items():
+                try:
+                    peer.send_peer_hits(
+                        reqs, timeout=conf.multi_region_timeout
+                    )
+                except PeerError as e:
+                    delay = backoff_delay(
+                        self.attempts.get(region, 0), 0.05, 2.0
+                    )
+                    self._requeue_region(region, reqs, delay)
+                    continue
+    """
+    findings = netcheck.check_file(_src(tmp_path, code))
+    assert not [
+        f for f in findings if f.rule == "net-retry-no-backoff"
+    ], findings
+
+
 def test_net_pass_catches_rpc_without_timeout(tmp_path):
     from tools.guberlint import netcheck
 

@@ -1,0 +1,547 @@
+"""Multi-region federation suite (RESILIENCE.md §12, ISSUE 14).
+
+Two tiers:
+
+- UNIT: a MultiRegionManager over fake region rings/peers (no jax, no
+  grpc servers) pins window aggregation, the cleared MULTI_REGION flag
+  on forwarded copies, requeue-on-failure with age-capped counted
+  drops, per-region circuit aggregation, and the fan-out barrier.
+- CLUSTER: a real 2×2 region×peer harness (two datacenters, two
+  daemons each) pins the federation invariants end to end — degraded
+  region metadata under partition, the canary over-admission bound
+  (≤ N_regions × limit), heal convergence with zero drops, and the
+  metrics surface.
+
+Fast cases run tier-1; the multi-cycle partition soak is @slow.
+"""
+
+import time
+from dataclasses import replace as dc_replace
+
+import pytest
+
+from gubernator_tpu.client import V1Client, random_string
+from gubernator_tpu.cluster.harness import ClusterHarness, cluster_behaviors
+from gubernator_tpu.cluster.health import (
+    REGION_DEGRADED,
+    REGION_HEALTHY,
+    REGION_OPEN,
+    PeerHealth,
+    aggregate_region_state,
+)
+from gubernator_tpu.cluster.multiregion import MultiRegionManager, _combine
+from gubernator_tpu.cluster.peer_client import PeerError
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.types import Behavior, PeerInfo, RateLimitReq, Status
+
+_MR = int(Behavior.MULTI_REGION)
+
+
+def _until(pred, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _req(name, key, limit=1_000_000, hits=1, behavior=_MR):
+    return RateLimitReq(
+        name=name,
+        unique_key=key,
+        hits=hits,
+        limit=limit,
+        duration=60_000,
+        behavior=behavior,
+    )
+
+
+# ----------------------------------------------------------------------
+# Unit tier: fake regions.
+
+
+class FakePeer:
+    def __init__(self, addr, dc):
+        self.info = PeerInfo(
+            grpc_address=addr, http_address="", datacenter=dc
+        )
+        self.health = PeerHealth(
+            addr, failure_threshold=3, backoff=0.4, backoff_cap=2.0
+        )
+        self.fail = False
+        self.delay = 0.0
+        self.sent = []  # list of request lists, in delivery order
+
+    def send_peer_hits(self, reqs, timeout=None):
+        if not self.health.allow():
+            raise PeerError(
+                f"circuit open to {self.info.grpc_address}",
+                not_ready=True, circuit_open=True,
+            )
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            self.health.record_failure()
+            raise PeerError("injected region fault", not_ready=True)
+        self.health.record_success()
+        self.sent.append(list(reqs))
+
+
+class FakeRing:
+    def __init__(self, peers):
+        self._peers = list(peers)
+
+    def get(self, key):
+        # Deterministic key→member mapping (tests pick keys per peer).
+        return self._peers[hash(key) % len(self._peers)]
+
+    def peers(self):
+        return list(self._peers)
+
+
+class FakeInstance:
+    def __init__(self, regions):
+        self.regions = {dc: FakeRing(peers) for dc, peers in regions.items()}
+
+    def get_region_pickers(self):
+        return self.regions
+
+
+def _behaviors(**over):
+    base = dict(
+        multi_region_sync_wait=0.01,
+        multi_region_timeout=0.2,
+        multi_region_batch_limit=100,
+        multi_region_fanout_deadline=0.5,
+        multi_region_requeue_age=2.0,
+        multi_region_backoff=0.01,
+        multi_region_backoff_cap=0.05,
+    )
+    base.update(over)
+    return BehaviorConfig(**base)
+
+
+def _mgr(regions, **over):
+    inst = FakeInstance(regions)
+    return MultiRegionManager(_behaviors(**over), inst), inst
+
+
+def test_combine_sums_hits_latest_config_wins():
+    a = _req("mr", "k", hits=3, limit=10)
+    b = _req("mr", "k", hits=4, limit=20)
+    assert _combine(None, a) is a
+    merged = _combine(a, b)
+    assert merged.hits == 7
+    assert merged.limit == 20  # latest occurrence's config
+
+
+def test_window_aggregates_and_clears_flag_per_region():
+    east = FakePeer("10.0.0.1:81", "dc-b")
+    west = FakePeer("10.0.1.1:81", "dc-c")
+    mgr, _ = _mgr({"dc-b": [east], "dc-c": [west]})
+    try:
+        for h in (1, 2, 4):
+            mgr.queue_hits(_req("mr", "agg", hits=h))
+        mgr.retry_now()
+        for peer in (east, west):
+            assert len(peer.sent) == 1, peer.sent
+            (r,) = peer.sent[0]
+            assert r.hits == 7  # one aggregated delta per region
+            # The forwarded copy clears MULTI_REGION: the receiving
+            # region applies locally — no DCN ping-pong loop.
+            assert int(r.behavior) & _MR == 0
+        st = mgr.stats()
+        assert st["windows"] == 1
+        assert st["region_sends_by"] == {"dc-b": 1, "dc-c": 1}
+    finally:
+        mgr.close()
+
+
+def test_failed_region_requeues_only_there_and_converges():
+    ok = FakePeer("10.0.0.1:81", "dc-b")
+    down = FakePeer("10.0.1.1:81", "dc-c")
+    down.fail = True
+    mgr, _ = _mgr({"dc-b": [ok], "dc-c": [down]})
+    try:
+        mgr.queue_hits(_req("mr", "cv", hits=5))
+        mgr.retry_now()
+        assert len(ok.sent) == 1
+        assert down.sent == []
+        st = mgr.stats()
+        assert st["hits_requeued"] >= 1
+        assert st["pending_retry"] == 1
+        # Heal: the retry is bound to dc-c ONLY — dc-b must not see
+        # the delta twice (that would double-count its region).
+        down.fail = False
+        assert _until(
+            lambda: (mgr.retry_now(), None)[1] or len(down.sent) >= 1,
+            timeout=5.0,
+        ), mgr.stats()
+        (r,) = down.sent[0]
+        assert r.hits == 5
+        assert len(ok.sent) == 1  # never resent to the healthy region
+        assert mgr.pending_retry() == 0
+        assert mgr.stats()["hits_dropped"] == 0
+    finally:
+        mgr.close()
+
+
+def test_requeue_age_cap_drops_counted():
+    down = FakePeer("10.0.1.1:81", "dc-c")
+    down.fail = True
+    mgr, _ = _mgr({"dc-c": [down]}, multi_region_requeue_age=0.1)
+    try:
+        mgr.queue_hits(_req("mr", "age", hits=1))
+        mgr.retry_now()  # fails → first-failure ts recorded
+        assert mgr.stats()["hits_requeued"] >= 1
+        time.sleep(0.15)  # inside (age_cap, 2*age_cap]
+        mgr.retry_now()  # fails again → the age check drops, counted
+        assert _until(
+            lambda: (mgr.retry_now(), None)[1]
+            or mgr.stats()["hits_dropped"] >= 1,
+            timeout=3.0,
+        ), mgr.stats()
+        assert mgr.pending_retry() == 0
+    finally:
+        mgr.close()
+
+
+def test_region_state_aggregates_member_breakers():
+    a = PeerHealth("a", failure_threshold=1, backoff=5.0)
+    b = PeerHealth("b", failure_threshold=1, backoff=5.0)
+    assert aggregate_region_state([a, b]) == REGION_HEALTHY
+    a.record_failure()  # breaks immediately (threshold 1)
+    assert aggregate_region_state([a, b]) == REGION_DEGRADED
+    b.record_failure()
+    assert aggregate_region_state([a, b]) == REGION_OPEN
+    assert aggregate_region_state([]) == REGION_HEALTHY
+    b.record_success()
+    assert aggregate_region_state([a, b]) == REGION_DEGRADED
+
+
+def test_open_region_surfaces_in_manager_states():
+    down = FakePeer("10.0.1.1:81", "dc-c")
+    down.fail = True
+    mgr, _ = _mgr({"dc-c": [down]})
+    try:
+        for i in range(3):  # threshold 3 → circuit opens
+            mgr.queue_hits(_req("mr", f"st{i}", hits=1))
+            mgr.retry_now()
+        assert _until(
+            lambda: mgr.region_states().get("dc-c") == REGION_OPEN,
+            timeout=3.0,
+        ), mgr.region_states()
+        assert mgr.open_regions() == ["dc-c"]
+    finally:
+        mgr.close()
+
+
+def test_fanout_deadline_bounds_slow_region():
+    """A region swallowing sends whole (2 s per RPC) must not stall
+    the window past the barrier budget — the healthy region's delta
+    still lands inside it."""
+    slow = FakePeer("10.0.1.1:81", "dc-slow")
+    slow.delay = 2.0
+    quick = FakePeer("10.0.0.1:81", "dc-quick")
+    mgr, _ = _mgr(
+        {"dc-slow": [slow], "dc-quick": [quick]},
+        multi_region_fanout_deadline=0.4,
+    )
+    try:
+        mgr.queue_hits(_req("mr", "dl", hits=1))
+        t0 = time.monotonic()
+        mgr.retry_now()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.5, f"window stalled {elapsed:.2f}s"
+        assert len(quick.sent) == 1
+        from gubernator_tpu.utils.metrics import swallowed_counts
+
+        assert swallowed_counts().get("multiregion.fanout_deadline", 0) > 0
+    finally:
+        mgr.close()
+
+
+def test_unroutable_key_counts_swallow():
+    class BadRing(FakeRing):
+        def get(self, key):
+            raise RuntimeError("picker torn down")
+
+    inst = FakeInstance({})
+    inst.regions = {"dc-x": BadRing([FakePeer("10.9.9.9:81", "dc-x")])}
+    mgr = MultiRegionManager(_behaviors(), inst)
+    try:
+        from gubernator_tpu.utils.metrics import swallowed_counts
+
+        before = swallowed_counts().get("multiregion.pick", 0)
+        mgr.queue_hits(_req("mr", "bad", hits=1))
+        mgr.retry_now()
+        assert swallowed_counts().get("multiregion.pick", 0) > before
+    finally:
+        mgr.close()
+
+
+# ----------------------------------------------------------------------
+# Cluster tier: the 2×2 region×peer topology.
+
+WEST = "dc-west"
+
+
+@pytest.fixture(scope="module")
+def xr():
+    h = ClusterHarness().start(4, datacenters=["", "", WEST, WEST])
+    h.install_faults(seed=21)
+    yield h
+    h.stop()
+
+
+def _mr_keys_by_west_owner(h, name, prefix):
+    """Two keys with ONE east owner (daemon 0) but DIFFERENT west
+    owners: region `open` means the whole region refuses, so the
+    answering east owner's circuits to BOTH west daemons must open —
+    which takes failed pushes toward both."""
+    east_addr = h.daemons[0].peer_info().grpc_address
+    out = {}
+    i = 0
+    while len(out) < 2:
+        key = f"{i}_{prefix}{random_string()}"
+        hk = f"{name}_{key}"
+        if h.owner_of(hk).peer_info().grpc_address != east_addr:
+            i += 1
+            continue
+        addr = h.owner_of(hk, WEST).peer_info().grpc_address
+        if addr not in out:
+            out[addr] = key
+        i += 1
+        assert i < 40_000
+    return list(out.values())
+
+
+def test_crossregion_hits_converge_when_healthy(xr):
+    h = xr
+    key = f"h_{random_string()}"
+    req = _req("xr_ok", key, hits=7)
+    east_owner = h.owner_of(req.hash_key())
+    west_owner = h.owner_of(req.hash_key(), WEST)
+    with V1Client(east_owner.grpc_address) as c:
+        r = c.get_rate_limits([req], timeout=15)[0]
+        assert r.error == ""
+        assert r.metadata.get("degraded_region") is None
+    # The west owner's engine converges onto the same count.
+    def _west_sees():
+        east_owner.instance.multi_region_mgr.retry_now()
+        with V1Client(west_owner.grpc_address) as wc:
+            wr = wc.get_rate_limits(
+                [_req("xr_ok", key, hits=0)], timeout=15
+            )[0]
+            return wr.remaining == 1_000_000 - 7
+    assert _until(_west_sees, timeout=10.0, interval=0.2)
+
+
+def test_partition_degraded_region_metadata_and_requeue(xr):
+    h = xr
+    keys = _mr_keys_by_west_owner(h, "xr_deg", "dg")
+    h.partition_regions("", WEST)
+    try:
+        east = h.daemons[0]
+        mgr = east.instance.multi_region_mgr
+        with V1Client(east.grpc_address) as c:
+            # Traffic on two keys east-owned by daemon 0 but
+            # west-owned by DIFFERENT west daemons: the failed pushes
+            # open daemon 0's circuit to every west member, the region
+            # aggregate reads `open`, and answers flag
+            # degraded_region.
+            def _degraded():
+                mgr.retry_now()  # push (and re-push) the deltas
+                flagged = False
+                for key in keys:
+                    r = c.get_rate_limits(
+                        [_req("xr_deg", key)], timeout=15
+                    )[0]
+                    assert r.error == ""
+                    if r.metadata.get("degraded_region") == "true":
+                        assert WEST in r.metadata.get(
+                            "degraded_regions", ""
+                        )
+                        flagged = True
+                return flagged
+            assert _until(_degraded, timeout=20.0, interval=0.2), (
+                h.multiregion_states()
+            )
+        # The failed deltas are re-queued, not dropped.
+        total = {}
+        for d, dc in zip(h.daemons, h._datacenters):
+            if dc == "":
+                total[d.grpc_address] = d.multiregion_stats()
+        assert any(
+            st["hits_requeued"] > 0 for st in total.values()
+        ), total
+        assert sum(
+            d.instance.counters["degraded_region_answers"]
+            for d, dc in zip(h.daemons, h._datacenters)
+            if dc == ""
+        ) > 0
+    finally:
+        h.heal()
+        _settle_heal(h)
+
+
+def _settle_heal(h, timeout=20.0):
+    """Drain every node's retry backlog after a heal (probes ride the
+    retries themselves) and wait for circuits to converge."""
+    def _drained():
+        for d in h.daemons:
+            d.instance.multi_region_mgr.retry_now()
+        return all(
+            d.instance.multi_region_mgr.pending_retry() == 0
+            for d in h.daemons
+        )
+    assert _until(_drained, timeout=timeout, interval=0.2), {
+        d.grpc_address: d.multiregion_stats() for d in h.daemons
+    }
+
+
+def test_partition_canary_over_admission_within_region_bound(xr):
+    """The §12 drift bound, asserted live: under a full inter-region
+    partition each region's owner admits from local state, so a
+    finite-limit canary admits at most N_regions × limit cluster-wide
+    (and at least `limit` — the healthy region share)."""
+    h = xr
+    limit = 10
+    key = f"cb_{random_string()}"
+    name = "xr_bound"
+    h.partition_regions("", WEST)
+    try:
+        admitted = 0
+        for dc in ("", WEST):
+            owner = h.owner_of(f"{name}_{key}", dc)
+            with V1Client(owner.grpc_address) as c:
+                for _ in range(3 * limit):
+                    r = c.get_rate_limits(
+                        [_req(name, key, limit=limit)], timeout=15
+                    )[0]
+                    assert r.error == ""
+                    if r.status == Status.UNDER_LIMIT:
+                        admitted += 1
+        n_regions = 2
+        assert limit <= admitted <= n_regions * limit, admitted
+    finally:
+        h.heal()
+        _settle_heal(h)
+
+
+def test_heal_convergence_delivers_requeued_hits(xr):
+    """Deltas queued during the partition land after the heal: the
+    west owner's bucket reflects the east hits, nothing dropped —
+    requeue-and-converge end to end."""
+    h = xr
+    key = f"cv_{random_string()}"
+    name = "xr_conv"
+    hits = 5
+    east_owner = h.owner_of(f"{name}_{key}")
+    west_owner = h.owner_of(f"{name}_{key}", WEST)
+    dropped_before = east_owner.multiregion_stats()["hits_dropped"]
+    h.partition_regions("", WEST)
+    try:
+        with V1Client(east_owner.grpc_address) as c:
+            r = c.get_rate_limits(
+                [_req(name, key, hits=hits)], timeout=15
+            )[0]
+            assert r.error == ""
+        mgr = east_owner.instance.multi_region_mgr
+        mgr.retry_now()  # fails against the partition → requeued
+        assert _until(
+            lambda: (mgr.retry_now(), None)[1]
+            or mgr.pending_retry() > 0,
+            timeout=8.0,
+        ), east_owner.multiregion_stats()
+    finally:
+        h.heal()
+    _settle_heal(h)
+    def _west_converged():
+        with V1Client(west_owner.grpc_address) as wc:
+            wr = wc.get_rate_limits(
+                [_req(name, key, hits=0)], timeout=15
+            )[0]
+            return wr.remaining == 1_000_000 - hits
+    assert _until(_west_converged, timeout=10.0, interval=0.2)
+    assert (
+        east_owner.multiregion_stats()["hits_dropped"] == dropped_before
+    )
+
+
+def test_multiregion_metrics_exported(xr):
+    import urllib.request
+
+    h = xr
+    body = urllib.request.urlopen(
+        f"http://{h.daemons[0].http_address}/metrics", timeout=5
+    ).read().decode()
+    assert "gubernator_multiregion_windows" in body
+    assert "gubernator_multiregion_region_sends" in body
+    assert "gubernator_multiregion_hits_requeued" in body
+    assert "gubernator_multiregion_hits_dropped" in body
+    assert 'gubernator_multiregion_region_state{' in body
+    assert "gubernator_multiregion_degraded_answers" in body
+    # The operator entry mirrors the scrape.
+    st = h.daemons[0].multiregion_stats()
+    assert WEST in st["region_states"]
+    assert "window_wait" in st and "region_rpc" in st
+
+
+# ----------------------------------------------------------------------
+# Soak: partition/heal cycles with sustained federated traffic.
+
+
+@pytest.mark.slow
+def test_multiregion_partition_soak():
+    """Three partition-heal cycles under sustained MULTI_REGION
+    traffic: zero errors throughout (region-local answering), the
+    canary never exceeds N_regions × limit, every cycle converges the
+    retry backlog after heal, and age-cap drops stay zero (the heal
+    always lands inside the requeue age)."""
+    b = dc_replace(cluster_behaviors(), multi_region_requeue_age=30.0)
+    h = ClusterHarness().start(
+        4, datacenters=["", "", WEST, WEST], behaviors=b
+    )
+    h.install_faults(seed=77)
+    try:
+        limit = 50
+        key = f"sk_{random_string()}"
+        n_err = 0
+        admitted = 0
+        def drive(dc, rounds):
+            nonlocal n_err, admitted
+            owner = h.owner_of(f"xr_soak_{key}", dc)
+            with V1Client(owner.grpc_address) as c:
+                for i in range(rounds):
+                    rs = c.get_rate_limits(
+                        [
+                            _req("xr_soak", key, limit=limit),
+                            _req("xr_soak_t", f"t{i % 13}_{dc}"),
+                        ],
+                        timeout=15,
+                    )
+                    for r in rs:
+                        if r.error:
+                            n_err += 1
+                    if rs[0].status == Status.UNDER_LIMIT and not rs[0].error:
+                        admitted += 1
+        for cycle in range(3):
+            drive("", 10)
+            drive(WEST, 10)
+            h.partition_regions("", WEST)
+            drive("", 15)
+            drive(WEST, 15)
+            h.heal()
+            _settle_heal(h)
+        assert n_err == 0
+        assert admitted <= 2 * limit, admitted
+        dropped = sum(
+            d.multiregion_stats()["hits_dropped"] for d in h.daemons
+        )
+        assert dropped == 0, {
+            d.grpc_address: d.multiregion_stats() for d in h.daemons
+        }
+    finally:
+        h.stop()

@@ -514,3 +514,47 @@ def test_remote_lease_rides_native_plane():
         led.detach_native()
         plane.close()
         led.close()
+
+
+def test_max_replicas_caps_fanout_to_least_loaded():
+    """Replica-count policy (GUBER_REPL_MAX_REPLICAS, ISSUE 14
+    satellite): with the cap set, grant fan-out targets the N
+    LEAST-LOADED local-DC peers (load = in-flight RPCs + queued batch
+    items, PeerClient.inflight()) instead of every peer; circuit-open
+    peers are excluded before the cut; 0 keeps the grant-everyone
+    behavior."""
+    from types import SimpleNamespace
+
+    from gubernator_tpu.cluster.replication import ReplicationManager
+
+    class FakePeer:
+        def __init__(self, addr, load, allow=True, owner=False):
+            self.info = SimpleNamespace(
+                grpc_address=addr, is_owner=owner
+            )
+            self.health = SimpleNamespace(
+                would_allow=lambda allow=allow: allow
+            )
+            self._load = load
+
+        def inflight(self):
+            return self._load
+
+    peers = [
+        FakePeer("10.0.0.1:81", 5),
+        FakePeer("10.0.0.2:81", 1),
+        FakePeer("10.0.0.9:81", 0, owner=True),  # self: never a replica
+        FakePeer("10.0.0.3:81", 3),
+        FakePeer("10.0.0.4:81", 9, allow=False),  # broken: skipped
+    ]
+    daemon = SimpleNamespace(
+        instance=SimpleNamespace(get_peer_list=lambda: peers)
+    )
+    capped = ReplicationManager(daemon, max_replicas=2)
+    got = [p.info.grpc_address for p in capped._replica_peers()]
+    assert got == ["10.0.0.2:81", "10.0.0.3:81"], got
+
+    uncapped = ReplicationManager(daemon, max_replicas=0)
+    assert {p.info.grpc_address for p in uncapped._replica_peers()} == {
+        "10.0.0.1:81", "10.0.0.2:81", "10.0.0.3:81",
+    }

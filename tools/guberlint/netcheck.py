@@ -2,12 +2,18 @@
 
 - ``net-retry-no-backoff`` — a retry loop over peer RPCs (a
   ``while``/``for`` whose body catches ``PeerError`` and makes a
-  retry decision: references ``not_ready``/``circuit_open`` or feeds a
-  ``retry``-named collection) must contain a backoff call somewhere in
-  the loop — ``time.sleep``, ``backoff_delay``, or a ``.wait(...)``.
-  A backoff-free re-pick spin is exactly the tail-latency amplifier
+  retry decision: references ``not_ready``/``circuit_open``, feeds a
+  ``retry``-named collection, or calls a ``requeue``-named method)
+  must contain a backoff call somewhere in the loop —
+  ``time.sleep``, ``backoff_delay``, or a ``.wait(...)``.  A
+  backoff-free re-pick spin is exactly the tail-latency amplifier
   the health plane exists to remove ("When Two is Worse Than One",
-  PAPERS.md); the reference's 5-retry loop had this bug.
+  PAPERS.md); the reference's 5-retry loop had this bug.  The
+  multiregion send path's historical log-and-continue suppression is
+  GONE: since the §12 rewrite its fan-out carries real
+  timeout+backoff+requeue and passes this rule on its own — and a
+  requeue-without-backoff loop (the shape that suppression used to
+  hide) now flags, because a requeue call IS a retry decision.
 
 - ``net-rpc-no-timeout`` — call sites of the PeerClient RPC surface
   (``get_peer_rate_limit(s)``, ``send_peer_hits(_raw)``,
@@ -95,17 +101,24 @@ def _catches_peer_error(handler: ast.ExceptHandler) -> bool:
 
 def _is_retry_decision(handler: ast.ExceptHandler) -> bool:
     """The handler decides to RETRY: it inspects not_ready /
-    circuit_open, or feeds a retry collection.  A log-and-continue
-    handler iterating unrelated peers is not a retry loop."""
+    circuit_open, feeds a retry collection, or re-queues the failed
+    items for a later attempt (a requeue IS a retry — deferring it to
+    another window without backoff is the same spin, one hop
+    removed).  A log-and-continue handler iterating unrelated peers
+    is not a retry loop."""
     for node in ast.walk(handler):
         if isinstance(node, ast.Attribute) and node.attr in (
             "not_ready",
             "circuit_open",
         ):
             return True
+        if not isinstance(node, ast.Call):
+            continue
+        callee = attr_path(node.func) or getattr(node.func, "id", "")
+        if "requeue" in (callee or "").lower():
+            return True
         if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
+            isinstance(node.func, ast.Attribute)
             and node.func.attr in ("append", "extend")
         ):
             recv = attr_path(node.func.value) or ""
