@@ -257,6 +257,15 @@ def main() -> int:
             # requeued deltas converge (drops == 0, convergence time
             # recorded).  RESILIENCE.md §12 / PERF.md §28.
             result = _run_crossregion(np, platform)
+        elif MODE == "fleetobs":
+            # Fleet observability A/B (ISSUE 15): the cluster rollup
+            # + SLO watchdog live at a bench-visible tick on a 2×2
+            # region×peer cluster vs every watchdog paused (the
+            # GUBER_OBS=0 steady state), alternating pairs with the
+            # median-of-pair-deltas treatment — pins the plane's
+            # serving overhead < 2% and captures the live burn-rate /
+            # admission-headroom columns for the trend.
+            result = _run_fleetobs(np, platform)
         elif MODE == "herdtrace":
             # Same-session tracing A/B: the herdfast workload once with
             # tracing disabled and once with the in-memory recorder +
@@ -1786,18 +1795,23 @@ def _ledger_diff(before: dict, after: dict) -> dict:
     }
 
 
-def _scrape_stage_raw(http_addrs: list) -> tuple:
-    """Cumulative gubernator_stage_duration (count, sum) aggregated
-    across the nodes' /metrics."""
+def _scrape_stage_raw(http_addrs: list) -> dict:
+    """Cumulative per-stage histograms (gubernator_stage_seconds
+    bucket/count/sum) summed across the nodes' /metrics.  Summing
+    per-node cumulative bucket counts IS the cross-node histogram
+    merge (obs/fleet.py's semantics), so a diff of two scrapes yields
+    REAL merged quantiles for the measured window — this used to fold
+    gubernator_stage_duration count/sum into per-node means, the
+    means-of-means lie the fleet rollup exists to retire."""
     import re
     import urllib.request
 
-    counts: dict = {}
-    sums: dict = {}
+    stages: dict = {}
     pat = re.compile(
-        r'gubernator_stage_duration_(count|sum)\{stage="([a-z_]+)"\}\s+'
-        r"([0-9.e+-]+)"
+        r"gubernator_stage_seconds_(bucket|count|sum)\{([^}]*)\}\s+"
+        r"([0-9.eE+-]+)"
     )
+    lab = re.compile(r'(\w+)="([^"]*)"')
     for addr in http_addrs:
         try:
             with urllib.request.urlopen(
@@ -1806,26 +1820,64 @@ def _scrape_stage_raw(http_addrs: list) -> tuple:
                 text = r.read().decode()
         except OSError:
             continue
-        for kind, stage, val in pat.findall(text):
-            d = counts if kind == "count" else sums
-            d[stage] = d.get(stage, 0.0) + float(val)
-    return counts, sums
+        for kind, labels, val in pat.findall(text):
+            d = dict(lab.findall(labels))
+            ent = stages.setdefault(
+                d.get("stage", ""),
+                {"count": 0.0, "sum": 0.0, "buckets": {}},
+            )
+            if kind == "bucket":
+                le = d.get("le", "")
+                ent["buckets"][le] = (
+                    ent["buckets"].get(le, 0.0) + float(val)
+                )
+            else:
+                ent[kind] += float(val)
+    return stages
 
 
-def _stage_budget_diff(before: tuple, after: tuple) -> dict:
-    """Per-stage means over the MEASURED window only (the counters are
-    cumulative from daemon start, and the warmup round's cold-compile
-    windows must not bias the published budget)."""
-    c0, s0 = before
-    c1, s1 = after
+def _stage_budget_diff(before: dict, after: dict) -> dict:
+    """Per-stage budget over the MEASURED window only (the histograms
+    are cumulative from daemon start, and the warmup round's
+    cold-compile windows must not bias the published budget): the
+    bucket diffs rebuild a DurationStat per stage, so the published
+    p50/p99 are real cross-node merged quantiles, with the window
+    mean alongside."""
+    from gubernator_tpu.utils.metrics import DurationStat
+
+    # The exporter formats each bucket's upper bound with the same
+    # "%.9g" as this table, so le strings map back to bucket indexes
+    # exactly ("+Inf" duplicates the top bucket's cumulative count
+    # and is dropped here).
+    le_to_idx = {
+        f"{DurationStat.bucket_bounds(i)[1]:.9g}": i
+        for i in range(DurationStat.N_BUCKETS)
+    }
     out = {}
-    for stage, n1 in c1.items():
-        dn = n1 - c0.get(stage, 0.0)
-        ds = s1.get(stage, 0.0) - s0.get(stage, 0.0)
-        out[stage] = {
+    for stage, a in after.items():
+        b = before.get(stage) or {"count": 0.0, "sum": 0.0, "buckets": {}}
+        dn = a["count"] - b.get("count", 0.0)
+        ds = a["sum"] - b.get("sum", 0.0)
+        stat = DurationStat()
+        prev = 0.0
+        for le in sorted(
+            (k for k in a["buckets"] if k in le_to_idx),
+            key=lambda k: le_to_idx[k],
+        ):
+            cum = a["buckets"][le] - (b.get("buckets") or {}).get(le, 0.0)
+            c = cum - prev
+            prev = cum
+            if c > 0:
+                stat.buckets[le_to_idx[le]] += int(round(c))
+        stat.count = sum(stat.buckets)
+        row = {
             "count": int(dn),
             "mean_ms": round(ds / dn * 1e3, 3) if dn else 0.0,
         }
+        if stat.count:
+            row["p50_ms"] = round(stat.quantile(0.5) * 1e3, 3)
+            row["p99_ms"] = round(stat.quantile(0.99) * 1e3, 3)
+        out[stage] = row
     return out
 
 
@@ -1952,6 +2004,10 @@ def _run_global_procs(np, platform: str, n_nodes: int, wire_batch: int) -> dict:
             "platform": platform,
             "topology": "process-per-node",
             "stage_budget_ms": budget,
+            # Rows carry merged p50/p99 (histogram diff across the
+            # nodes' gubernator_stage_seconds), not per-node means —
+            # bench_trend marks artifacts that predate this.
+            "stage_budget_source": "histogram-merge",
             "ledger": ledger,
         }
     finally:
@@ -2504,6 +2560,245 @@ def _run_crossregion(np, platform: str) -> dict:
                 "window_wait": hop.window_wait.snapshot_ms(),
                 "region_rpc": hop.region_rpc.snapshot_ms(),
                 "states": states,
+            },
+            "platform": platform,
+        }
+    finally:
+        h.stop()
+
+
+def _run_fleetobs(np, platform: str) -> dict:
+    """Fleet observability A/B (ISSUE 15 acceptance): the rollup +
+    SLO watchdog's serving cost, pinned < 2% like herdtrace.
+
+    A 2×2 region×peer in-process cluster serves a closed-loop herd of
+    single-item RPCs split across all four nodes.  Every node runs
+    the obs plane at a bench-visible tick (GUBER_SLO_INTERVAL, default
+    0.5s here vs 5s in production) and node 0 is the designated
+    rollup node (fleet scope): each of its ticks is a real 4-node
+    ObsSnapshot fan-out + histogram merge + SLI evaluation.  Arms
+    alternate per pair with the herdtrace median-of-pair-deltas
+    treatment; the OFF arm pauses every watchdog (no ticks, no
+    fan-outs — the GUBER_OBS=0 steady state; what remains is the
+    serve paths' one-attribute admission-watch peek, which both arms
+    pay).  A finite-limit canary key (~5% of traffic, watched on
+    every node) makes the admission-bound gauge live: the artifact
+    carries its cluster-summed admitted count, the derived
+    N_regions × limit bound, and the headroom — which must never be
+    negative on this healthy cluster.  The canary is MULTI_REGION —
+    the crossregion drift canary's shape — because that is the route
+    the admission watch covers by design (the dataclass serve path;
+    the raw-wire columnar route would under-count, the documented
+    safe direction — OBSERVABILITY.md §10)."""
+    import grpc
+
+    from gubernator_tpu.cluster.harness import ClusterHarness
+    from gubernator_tpu.net.grpc_service import V1_SERVICE
+    from gubernator_tpu.net.pb import gubernator_pb2 as pb
+    from gubernator_tpu.types import Behavior
+
+    pairs = max(1, int(os.environ.get("BENCH_FLEETOBS_PAIRS", "3")))
+    n_threads = int(os.environ.get("BENCH_FLEETOBS_THREADS", 8))
+    seconds = float(
+        os.environ.get("BENCH_FLEETOBS_SECONDS", min(MEASURE_SECONDS, 4.0))
+    )
+    canary_limit = int(os.environ.get("BENCH_FLEETOBS_CANARY_LIMIT", 50))
+    regions = ["", "dc-west"]
+    datacenters = [r for r in regions for _ in range(2)]
+    # The daemons read the obs knobs at start; restore after.
+    obs_env = {
+        "GUBER_OBS": "1",
+        "GUBER_SLO_INTERVAL": os.environ.get(
+            "BENCH_FLEETOBS_INTERVAL", "0.5s"
+        ),
+        "GUBER_SLO_FAST_WINDOWS": "1,3",
+        "GUBER_SLO_SLOW_WINDOWS": "5,10",
+    }
+    saved = {k: os.environ.get(k) for k in obs_env}
+    os.environ.update(obs_env)
+    try:
+        h = ClusterHarness().start(
+            len(datacenters), datacenters=datacenters,
+            cache_size=CAPACITY,
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    try:
+        lead = h.daemons[0]
+        lead.slo.fleet_scope = True  # the designated rollup node
+        canary_key = "fo_9canary"
+        for d in h.daemons:
+            d.instance.admission_watch.watch(canary_key, limit=canary_limit)
+
+        def payload(key, limit, behavior=0):
+            return pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="fo", unique_key=key, hits=1,
+                        limit=limit, duration=3_600_000,
+                        behavior=behavior,
+                    )
+                ]
+            ).SerializeToString()
+
+        # Keys vary a LEADING byte (FNV-1 trailing-byte collapse; see
+        # hash_ring.py) so every owner in every region gets a share.
+        payloads = [payload(f"{i}_fo", 10**9) for i in range(256)]
+        canary_payload = payload(
+            "9canary", canary_limit, behavior=int(Behavior.MULTI_REGION)
+        )
+        addrs = [
+            h.daemons[t % len(h.daemons)].grpc_address
+            for t in range(n_threads)
+        ]
+
+        def drive(sec: float) -> dict:
+            stop = threading.Event()
+            barrier = threading.Barrier(n_threads + 1)
+            counts = [0] * n_threads
+            errors = [0] * n_threads
+            lats: list = [None] * n_threads
+
+            def worker(tid: int) -> None:
+                rng = np.random.default_rng(300 + tid)
+                mylat = []
+                ch = grpc.insecure_channel(addrs[tid])
+                call = ch.unary_unary(
+                    f"/{V1_SERVICE}/GetRateLimits",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                try:
+                    call(payloads[tid % len(payloads)])
+                finally:
+                    barrier.wait()
+                i = tid
+                while not stop.is_set():
+                    body = (
+                        canary_payload
+                        if rng.random() < 0.05
+                        else payloads[i % len(payloads)]
+                    )
+                    t0 = time.perf_counter()
+                    try:
+                        call(body)
+                    except grpc.RpcError:
+                        errors[tid] += 1
+                    mylat.append(time.perf_counter() - t0)
+                    counts[tid] += 1
+                    i += n_threads
+                lats[tid] = mylat
+                ch.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(t,), daemon=True)
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            start = time.perf_counter()
+            time.sleep(sec)
+            stop.set()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - start
+            all_lat = np.asarray([x for ml in lats if ml for x in ml])
+            pct = lambda q: (  # noqa: E731
+                round(float(np.percentile(all_lat, q)) * 1e3, 3)
+                if all_lat.size else None
+            )
+            return {
+                "value": round(sum(counts) / elapsed, 1),
+                "p50_ms": pct(50),
+                "p99_ms": pct(99),
+                "errors": int(sum(errors)),
+            }
+
+        off_runs, on_runs = [], []
+        off_lats = {"p50_ms": [], "p99_ms": []}
+        on_lats = {"p50_ms": [], "p99_ms": []}
+        errors = 0
+        for _ in range(pairs):
+            for d in h.daemons:
+                d.slo.pause()
+            off = drive(seconds)
+            for d in h.daemons:
+                d.slo.resume()
+            on = drive(seconds)
+            off_runs.append(off["value"])
+            on_runs.append(on["value"])
+            errors += off["errors"] + on["errors"]
+            for k in off_lats:
+                if off.get(k) is not None:
+                    off_lats[k].append(off[k])
+                if on.get(k) is not None:
+                    on_lats[k].append(on[k])
+        # Let the designated node tick at least once more with the
+        # full traffic counted, then read the live surfaces.
+        time.sleep(1.0)
+        fleet = lead.fleet_stats()
+        slo_view = lead.slo.evaluate(fleet, record=False)
+        status = lead.slo_status()
+        adm = (fleet.get("admitted") or {}).get(canary_key) or {}
+        hr = (slo_view.get("headroom") or {}).get(canary_key) or {}
+        burns = slo_view.get("slis") or {}
+        off_v = float(np.median(off_runs))
+        on_v = float(np.median(on_runs))
+        pair_deltas = [
+            round((b - a) / a * 100, 2)
+            for a, b in zip(off_runs, on_runs)
+            if a
+        ]
+        delta_pct = (
+            round(float(np.median(pair_deltas)), 2)
+            if pair_deltas else None
+        )
+
+        def _med(draws):
+            return round(float(np.median(draws)), 3) if draws else None
+        return {
+            "metric": "rate-limit decisions/sec, fleet observability "
+            f"A/B across a 2x2 region x peer cluster ({n_threads} "
+            f"client threads, median of {pairs} alternating pairs: "
+            "watchdog paused vs rollup node fan-out ticking every "
+            f"{obs_env['GUBER_SLO_INTERVAL']}; value = obs-on arm)",
+            "value": round(on_v, 1),
+            "unit": "decisions/sec",
+            "vs_baseline": round(on_v / BASELINE_DECISIONS_PER_SEC, 2),
+            "fleetobs_off_value": round(off_v, 1),
+            "fleetobs_delta_pct": delta_pct,
+            "pair_deltas_pct": pair_deltas,
+            "off_runs": off_runs,
+            "on_runs": on_runs,
+            "p50_ms": _med(on_lats["p50_ms"]),
+            "p99_ms": _med(on_lats["p99_ms"]),
+            "p50_ms_off": _med(off_lats["p50_ms"]),
+            "p99_ms_off": _med(off_lats["p99_ms"]),
+            "errors": errors,
+            "fleet": {
+                "nodes": len(fleet.get("nodes") or ()),
+                "regions": sorted((fleet.get("regions") or {}).keys()),
+                "scrape_ok": (fleet.get("scrape") or {}).get("ok"),
+                "scrape_failed": (fleet.get("scrape") or {}).get("failed"),
+            },
+            "slo": {
+                "samples": status.get("samples"),
+                "max_burn": (
+                    round(max(burns.values()), 4) if burns else None
+                ),
+                "breaches": len(status.get("breaches") or ()),
+            },
+            "canary": {
+                "limit": canary_limit,
+                "admitted": int(adm.get("admitted", 0)),
+                "bound": hr.get("bound"),
+                "headroom": hr.get("headroom"),
+                "within_bound": (hr.get("headroom") or 0) >= 0,
             },
             "platform": platform,
         }

@@ -140,6 +140,7 @@ class PeerClient:
         self._raw_update_globals = None
         self._raw_transfer = None
         self._raw_replicate = None
+        self._raw_obs = None
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
         self._queue_cv = threading.Condition(self._lock)
@@ -183,6 +184,11 @@ class PeerClient:
                 )
                 self._raw_replicate = self._channel.unary_unary(
                     f"/{PEERS_SERVICE}/ReplicateKeys",
+                    request_serializer=lambda raw: raw,
+                    response_deserializer=lambda raw: raw,
+                )
+                self._raw_obs = self._channel.unary_unary(
+                    f"/{PEERS_SERVICE}/ObsSnapshot",
                     request_serializer=lambda raw: raw,
                     response_deserializer=lambda raw: raw,
                 )
@@ -481,6 +487,39 @@ class PeerClient:
             return resp
         except grpc.RpcError as e:
             err = f"ReplicateKeys to {self.info.grpc_address}: {e.code().name}: {e.details()}"
+            self._set_last_err(err)
+            self._observe_rpc_error(e)
+            raise PeerError(
+                err, not_ready=e.code() == grpc.StatusCode.UNAVAILABLE
+            ) from e
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
+    def obs_snapshot_raw(
+        self, timeout: Optional[float] = None
+    ) -> bytes:
+        """Pull this peer's observability snapshot (counters, gauges,
+        raw stage histograms) for the fleet rollup merge
+        (obs/fleet.py).  Scrape-rate traffic, never the decision hot
+        path; the empty request body is the protocol."""
+        self._gate()
+        self._connect()
+        with self._lock:
+            if self._closing:
+                raise PeerError("already disconnecting", not_ready=True)
+            raw = self._raw_obs
+            self._inflight += 1
+        try:
+            resp = raw(
+                b"", timeout=timeout or self.behaviors.global_timeout,
+                metadata=tracing.grpc_metadata(),
+            )
+            self.health.record_success()
+            return resp
+        except grpc.RpcError as e:
+            err = f"ObsSnapshot to {self.info.grpc_address}: {e.code().name}: {e.details()}"
             self._set_last_err(err)
             self._observe_rpc_error(e)
             raise PeerError(

@@ -217,6 +217,16 @@ KNOWN_ENV_KNOBS = (
     "GUBER_NATIVE_EVENTS",       # net/h2_fast.py: C event ring on/off
     "GUBER_NATIVE_EVENTS_CAP",   # net/h2_fast.py: ring record capacity
     "GUBER_NATIVE_EVENTS_INTERVAL",  # utils/native_events.py: drain period
+    # Fleet observability plane (obs/; OBSERVABILITY.md §§9-10).
+    "GUBER_OBS",                 # daemon.py: fleet rollup + watchdog on/off
+    "GUBER_OBS_RPC_TIMEOUT",     # obs/fleet.py: per-peer ObsSnapshot timeout
+    "GUBER_OBS_FANOUT_DEADLINE",  # obs/fleet.py: rollup fan-out barrier
+    "GUBER_SLO_INTERVAL",        # obs/slo.py: watchdog tick period (0=off)
+    "GUBER_SLO_FLEET",           # obs/slo.py: ticks scrape the whole fleet
+    "GUBER_SLO_FAST_WINDOWS",    # obs/slo.py: fast burn pair "short,long" s
+    "GUBER_SLO_SLOW_WINDOWS",    # obs/slo.py: slow burn pair "short,long" s
+    "GUBER_SLO_WATCH_KEYS",      # obs/slo.py: admission-bound watched keys
+    "GUBER_METRICS_EXEMPLARS",   # utils/metrics.py: bucket trace exemplars
     # Event front (net/h2_fast.py; h2_server.cpp reactors, PERF §26).
     "GUBER_H2_EVENT_FRONT",      # net/h2_fast.py: epoll reactor front on/off
     "GUBER_H2_REACTORS",         # net/h2_fast.py: reactor threads (0=ncpu-1)

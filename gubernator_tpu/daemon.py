@@ -65,6 +65,8 @@ class Daemon:
         self._discovery = None
         self.membership = None
         self.replication = None
+        self.obs = None
+        self.slo = None
         self._closed = False
 
     # ------------------------------------------------------------------
@@ -343,6 +345,37 @@ class Daemon:
                     self.h2_fast
                 )
 
+        # Fleet observability plane (obs/; OBSERVABILITY.md §§9-10):
+        # the cluster rollup collector behind /debug/fleet +
+        # /metrics?fleet=1 + PeersV1/ObsSnapshot, and the SLO/
+        # invariant burn-rate watchdog behind /debug/slo and the
+        # gubernator_slo_* gauges.  GUBER_OBS=0 removes the whole
+        # plane (the fleetobs bench's A/B arm).
+        self.obs = None
+        self.slo = None
+        if os.environ.get("GUBER_OBS", "1").strip().lower() not in (
+            "0", "false", "no", "off",
+        ):
+            from gubernator_tpu.obs.fleet import FleetCollector
+            from gubernator_tpu.obs.slo import (
+                SLOWatchdog,
+                watch_keys_from_env,
+            )
+
+            self.obs = FleetCollector.from_env(
+                self.instance,
+                addr=resolve_advertise_address(
+                    self.grpc_address, conf.advertise_address
+                ),
+                region=conf.data_center,
+            )
+            self.instance.obs = self.obs
+            watch_keys_from_env(self.instance.admission_watch)
+            self.slo = SLOWatchdog.from_env(
+                self.obs, self.instance.admission_watch
+            )
+            self.instance.slo_watchdog = self.slo
+
         # Optional plain-HTTP status listener for probes when mTLS
         # would block them (reference: daemon.go:279-307).
         if conf.http_status_listen_address:
@@ -546,6 +579,22 @@ class Daemon:
             return {}
         return self.instance.multi_region_mgr.stats()
 
+    def fleet_stats(self, peers: bool = True) -> dict:
+        """One cluster rollup from this node's vantage (obs/fleet.py)
+        — the same merged view /debug/fleet and /metrics?fleet=1
+        serve (bench artifacts embed it, like peer_health())."""
+        if self.obs is None:
+            return {}
+        return self.obs.collect(peers=peers)
+
+    def slo_status(self) -> dict:
+        """The SLO watchdog's live view: declared SLIs, current burn
+        rates, invariant headroom, and the bounded breach log — the
+        same shape /debug/slo serves."""
+        if self.slo is None:
+            return {}
+        return self.slo.status()
+
     def drain(self, deadline: Optional[float] = None) -> dict:
         """Planned leave: ship EVERY held bucket to its owner under
         the ring-without-self (cluster/membership.py), bounded by
@@ -595,6 +644,12 @@ class Daemon:
             # peers are still up) and drop replica leases BEFORE the
             # native front frees the decision plane below.
             self.replication.close()
+        if getattr(self, "slo", None) is not None:
+            # Watchdog before the obs collector: a tick mid-teardown
+            # must not fan out through a closed scrape pool.
+            self.slo.close()
+        if getattr(self, "obs", None) is not None:
+            self.obs.close()
         if self.instance is not None and self.instance.native_events is not None:
             # Stop the drain thread BEFORE the front frees the ring
             # (single-consumer contract; a drain into a freed ring is

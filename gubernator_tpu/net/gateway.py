@@ -55,7 +55,7 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def do_GET(self):  # noqa: N802 (stdlib naming)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/v1/HealthCheck" or path == "/healthz":
             resp = serde.health_check_resp_to_pb(self.instance.health_check())
             self._reply(
@@ -67,19 +67,98 @@ class _Handler(BaseHTTPRequestHandler):
                 ).encode(),
             )
         elif path == "/metrics" and self.registry is not None:
-            self._reply(
-                200,
-                generate_latest(self.registry),
-                content_type="text/plain; version=0.0.4; charset=utf-8",
-            )
+            self._serve_metrics(query)
         elif path == "/debug/trace":
             self._reply(200, json.dumps(self._debug_trace()).encode())
         elif path == "/debug/hotkeys":
             self._reply(200, json.dumps(self._debug_hotkeys()).encode())
         elif path == "/debug/vars":
             self._reply(200, json.dumps(self._debug_vars()).encode())
+        elif path == "/debug/fleet":
+            self._reply(200, json.dumps(self._debug_fleet()).encode())
+        elif path == "/debug/slo":
+            self._reply(200, json.dumps(self._debug_slo()).encode())
         else:
             self._reply_error(404, 5, "not found")
+
+    def _serve_metrics(self, query: str) -> None:
+        """The /metrics scrape, with two opt-in extensions:
+
+        - ``?fleet=1`` appends the gubernator_fleet_* rollup families
+          (one ObsSnapshot fan-out, merged — any node answers for the
+          cluster);
+        - ``?exemplars=1`` switches to the OpenMetrics exposition so
+          the stage-histogram buckets carry their trace_id exemplars
+          (the classic format has no exemplar syntax and drops them).
+        """
+        from urllib.parse import parse_qs
+
+        qs = parse_qs(query)
+
+        def _flag(name: str) -> bool:
+            return (qs.get(name, ["0"])[0] or "0") not in ("0", "false")
+
+        want_exemplars = _flag("exemplars")
+        if want_exemplars:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_generate_latest,
+            )
+
+            gen = om_generate_latest
+            ctype = (
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8"
+            )
+        else:
+            gen = generate_latest
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        body = gen(self.registry)
+        if _flag("fleet"):
+            obs = getattr(self.instance, "obs", None)
+            if obs is not None:
+                from gubernator_tpu.utils.metrics import (
+                    build_fleet_registry,
+                )
+
+                extra = gen(build_fleet_registry(obs.collect()))
+                if want_exemplars and body.endswith(b"# EOF\n"):
+                    # OpenMetrics ends every exposition with "# EOF";
+                    # splicing two outputs keeps exactly one.
+                    body = body[: -len(b"# EOF\n")]
+                body += extra
+        self._reply(200, body, content_type=ctype)
+
+    # -- /debug fleet/SLO surface (obs/; OBSERVABILITY.md §§9-10) ------
+
+    def _debug_fleet(self) -> dict:
+        """One cluster rollup from this node's vantage: the merged
+        counters/gauges/quantiles plus the SLO evaluation OVER that
+        rollup (read-only — the on-demand view must not pollute the
+        watchdog's periodic sample cadence)."""
+        obs = getattr(self.instance, "obs", None)
+        if obs is None:
+            return {"enabled": False}
+        rollup = obs.collect()
+        out = {"enabled": True}
+        out.update(rollup)
+        wd = getattr(self.instance, "slo_watchdog", None)
+        if wd is not None:
+            # Windowed (ratio/drops) burns only when the watchdog's
+            # recorded history shares this rollup's FLEET scope — a
+            # local-slice history differenced against a fleet rollup
+            # would report other nodes' lifetime totals as window
+            # traffic (phantom breaches).  Quantile + invariant SLIs
+            # always evaluate (no history needed).
+            out["slo"] = wd.evaluate(
+                rollup, record=False, windowed=wd.fleet_scope
+            )
+        return out
+
+    def _debug_slo(self) -> dict:
+        wd = getattr(self.instance, "slo_watchdog", None)
+        if wd is None:
+            return {"enabled": False}
+        return wd.status()
 
     # -- /debug introspection surface (OBSERVABILITY.md) ---------------
 
@@ -145,6 +224,20 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001 — snapshot best-effort
                 out["membership"] = None
         out["handoff"] = dict(inst.handoff_counters)
+        # PR 13/14 planes (hot-key replication, multi-region
+        # federation): the same numbers /metrics exports as
+        # gubernator_replication_* / gubernator_multiregion_*, in the
+        # one-stop snapshot the other planes already had.
+        repl = getattr(inst, "replication", None)
+        if repl is not None:
+            try:
+                out["replication"] = repl.stats()
+            except Exception:  # noqa: BLE001 — snapshot best-effort
+                out["replication"] = None
+        try:
+            out["multiregion"] = inst.multi_region_mgr.stats()
+        except Exception:  # noqa: BLE001 — snapshot best-effort
+            out["multiregion"] = None
         out["global"] = {
             "hits_pending": inst.global_mgr._hits.pending(),
             "broadcasts_pending": inst.global_mgr._updates.pending(),

@@ -48,6 +48,8 @@ class PeersV1Servicer(Protocol):
 
     def ReplicateKeys(self, request, context) -> bytes: ...
 
+    def ObsSnapshot(self, request, context) -> bytes: ...
+
 
 def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
     return grpc.unary_unary_rpc_method_handler(
@@ -112,6 +114,13 @@ def add_peers_v1_to_server(servicer: PeersV1Servicer, server: grpc.Server) -> No
                     # idiom as the handoff plane.
                     "ReplicateKeys": _unary_raw(
                         servicer.ReplicateKeys
+                    ),
+                    # Fleet rollup scrape (obs/fleet.py): one node's
+                    # metric families — counters, gauges, raw
+                    # 36-bucket histograms — as raw JSON for the
+                    # cluster rollup merge.  Scrape-rate traffic.
+                    "ObsSnapshot": _unary_raw(
+                        servicer.ObsSnapshot
                     ),
                 },
             ),

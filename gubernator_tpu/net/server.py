@@ -250,3 +250,11 @@ class GrpcPeersV1Adapter:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"malformed replication message: {e}",
             )
+
+    def ObsSnapshot(self, request, context):
+        with _handler_span("rpc.obs_snapshot", context):
+            # Fleet rollup scrape (obs/fleet.py): this node's metric
+            # families as raw JSON.  The request body is empty by
+            # contract; a node without the obs plane answers its
+            # disabled shape so the collector can count it.
+            return self.instance.obs_snapshot_raw()

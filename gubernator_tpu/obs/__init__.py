@@ -1,0 +1,16 @@
+"""Fleet observability plane (OBSERVABILITY.md §§9-11).
+
+- ``obs.fleet.FleetCollector`` — the cluster rollup: every peer's
+  metric families pulled over the raw-JSON ``PeersV1/ObsSnapshot``
+  RPC (health-gated, per-RPC timeouts under a total fan-out deadline)
+  and merged so counters SUM, gauges label-join by peer/region, and
+  ``DurationStat`` histograms merge bucket-for-bucket — cluster
+  p50/p99 are real quantiles, not means-of-means.
+- ``obs.slo`` — declared SLIs evaluated as multi-window multi-burn-
+  rate alerts over the rollup, plus the admission-bound invariant
+  (RESILIENCE.md's N×limit proofs as a live gauge).
+
+Pure-Python and jax-free by design: the smoke harness and the
+guberlint drift ``slo`` sub-rule both load this package without a
+backend.
+"""

@@ -1,0 +1,320 @@
+"""FleetCollector — the cluster rollup scrape (OBSERVABILITY.md §9).
+
+An operator of the PR-13/14 cluster had N×/metrics + N×/debug
+endpoints and no rollup; the crossregion/flashcrowd benches hand-fold
+counters per node — exactly the fleet-level accounting gap "Designing
+Scalable Rate Limiting Systems" (PAPERS.md) calls out.  This module
+gives any node a one-scrape cluster view:
+
+* **Pull, not push**: `collect()` fans one raw-JSON
+  ``PeersV1/ObsSnapshot`` RPC out to every peer (local ring + every
+  region picker — the same topology surface the decision planes
+  route over).  The fan-out is health-gated (circuit-open peers are
+  SKIPPED counted, never probed — a rollup must not perturb the
+  breakers chaos tests assert on), every RPC carries an explicit
+  timeout, and the whole fan-out sits under one total barrier budget
+  — the multiregion push's shape (GUBER_OBS_RPC_TIMEOUT /
+  GUBER_OBS_FANOUT_DEADLINE).
+
+* **Merge semantics**: counters SUM (per region and fleet-wide,
+  regions from the nodes' DC tags); gauges label-join by peer/region
+  (a cache size does not sum); ``DurationStat`` histograms merge
+  bucket-for-bucket via ``merge_snapshot`` so the fleet p50/p99 are
+  REAL quantiles of the union of observations — never
+  means-of-means.
+
+Served as ``/debug/fleet`` and ``/metrics?fleet=1`` on any node
+(net/gateway.py), and consumed by the SLO watchdog (obs/slo.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutTimeout
+from typing import Dict, List, Tuple
+
+log = logging.getLogger("gubernator_tpu.obs.fleet")
+
+SNAPSHOT_VERSION = 1
+
+
+class FleetCollector:
+    """One node's rollup plane: local snapshot + peer fan-out merge."""
+
+    def __init__(
+        self,
+        instance,
+        *,
+        addr: str = "",
+        region: str = "",
+        rpc_timeout: float = 0.5,
+        fanout_deadline: float = 2.0,
+    ) -> None:
+        self.instance = instance
+        self.addr = addr
+        self.region = region
+        self.rpc_timeout = rpc_timeout
+        self.fanout_deadline = fanout_deadline
+        # Small persistent pool: rollups are scrape-rate, and a pool
+        # per collect() would leak thread churn into every scrape.
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="guber-obs-scrape"
+        )
+        self._closed = False
+
+    @classmethod
+    def from_env(
+        cls, instance, *, addr: str = "", region: str = ""
+    ) -> "FleetCollector":
+        from gubernator_tpu.config import _env_float_seconds
+
+        return cls(
+            instance,
+            addr=addr,
+            region=region,
+            rpc_timeout=_env_float_seconds(
+                {}, "GUBER_OBS_RPC_TIMEOUT", 0.5
+            ),
+            fanout_deadline=_env_float_seconds(
+                {}, "GUBER_OBS_FANOUT_DEADLINE", 2.0
+            ),
+        )
+
+    # -- the local snapshot (what ObsSnapshot serves) -------------------
+
+    def local_snapshot(self) -> dict:
+        """This node's metric families in wire shape: summable
+        counters, per-node gauges, and raw 36-bucket histograms."""
+        inst = self.instance
+        eng = inst.engine
+        counters: Dict[str, float] = {
+            "checks": getattr(eng, "requests_total", 0),
+            "over_limit": getattr(eng, "over_limit_total", 0),
+        }
+        for k in (
+            "check_errors", "local", "forward", "global", "sketch",
+            "replicated_local", "global_miss_local",
+            "degraded_answers", "degraded_region_answers",
+            "backoff_retries", "async_retries",
+        ):
+            counters[k] = inst.counters.get(k, 0)
+        gm = getattr(inst, "global_mgr", None)
+        if gm is not None:
+            counters["global_async_sends"] = gm.async_sends
+            counters["global_broadcasts"] = gm.broadcasts
+            counters["global_hits_requeued"] = gm.hits_requeued
+            counters["global_hits_requeue_dropped"] = (
+                gm.hits_requeue_dropped
+            )
+        mr = getattr(inst, "multi_region_mgr", None)
+        if mr is not None:
+            mrs = mr.stats()
+            counters["multiregion_windows"] = mrs["windows"]
+            counters["multiregion_region_sends"] = mrs["region_sends"]
+            counters["multiregion_hits_requeued"] = mrs["hits_requeued"]
+            counters["multiregion_hits_dropped"] = mrs["hits_dropped"]
+        hoff = getattr(inst, "handoff_counters", None)
+        if hoff is not None:
+            for k in ("shipped", "forfeited", "received"):
+                counters[f"handoff_{k}"] = hoff[k]
+        led = getattr(inst, "ledger", None)
+        if led is not None:
+            counters["ledger_answered"] = led.answered
+            counters["ledger_native_answered"] = led.native_answered()
+        ev = getattr(inst, "native_events", None)
+        if ev is not None:
+            rs = ev.ring_stats()
+            counters["native_ring_dropped"] = rs.get("dropped", 0)
+            counters["native_events"] = sum(
+                ev.event_counts().values()
+            )
+
+        gauges: Dict[str, float] = {
+            "cache_size": eng.cache_size()
+            if hasattr(eng, "cache_size") else 0,
+        }
+        if gm is not None:
+            gauges["global_hits_pending"] = gm._hits.pending()
+            gauges["global_broadcasts_pending"] = gm._updates.pending()
+        mem = getattr(inst, "membership", None)
+        if mem is not None:
+            gauges["membership_epoch"] = mem.epoch()
+        front = getattr(inst, "h2_front", None)
+        if front is not None:
+            try:
+                gauges["h2_conns_open"] = front.conn_stats()[
+                    "conns_open"
+                ]
+            except Exception:  # noqa: BLE001 — front mid-teardown
+                pass
+        repl = getattr(inst, "replication", None)
+        if repl is not None:
+            rs = repl.stats()
+            gauges["replication_promoted"] = rs["promoted_keys"]
+            gauges["replication_replica_leases"] = rs["replica_leases"]
+
+        hists = {
+            stage: stat.bucket_snapshot()
+            for stage, stat in inst.stage_timers.items()
+        }
+        if ev is not None:
+            for stage, stat in ev.histograms().items():
+                hists[stage] = stat.bucket_snapshot()
+
+        aw = getattr(inst, "admission_watch", None)
+        admitted = aw.snapshot() if aw is not None else {}
+        return {
+            "v": SNAPSHOT_VERSION,
+            "addr": self.addr,
+            "region": self.region,
+            "counters": counters,
+            "gauges": gauges,
+            "hists": hists,
+            "admitted": admitted,
+        }
+
+    def local_snapshot_raw(self) -> bytes:
+        return json.dumps(self.local_snapshot()).encode()
+
+    # -- the fan-out ---------------------------------------------------
+
+    def _peers(self) -> List:
+        """Every dialable peer: the local ring plus every region
+        picker's members (self excluded — the local snapshot is taken
+        in-process)."""
+        inst = self.instance
+        peers = [
+            p for p in inst.get_peer_list() if not p.info.is_owner
+        ]
+        for _dc, ring in inst.get_region_pickers().items():
+            peers.extend(ring.peers())
+        return peers
+
+    @staticmethod
+    def _scrape_peer(peer, timeout: float) -> dict:
+        raw = peer.obs_snapshot_raw(timeout=timeout)
+        snap = json.loads(bytes(raw) or b"{}")
+        if not isinstance(snap, dict):
+            raise ValueError("malformed obs snapshot")
+        snap.setdefault("addr", peer.info.grpc_address)
+        snap.setdefault("region", peer.info.datacenter)
+        return snap
+
+    def collect(self, peers: bool = True) -> dict:
+        """One rollup: local snapshot (+ the peer fan-out unless
+        `peers` is False) merged into the fleet view."""
+        from gubernator_tpu.utils.metrics import record_swallowed
+        from gubernator_tpu.utils.tracing import span
+
+        t0 = time.monotonic()
+        snaps = [self.local_snapshot()]
+        ok, failed, skipped = 1, 0, 0
+        if peers and not self._closed:
+            targets = self._peers()
+            with span("obs.fleet_scrape", peers=len(targets)):
+                futs = []
+                for p in targets:
+                    # Peek-only gate: a broken peer is skipped without
+                    # consuming a half-open probe slot — the rollup
+                    # must observe the health plane, not drive it.
+                    if not p.health.would_allow():
+                        skipped += 1
+                        continue
+                    futs.append(
+                        self._pool.submit(
+                            self._scrape_peer, p, self.rpc_timeout
+                        )
+                    )
+                deadline = t0 + max(0.05, self.fanout_deadline)
+                for f in futs:
+                    try:
+                        snaps.append(
+                            f.result(
+                                timeout=max(
+                                    0.0, deadline - time.monotonic()
+                                )
+                            )
+                        )
+                        ok += 1
+                    except FutTimeout:
+                        # A not-yet-started scrape is cancelled so it
+                        # does not burn a pool slot (and a peer RPC)
+                        # after the barrier already gave up on it.
+                        f.cancel()
+                        failed += 1
+                        record_swallowed("obs.fanout_deadline")
+                    except Exception:  # noqa: BLE001 — one peer must
+                        # not sink the rollup; the count is the signal.
+                        failed += 1
+                        record_swallowed("obs.scrape")
+        rollup = self.merge(snaps)
+        rollup["scrape"] = {
+            "ok": ok,
+            "failed": failed,
+            "skipped": skipped,
+            "elapsed_ms": round((time.monotonic() - t0) * 1e3, 3),
+        }
+        return rollup
+
+    # -- the merge -----------------------------------------------------
+
+    @staticmethod
+    def merge(snaps: List[dict]) -> dict:
+        """Merge node snapshots: counters sum (per region + total),
+        gauges label-join, histograms merge exactly."""
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        nodes = []
+        counters: Dict[str, float] = {}
+        regions: Dict[str, dict] = {}
+        gauges: Dict[str, Dict[str, Tuple[str, float]]] = {}
+        hists: Dict[str, DurationStat] = {}
+        admitted: Dict[str, dict] = {}
+        for snap in snaps:
+            addr = snap.get("addr", "")
+            region = snap.get("region", "")
+            nodes.append({"addr": addr, "region": region})
+            sub = regions.setdefault(
+                region, {"nodes": 0, "counters": {}}
+            )
+            sub["nodes"] += 1
+            for name, v in (snap.get("counters") or {}).items():
+                counters[name] = counters.get(name, 0) + v
+                sub["counters"][name] = (
+                    sub["counters"].get(name, 0) + v
+                )
+            for name, v in (snap.get("gauges") or {}).items():
+                gauges.setdefault(name, {})[addr] = (region, v)
+            for stage, hsnap in (snap.get("hists") or {}).items():
+                hists.setdefault(stage, DurationStat()).merge_snapshot(
+                    hsnap
+                )
+            for key, ent in (snap.get("admitted") or {}).items():
+                agg = admitted.setdefault(
+                    key, {"admitted": 0, "limit": 0, "nodes": 0}
+                )
+                agg["admitted"] += int(ent.get("admitted", 0))
+                agg["limit"] = max(
+                    agg["limit"], int(ent.get("limit", 0))
+                )
+                agg["nodes"] += 1
+        return {
+            "v": SNAPSHOT_VERSION,
+            "nodes": nodes,
+            "regions": regions,
+            "counters": counters,
+            "gauges": gauges,
+            "quantiles": {
+                stage: h.snapshot_ms() for stage, h in hists.items()
+            },
+            "admitted": admitted,
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True)

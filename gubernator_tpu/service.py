@@ -482,6 +482,16 @@ class V1Instance:
         # Native event collector (utils/native_events.py), attached by
         # the daemon when the h2 fast front runs with its event ring.
         self.native_events = None
+        # Fleet observability plane (obs/): the rollup collector and
+        # SLO watchdog, attached by the daemon (GUBER_OBS); None for
+        # bare library instances.  The admission watch is always
+        # present — it costs one attribute peek while no key is
+        # watched, and the serve-path hooks need a stable handle.
+        from gubernator_tpu.obs.slo import AdmissionWatch
+
+        self.obs = None
+        self.slo_watchdog = None
+        self.admission_watch = AdmissionWatch()
 
     def sketch(self):
         if self._sketch is None:
@@ -706,6 +716,19 @@ class V1Instance:
             for f in futures:
                 f.result()
 
+        aw = self.admission_watch
+        if aw.active:
+            # Admission-bound invariant feed (obs/slo.py): watched
+            # finite-limit keys count their CLIENT-VISIBLE admitted
+            # hits here, at the client-facing boundary — local,
+            # forwarded, degraded, GLOBAL-cached and replica-lease
+            # answers all land in `responses` by now.  Internal
+            # re-applies (multiregion delta pushes, GLOBAL hit
+            # windows, handoff restores) arrive via the peer routes
+            # and are deliberately NOT counted: they re-play hits a
+            # client was already answered for, and counting them
+            # would double-bill the N×limit bound.
+            aw.observe_batch(requests, responses)
         return responses  # type: ignore[return-value]
 
     def _degraded_answer(
@@ -1473,7 +1496,16 @@ class V1Instance:
             # pb-decoded columns carry no fnv1a hashes, so this path
             # cannot consult the ledger — keep it coherent instead.
             self.ledger.invalidate_keys(keys_bytes)
-        return apply_columnar(keys_bytes, algo, behavior, hits, limit, duration, burst)
+        out = apply_columnar(
+            keys_bytes, algo, behavior, hits, limit, duration, burst
+        )
+        aw = self.admission_watch
+        if out is not None and aw.active and check_ownership:
+            # Client-facing columnar answers only: the peer-side call
+            # (check_ownership=False) serves batches a remote
+            # client-facing node already counts from its responses.
+            aw.observe_columns(keys_str, hits, out)
+        return out
 
     def get_peer_batch(self, keys: Sequence[str]) -> List:
         """Owner clients for a key list — ONE lock + one vectorized
@@ -1550,6 +1582,17 @@ class V1Instance:
             # as a failed grant and returns the credit immediately.
             return b'{"disabled":true,"returns":[]}'
         return repl.receive(raw)
+
+    def obs_snapshot_raw(self) -> bytes:
+        """Fleet rollup scrape receiver (PeersV1/ObsSnapshot): this
+        node's metric families as raw JSON (obs/fleet.py documents
+        the schema and merge semantics).  A node without the obs
+        plane answers its disabled shape so the collector can count
+        it instead of erroring."""
+        obs = self.obs
+        if obs is None:
+            return b'{"v":1,"disabled":true}'
+        return obs.local_snapshot_raw()
 
     def health_check(self) -> HealthCheckResp:
         """Aggregate recent peer errors. reference: gubernator.go:562-619."""

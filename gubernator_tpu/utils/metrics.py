@@ -17,18 +17,42 @@ here — registering a metric without documenting it fails CI.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import TYPE_CHECKING, Iterable, Sequence
 
 from prometheus_client.core import (
     CounterMetricFamily,
     GaugeMetricFamily,
+    HistogramMetricFamily,
     SummaryMetricFamily,
 )
 from prometheus_client.registry import Collector, CollectorRegistry
+from prometheus_client.samples import Exemplar
 
 if TYPE_CHECKING:
     from gubernator_tpu.service import V1Instance
+
+_OFF_VALUES = ("0", "false", "no", "off")
+
+
+_exemplars_enabled = None
+
+
+def exemplars_enabled() -> bool:
+    """GUBER_METRICS_EXEMPLARS (default on): retain the last sampled
+    trace_id per histogram bucket and export it as an OpenMetrics
+    exemplar — the metrics→traces link.  Costs nothing while tracing
+    is disabled (the tracing.active() check short-circuits first).
+    Parsed once and cached: DurationStat.observe runs at wire-batch
+    rate and must not pay an environment read + string normalization
+    per observation (every other knob reads once at construction)."""
+    global _exemplars_enabled
+    if _exemplars_enabled is None:
+        _exemplars_enabled = os.environ.get(
+            "GUBER_METRICS_EXEMPLARS", "1"
+        ).strip().lower() not in _OFF_VALUES
+    return _exemplars_enabled
 
 
 # Swallowed-exception visibility (guberlint thread pass): background
@@ -63,18 +87,24 @@ class DurationStat:
     boundaries (ms-scale work), so a tiny lock is fine; the
     per-decision hot path never touches one."""
 
-    __slots__ = ("count", "total", "max", "buckets", "_lock")
+    __slots__ = ("count", "total", "max", "buckets", "exemplars", "_lock")
 
     N_BUCKETS = 36
     _BASE = 1e-6  # bucket 0 lower bound: 1µs
 
-    # guberlint: guard count, total, max, buckets by _lock
+    # guberlint: guard count, total, max, buckets, exemplars by _lock
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.max = 0.0
         self.buckets = [0] * self.N_BUCKETS
+        # bucket index -> (trace_id, seconds): the LAST sampled trace
+        # that landed in the bucket (bounded by N_BUCKETS entries by
+        # construction; populated only while tracing is live AND
+        # GUBER_METRICS_EXEMPLARS is on) — what turns a cluster p99
+        # bucket into a link to a flight-recorder trace.
+        self.exemplars: dict = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -94,12 +124,26 @@ class DurationStat:
 
     def observe(self, seconds: float) -> None:
         b = self.bucket_of(seconds)
+        ex = None
+        # Exemplar capture: observations happen at flush/window
+        # boundaries (see class docstring), so the context lookup is
+        # off the per-decision path; a disabled tracer short-circuits
+        # at one global check.
+        if exemplars_enabled():
+            from gubernator_tpu.utils import tracing
+
+            if tracing.active():
+                ctx = tracing.current_context()
+                if ctx is not None and ctx.sampled:
+                    ex = (ctx.trace_id, seconds)
         with self._lock:
             self.count += 1
             self.total += seconds
             if seconds > self.max:
                 self.max = seconds
             self.buckets[b] += 1
+            if ex is not None:
+                self.exemplars[b] = ex
 
     def observe_bucket_counts(self, counts) -> None:
         """Merge pre-bucketed counts (index-aligned with N_BUCKETS) —
@@ -126,6 +170,59 @@ class DurationStat:
             for i, c in enumerate(counts):
                 if c:
                     self.buckets[i] += int(c)
+
+    def bucket_snapshot(self) -> dict:
+        """One consistent {count, total, max, buckets} view — the
+        wire shape of the fleet rollup (obs/fleet.py): a peer ships
+        this and the collector merges it exactly."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "total": self.total,
+                "max": self.max,
+                "buckets": list(self.buckets),
+            }
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """EXACT merge of another DurationStat's bucket_snapshot():
+        counts/totals/max add, buckets add index-aligned — unlike
+        observe_bucket_counts there is no midpoint approximation, so
+        a fleet-merged mean is the true cluster mean and the merged
+        quantiles are real histogram quantiles, not means-of-means."""
+        buckets = snap.get("buckets") or []
+        with self._lock:
+            self.count += int(snap.get("count", 0))
+            self.total += float(snap.get("total", 0.0))
+            m = float(snap.get("max", 0.0))
+            if m > self.max:
+                self.max = m
+            for i, c in enumerate(buckets[: self.N_BUCKETS]):
+                if c:
+                    self.buckets[i] += int(c)
+
+    def exemplar_snapshot(self) -> dict:
+        """{bucket index: (trace_id, seconds)} of live exemplars.
+        Exemplars whose trace the in-memory tracer has fully evicted
+        are pruned HERE (from the snapshot and the retained table):
+        a metrics→trace link must never point at a trace that no
+        longer exists."""
+        with self._lock:
+            out = dict(self.exemplars)
+        if not out:
+            return out
+        from gubernator_tpu.utils import tracing
+
+        has = getattr(tracing.current_tracer(), "has_trace", None)
+        if has is None:
+            return out
+        for b, (tid, _v) in list(out.items()):
+            if not has(tid):
+                del out[b]
+                with self._lock:
+                    cur = self.exemplars.get(b)
+                    if cur is not None and cur[0] == tid:
+                        del self.exemplars[b]
+        return out
 
     def mean(self) -> float:
         # Under the lock so count/total come from the same observation
@@ -618,6 +715,73 @@ class InstanceCollector(Collector):
             g.add_metric([stage, "0.99"], stat.p99())
         yield g
 
+        # The RAW per-stage histograms behind the quantile gauge: a
+        # cross-node scraper (obs/fleet.py, bench.py's multi-node
+        # stage budgets) needs the bucket counts to MERGE histograms
+        # into real cluster quantiles — averaging per-node p99s is
+        # the means-of-means lie the rollup exists to retire.  Tail
+        # buckets carry OpenMetrics exemplars (last sampled trace_id)
+        # when tracing is live, so a p99 bucket links straight to a
+        # flight-recorder trace (classic exposition drops them;
+        # /metrics?exemplars=1 serves the OpenMetrics rendering).
+        h = HistogramMetricFamily(
+            "gubernator_stage_seconds",
+            "Per-stage latency histogram (36 log2 buckets from 1µs; "
+            "the raw counts behind gubernator_stage_quantile_seconds, "
+            "mergeable across nodes into real cluster quantiles).",
+            labels=["stage"],
+        )
+        for stage, stat in quantile_stats.items():
+            snap = stat.bucket_snapshot()
+            exs = stat.exemplar_snapshot()
+            cum = 0
+            buckets = []
+            for i, c in enumerate(snap["buckets"]):
+                cum += c
+                _lo, hi = DurationStat.bucket_bounds(i)
+                ex = exs.get(i)
+                if ex is not None:
+                    buckets.append(
+                        (
+                            f"{hi:.9g}", float(cum),
+                            Exemplar({"trace_id": ex[0]}, float(ex[1])),
+                        )
+                    )
+                else:
+                    buckets.append((f"{hi:.9g}", float(cum)))
+            buckets.append(("+Inf", float(snap["count"])))
+            h.add_metric([stage], buckets, sum_value=snap["total"])
+        yield h
+
+        # SLO watchdog gauges (obs/slo.py, attached by the daemon):
+        # the continuously-evaluated burn rates of the declared SLIs
+        # and the live admission-bound headroom — RESILIENCE.md's
+        # N×limit proofs as a gauge instead of a bench-only assert.
+        wd = getattr(inst, "slo_watchdog", None)
+        if wd is not None:
+            snap = wd.metrics_snapshot()
+            g = GaugeMetricFamily(
+                "gubernator_slo_burn_rate",
+                "Error-budget burn rate per declared SLI and window "
+                "(>1 = burning budget faster than the SLO allows; "
+                "multi-window multi-burn-rate alerting, obs/slo.py).",
+                labels=["sli", "window"],
+            )
+            for (sli, window), v in sorted(snap["burn"].items()):
+                g.add_metric([sli, window], v)
+            yield g
+            g = GaugeMetricFamily(
+                "gubernator_invariant_headroom",
+                "Per watched finite-limit key: derived admission "
+                "bound minus observed admitted hits in the current "
+                "window (negative = a RESILIENCE.md invariant was "
+                "violated; the bound label names the derivation).",
+                labels=["key", "bound"],
+            )
+            for (key, bound), v in sorted(snap["headroom"].items()):
+                g.add_metric([key, bound], v)
+            yield g
+
         # Native event ring (core/native/event_ring.cpp, drained by
         # utils/native_events.py): per-stage C-front latency events and
         # the ring's overflow drops — the first per-decision visibility
@@ -797,6 +961,82 @@ class InstanceCollector(Collector):
         )
         c.add_metric([], jit_guard.compile_count())
         yield c
+
+
+class FleetRollupCollector(Collector):
+    """Exports ONE merged fleet rollup (obs/fleet.FleetCollector
+    .collect()) as gubernator_fleet_* families — served by any node
+    at /metrics?fleet=1 so a single scrape answers for the cluster:
+    counters SUM, gauges label-join by peer/region, and stage
+    histograms merge via the 36-bucket path so the fleet p50/p99 are
+    real quantiles.  Registered into a throwaway registry per scrape
+    (the rollup is a point-in-time fan-out, not node state)."""
+
+    def __init__(self, rollup: dict):
+        self.rollup = rollup
+
+    def collect(self) -> Iterable:
+        r = self.rollup
+        regions = r.get("regions") or {}
+        g = GaugeMetricFamily(
+            "gubernator_fleet_nodes",
+            "Nodes merged into this fleet rollup, by region.",
+            labels=["region"],
+        )
+        for region, sub in sorted(regions.items()):
+            g.add_metric([region or "default"], sub.get("nodes", 0))
+        yield g
+        c = CounterMetricFamily(
+            "gubernator_fleet_counter",
+            "Fleet-summed node counters by name and region (the "
+            "per-region subtotals come from the nodes' DC tags; the "
+            "cluster total is the sum over regions).",
+            labels=["counter", "region"],
+        )
+        for region, sub in sorted(regions.items()):
+            for name, v in sorted((sub.get("counters") or {}).items()):
+                c.add_metric([name, region or "default"], v)
+        yield c
+        g = GaugeMetricFamily(
+            "gubernator_fleet_gauge",
+            "Per-node gauges label-joined by peer and region (gauges "
+            "do not sum — cache sizes and queue depths are per-node "
+            "facts).",
+            labels=["gauge", "peer", "region"],
+        )
+        for name, by_peer in sorted((r.get("gauges") or {}).items()):
+            for peer, (region, v) in sorted(by_peer.items()):
+                g.add_metric([name, peer, region or "default"], v)
+        yield g
+        g = GaugeMetricFamily(
+            "gubernator_fleet_stage_quantile_seconds",
+            "REAL cluster-wide per-stage quantiles from histogram "
+            "merge (DurationStat.merge_snapshot over every node's "
+            "36-bucket histogram) — not means of per-node quantiles.",
+            labels=["stage", "quantile"],
+        )
+        for stage, q in sorted((r.get("quantiles") or {}).items()):
+            g.add_metric([stage, "0.5"], q.get("p50_ms", 0.0) / 1e3)
+            g.add_metric([stage, "0.99"], q.get("p99_ms", 0.0) / 1e3)
+        yield g
+        scrape = r.get("scrape") or {}
+        g = GaugeMetricFamily(
+            "gubernator_fleet_scrape",
+            "The rollup fan-out's own health, by outcome: peers that "
+            "answered (ok), failed inside the budget (failed), or "
+            "were skipped because their circuit was open (skipped).",
+            labels=["outcome"],
+        )
+        for outcome in ("ok", "failed", "skipped"):
+            g.add_metric([outcome], scrape.get(outcome, 0))
+        yield g
+
+
+def build_fleet_registry(rollup: dict) -> CollectorRegistry:
+    """Throwaway registry for one /metrics?fleet=1 scrape."""
+    reg = CollectorRegistry()
+    reg.register(FleetRollupCollector(rollup))
+    return reg
 
 
 def build_registry(

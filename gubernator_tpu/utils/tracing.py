@@ -136,9 +136,49 @@ class InMemoryTracer:
         self.finished = deque(maxlen=max(1, max_spans))
         self._lock = threading.Lock()
         self._local = threading.local()
+        # Live trace ids by refcount: a metric exemplar links a
+        # histogram bucket to a trace_id (utils/metrics.DurationStat),
+        # and an exemplar pointing at a trace the deque has fully
+        # evicted is a dead link — has_trace() answers membership in
+        # O(1) so the exporter can prune instead of publishing it.
+        # Every OPEN span holds one ref (acquired at start, released
+        # at finish) and every RETAINED finished span holds one: an
+        # exemplar is captured while its span is still open, so a
+        # scrape racing the span's finish must still see the trace as
+        # live — pruning there would drop the link moments before the
+        # trace lands in the deque.
+        self._trace_refs: dict = {}  # guberlint: guarded-by _lock
         # Root-finish hook (utils/flight_recorder.py): called with the
         # outermost span of a thread's stack right after it finishes.
         self.on_root_finish = None
+
+    def _acquire_ref_locked(self, trace_id: str) -> None:
+        self._trace_refs[trace_id] = (
+            self._trace_refs.get(trace_id, 0) + 1
+        )
+
+    def _release_ref_locked(self, trace_id: str) -> None:
+        n = self._trace_refs.get(trace_id, 0) - 1
+        if n <= 0:
+            self._trace_refs.pop(trace_id, None)
+        else:
+            self._trace_refs[trace_id] = n
+
+    def _append_finished_locked(self, s: "RecordedSpan") -> None:
+        """Append under self._lock, accounting trace-id refcounts
+        through the deque's eviction (popleft explicitly — an implicit
+        maxlen eviction would be invisible to the refcount table)."""
+        if len(self.finished) == self.finished.maxlen:
+            old = self.finished.popleft()
+            self._release_ref_locked(old.trace_id)
+        self.finished.append(s)
+        self._acquire_ref_locked(s.trace_id)
+
+    def has_trace(self, trace_id: str) -> bool:
+        """Whether any open or retained finished span of this trace
+        is still live (exemplar liveness — see _trace_refs above)."""
+        with self._lock:
+            return trace_id in self._trace_refs
 
     def _stack(self) -> List[RecordedSpan]:
         st = getattr(self._local, "stack", None)
@@ -190,13 +230,28 @@ class InMemoryTracer:
             remote=remote,
         )
         stack.append(s)
+        # The open span holds a trace ref so an exemplar captured
+        # inside it survives a scrape racing the span's finish — but
+        # only the thread's STACK ROOT (or a span re-anchored to a
+        # different trace) needs one: children share the root's
+        # trace_id, so its ref already keeps has_trace() true for
+        # exemplars captured in descendants, and skipping them avoids
+        # a global-lock acquisition per child span start.
+        own_ref = len(stack) == 1 or s.trace_id != stack[0].trace_id
+        if own_ref:
+            with self._lock:
+                self._acquire_ref_locked(s.trace_id)
         try:
             yield s
         finally:
             stack.pop()
             s.end_ns = time.monotonic_ns()
             with self._lock:
-                self.finished.append(s)
+                # Retained-ref first, open-ref release second: the
+                # trace must never read dead between the two.
+                self._append_finished_locked(s)
+                if own_ref:
+                    self._release_ref_locked(s.trace_id)
             # Fire for this PROCESS's trace roots: spans with no
             # parent anywhere, plus remote-parented handler spans —
             # on an owner node every root is rpc.* with a remote
@@ -243,7 +298,7 @@ class InMemoryTracer:
             parent_span_id=parent_span_id,
         )
         with self._lock:
-            self.finished.append(s)
+            self._append_finished_locked(s)
         return s
 
     def add_event(self, name: str, **attrs) -> None:
@@ -289,6 +344,7 @@ class InMemoryTracer:
     def clear(self) -> None:
         with self._lock:
             self.finished.clear()
+            self._trace_refs.clear()
 
 
 class _OtelTracer:

@@ -193,6 +193,42 @@ def _extract(data: dict) -> dict | None:
             out["over_admission_bound"] = can.get("bound")
         if data.get("hits_dropped") is not None:
             out["multiregion_hits_dropped"] = data["hits_dropped"]
+    # Multi-node stage budgets: artifacts captured since the PR 15
+    # histogram-merge fix carry real cross-node merged p50/p99 per
+    # stage (bench.py _stage_budget_diff diffs and merges the nodes'
+    # gubernator_stage_seconds buckets); older artifacts folded
+    # per-node count/sum into means — the means-of-means lie.  Mark
+    # every row so legacy numbers read as the means they are, not as
+    # quantiles.
+    sb = data.get("stage_budget_ms")
+    if isinstance(sb, dict) and sb:
+        legacy = not any(
+            isinstance(v, dict) and "p99_ms" in v for v in sb.values()
+        )
+        out["stage_budget_kind"] = (
+            "per-node means (legacy)" if legacy else "merged quantiles"
+        )
+    # Fleet observability A/B artifacts (fleetobs mode): fold the
+    # off arm + median pair delta (the < 2% acceptance bar), the live
+    # SLO burn-rate / admission-bound headroom columns
+    # (gubernator_slo_burn_rate / gubernator_invariant_headroom as
+    # measured during the run), and the rollup's scrape coverage.
+    if data.get("fleetobs_delta_pct") is not None:
+        out["fleetobs_off_value"] = data.get("fleetobs_off_value")
+        out["fleetobs_delta_pct"] = data["fleetobs_delta_pct"]
+        slo = data.get("slo")
+        if isinstance(slo, dict):
+            if slo.get("max_burn") is not None:
+                out["slo_max_burn"] = slo["max_burn"]
+            if slo.get("breaches") is not None:
+                out["slo_breaches"] = slo["breaches"]
+        can = data.get("canary")
+        if isinstance(can, dict) and can.get("headroom") is not None:
+            out["invariant_headroom"] = can["headroom"]
+            out["invariant_bound"] = can.get("bound")
+        fl = data.get("fleet")
+        if isinstance(fl, dict) and fl.get("scrape_ok") is not None:
+            out["fleet_scrape_ok"] = fl["scrape_ok"]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its

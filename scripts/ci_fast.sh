@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly nine things:
+# Runs exactly ten things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -44,14 +44,21 @@
 #      reads `open`, and the healed region converges — the
 #      multi-region federation gate (RESILIENCE.md section 12), 30 s
 #      wall budget;
-#   8. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#   8. the obs smoke (scripts/obs_smoke.py): a jax-free 2×2 loopback
+#      harness through the fleet rollup merge (all four nodes, real
+#      histogram-merged quantiles), a partition that burns the
+#      degraded-fraction SLI past its fast-pair factor, and the
+#      admission-bound headroom recovering after the heal — the fleet
+#      observability gate (OBSERVABILITY.md sections 9-10), 30 s wall
+#      budget;
+#   9. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants;
 #      tests/test_multiregion.py: the full-stack 2×2 federation
 #      invariants; the multi-cycle soaks are @slow);
-#   9. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#  10. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -181,6 +188,23 @@ echo "crossregion smoke: ${XR_MS} ms (budget 30000 ms)" >&2
 if [ "${XR_MS}" -gt 30000 ]; then
   echo "crossregion smoke blew its 30 s budget — it must stay jax-free" >&2
   echo "and cheap enough to gate every federation-plane edit" >&2
+  exit 1
+fi
+
+echo "=== obs smoke (fleet rollup + SLO burn + headroom) ===" >&2
+OBS_T0=$(date +%s%N)
+if ! timeout -k 10 60 python scripts/obs_smoke.py; then
+  echo "obs smoke: the fleet rollup stopped merging all nodes, the" >&2
+  echo "degraded-fraction SLI no longer burns under a partition, or" >&2
+  echo "the admission-bound headroom failed to recover after heal" >&2
+  echo "(scripts/obs_smoke.py; OBSERVABILITY.md sections 9-10)" >&2
+  exit 1
+fi
+OBS_MS=$(( ($(date +%s%N) - OBS_T0) / 1000000 ))
+echo "obs smoke: ${OBS_MS} ms (budget 30000 ms)" >&2
+if [ "${OBS_MS}" -gt 30000 ]; then
+  echo "obs smoke blew its 30 s budget — it must stay jax-free and" >&2
+  echo "cheap enough to gate every observability-plane edit" >&2
   exit 1
 fi
 

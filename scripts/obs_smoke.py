@@ -1,0 +1,264 @@
+"""Obs smoke: 2×2 fleet rollup + SLO watchdog arc, jax-free, fast.
+
+ci_fast.sh stage (30 s wall budget, the crossregion-smoke pattern):
+drive the REAL FleetCollector + SLOWatchdog + AdmissionWatch + fault
+injector + per-peer circuit breakers through a partition arc on a
+jax-free, grpc-server-free 2-region × 2-node loopback harness — the
+smoke budget is spent on the observability plane, not on XLA warmup
+or daemon bootstrap.  The full-stack invariants (real daemons, the
+ObsSnapshot RPC end to end, /debug/fleet over HTTP) are pinned by
+tests/test_obs.py in the tier-1 suite.
+
+Asserts, in order:
+
+1. MERGE: one collect() from node east-0 reaches all four nodes,
+   sums counters per region, and the merged stage p99 lands in the
+   slow node's octave — a real histogram-merged quantile (a mean of
+   per-node p99s would sit in the empty gap between the modes).
+2. FAULT: with the west region partitioned, the scrape counts the
+   unreachable peers (failed/skipped, never an exception), and a
+   burst of degraded_region answers makes the degraded-fraction SLI
+   BURN past its fast-pair factor — a recorded breach.
+3. HEAL + RECOVER: the watched canary key admits up to its bound
+   with headroom ≥ 0 throughout, and a new duration window after the
+   heal re-arms the count — headroom recovers to the full derived
+   bound.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    t0 = time.monotonic()
+
+    from gubernator_tpu.cluster import faults
+    from gubernator_tpu.cluster.health import PeerHealth
+    from gubernator_tpu.cluster.peer_client import PeerError
+    from gubernator_tpu.obs.fleet import FleetCollector
+    from gubernator_tpu.obs.slo import AdmissionWatch, SLOWatchdog
+    from gubernator_tpu.types import PeerInfo
+    from gubernator_tpu.utils.metrics import DurationStat
+
+    class Engine:
+        requests_total = 0
+        over_limit_total = 0
+
+        @staticmethod
+        def cache_size() -> int:
+            return 0
+
+    class Node:
+        """One 'daemon': the narrow instance surface the collector
+        snapshots, plus its region tag."""
+
+        def __init__(self, addr: str, region: str):
+            self.addr = addr
+            self.region = region
+            self.engine = Engine()
+            self.counters = {
+                "check_errors": 0,
+                "degraded_region_answers": 0,
+            }
+            self.stage_timers = {"window_wait": DurationStat()}
+            self.admission_watch = AdmissionWatch()
+            self._peers: list = []
+            self.obs = FleetCollector(
+                self, addr=addr, region=region,
+                rpc_timeout=0.2, fanout_deadline=0.5,
+            )
+
+        def get_peer_list(self):
+            return [p for p in self._peers
+                    if p.info.datacenter == self.region]
+
+        def get_region_pickers(self):
+            remote = {}
+            for p in self._peers:
+                if p.info.datacenter != self.region:
+                    remote.setdefault(
+                        p.info.datacenter, _Ring([])
+                    )._peers.append(p)
+            return remote
+
+    class _Ring:
+        def __init__(self, peers):
+            self._peers = list(peers)
+
+        def peers(self):
+            return list(self._peers)
+
+    class LoopbackPeer:
+        """In-process PeerClient stand-in: the fault injector gates
+        obs_snapshot_raw at the same (src, dst) choke point, outcomes
+        feed a real PeerHealth breaker."""
+
+        def __init__(self, src: Node, dst: Node):
+            self.info = PeerInfo(
+                grpc_address=dst.addr, http_address="",
+                datacenter=dst.region,
+            )
+            self._src, self._dst = src, dst
+            self.health = PeerHealth(
+                dst.addr, failure_threshold=3, backoff=0.05,
+                backoff_cap=0.2,
+            )
+
+        def obs_snapshot_raw(self, timeout=None) -> bytes:
+            if not self.health.allow():
+                raise PeerError(
+                    f"circuit open to {self.info.grpc_address}",
+                    not_ready=True, circuit_open=True,
+                )
+            inj = faults.active()
+            if inj is not None:
+                try:
+                    inj.check(self._src.addr, self._dst.addr)
+                except faults.FaultError as e:
+                    self.health.record_failure()
+                    raise PeerError(str(e), not_ready=True) from e
+            self.health.record_success()
+            return self._dst.obs.local_snapshot_raw()
+
+    east = [Node(f"10.0.0.{i}:81", "east") for i in (1, 2)]
+    west = [Node(f"10.0.1.{i}:81", "west") for i in (1, 2)]
+    nodes = east + west
+    for n in nodes:
+        n._peers = [
+            LoopbackPeer(n, other) for other in nodes if other is not n
+        ]
+    lead = east[0]
+    wd = SLOWatchdog(
+        lead.obs, lead.admission_watch, interval=0,
+        fleet_scope=True,
+        fast_windows=(0.05, 0.1), slow_windows=(0.5, 1.0),
+        fast_factor=14.4,
+    )
+
+    inj = faults.install(faults.FaultInjector(seed=7))
+    try:
+        # -- phase 1: healthy merge + real quantiles -------------------
+        for n in nodes:
+            n.engine.requests_total = 100
+            for _ in range(99):
+                n.stage_timers["window_wait"].observe(0.001)
+        # One slow node: the merged p99 must find ITS octave.
+        for _ in range(8):
+            west[1].stage_timers["window_wait"].observe(0.512)
+        fleet = lead.obs.collect()
+        assert len(fleet["nodes"]) == 4, fleet["nodes"]
+        assert fleet["scrape"] == {
+            **fleet["scrape"], "ok": 4, "failed": 0, "skipped": 0,
+        }, fleet["scrape"]
+        assert fleet["regions"]["east"]["nodes"] == 2
+        assert fleet["counters"]["checks"] == 400
+        q = fleet["quantiles"]["window_wait"]
+        assert q["count"] == 404
+        assert 0.5 < q["p50_ms"] < 2.0, q
+        assert 250.0 < q["p99_ms"] < 1100.0, (
+            "merged p99 must be the histogram-merged quantile "
+            f"(the slow octave), got {q}"
+        )
+        wd.evaluate(fleet)  # baseline sample for the burn windows
+
+        # -- phase 2: partition west + burn the degraded SLI -----------
+        for e in east:
+            for w in west:
+                inj.partition(e.addr, w.addr)
+        # Serving continues region-locally; every MULTI_REGION answer
+        # is flagged degraded_region while west is unreachable.
+        time.sleep(0.07)  # cross the fast short window
+        for n in east:
+            n.engine.requests_total += 200
+            n.counters["degraded_region_answers"] += 150
+        fleet = lead.obs.collect()
+        scrape = fleet["scrape"]
+        assert scrape["ok"] == 2 and (
+            scrape["failed"] + scrape["skipped"] == 2
+        ), scrape
+        out = wd.evaluate(fleet)
+        burns = {
+            k: v for k, v in out["slis"].items()
+            if k.startswith("degraded_region_fraction@fast")
+        }
+        assert burns and all(v > 14.4 for v in burns.values()), out[
+            "slis"
+        ]
+        assert any(
+            b["sli"] == "degraded_region_fraction"
+            for b in out["breaches"]
+        ), out["breaches"]
+
+        # -- phase 3: canary headroom + recovery after heal ------------
+        key = "xr_canary"
+        limit = 40
+        for n in nodes:
+            n.admission_watch.watch(key, limit=limit)
+
+        class Resp:
+            error = ""
+
+            def __init__(self, status, reset_time):
+                self.status = status
+                self.reset_time = reset_time
+
+        class Req:
+            hits = 1
+            limit = 40
+
+            @staticmethod
+            def hash_key():
+                return key
+
+        # Each partition side admits up to its regional limit — the
+        # §12 drift shape: cluster-admitted ≤ N_regions × limit.
+        for n in (east[0], west[0]):
+            for _ in range(limit):
+                n.admission_watch.observe_batch(
+                    [Req()], [Resp(0, 1000)]
+                )
+        fleet = lead.obs.collect()  # west unreachable: east slice only
+        out = wd.evaluate(fleet)
+        hr = out["headroom"][key]
+        assert hr["headroom"] >= 0, hr
+        inj.heal()
+        fleet = lead.obs.collect()
+        assert fleet["admitted"][key]["admitted"] == 2 * limit
+        out = wd.evaluate(fleet)
+        hr = out["headroom"][key]
+        assert hr["bound"] == f"2_regions_x_{limit}", hr
+        assert hr["headroom"] == 0, hr  # exactly at the bound
+        # A new duration window re-arms the count: headroom recovers
+        # to the full derived bound.
+        for n in (east[0], west[0]):
+            n.admission_watch.observe_batch([Req()], [Resp(1, 61_000)])
+        fleet = lead.obs.collect()
+        out = wd.evaluate(fleet)
+        assert out["headroom"][key]["headroom"] == 2 * limit, out[
+            "headroom"
+        ]
+        assert wd.status()["breaches"], "breach log must retain phase 2"
+    finally:
+        faults.uninstall()
+        wd.close()
+        for n in nodes:
+            n.obs.close()
+
+    elapsed_ms = (time.monotonic() - t0) * 1e3
+    print(
+        "obs smoke OK: 2x2 rollup merge + degraded-SLI burn + "
+        "headroom recovery "
+        f"in {elapsed_ms:.0f} ms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1372,6 +1372,78 @@ def test_drift_pass_stale_doc_rows(tmp_path):
     assert ("metric-stale", "gubernator_ghost_total") in details
 
 
+def _slo_repo(tmp_path: Path, slo_body: str) -> Path:
+    """A drift fixture repo with an SLI registry (obs/slo.py) — the
+    slo sub-rule's seed bed."""
+    root = _drift_repo(tmp_path)
+    (root / "gubernator_tpu" / "obs").mkdir()
+    (root / "gubernator_tpu" / "obs" / "slo.py").write_text(slo_body)
+    return root
+
+
+def test_drift_slo_unregistered_metric_is_a_finding(tmp_path):
+    """An SLI naming a metric the registry never exports flags — the
+    burn rate would watch a series that does not exist."""
+    from tools.guberlint import driftcheck
+
+    root = _slo_repo(
+        tmp_path,
+        textwrap.dedent(
+            """
+            from dataclasses import dataclass
+
+            @dataclass(frozen=True)
+            class SLI:
+                name: str = ""
+                metric: str = ""
+                kind: str = ""
+
+            GOOD = SLI(
+                name="ok",
+                metric="gubernator_documented_total",
+                kind="ratio",
+            )
+            BAD = SLI(
+                name="ghost",
+                metric="gubernator_never_registered",
+                kind="ratio",
+            )
+            """
+        ),
+    )
+    findings = driftcheck.check(root, [])
+    details = {(f.rule, f.detail) for f in findings}
+    assert ("slo-metric-unregistered", "gubernator_never_registered") \
+        in details
+    assert not any(
+        d == "gubernator_documented_total" for _, d in details
+    )
+
+
+def test_drift_slo_computed_metric_name_is_a_finding(tmp_path):
+    """An SLI without a literal metric= is unverifiable — it must
+    flag (or carry a reasoned suppression)."""
+    from tools.guberlint import driftcheck
+
+    root = _slo_repo(
+        tmp_path,
+        textwrap.dedent(
+            """
+            class SLI:
+                def __init__(self, **kw):
+                    pass
+
+            NAME = "gubernator_documented_total"
+            COMPUTED = SLI(name="dyn", metric=NAME, kind="ratio")
+            SUPPRESSED = SLI(name="dyn2", metric=NAME, kind="ratio")  # guberlint: ok drift — resolved at import, pinned by tests
+            """
+        ),
+    )
+    findings = driftcheck.check(root, [])
+    rules = [f.rule for f in findings if f.rule.startswith("slo")]
+    assert rules == ["slo-no-metric"]
+
+
 def test_drift_pass_prose_mention_is_not_a_read(tmp_path):
     """Docstrings and comments naming a knob must not count as reads
     (only call-argument string literals do)."""

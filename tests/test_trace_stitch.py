@@ -453,6 +453,22 @@ def test_debug_endpoints_serve_live_data(tracer, monkeypatch):
         assert "device.step" in vars_["stage_budget"]
         assert vars_["stage_budget"]["device.step"]["count"] >= 1
         assert "device.readback" in vars_["stage_budget"]
+        # PR 13/14 planes (ISSUE 15 satellite): the replication and
+        # multiregion managers' stats in the one-stop snapshot —
+        # manager counters, per-region circuit state, held/requeued
+        # accounting.
+        mr = vars_["multiregion"]
+        assert {
+            "windows", "region_sends", "hits_requeued",
+            "hits_dropped", "region_states", "pending",
+            "pending_retry", "window_wait", "region_rpc",
+        } <= set(mr)
+        if vars_.get("replication") is not None:
+            repl = vars_["replication"]
+            assert {
+                "promoted_keys", "replica_leases", "promoted",
+                "demoted", "answered", "credit_granted",
+            } <= set(repl)
         hot = _get_json(addr, "/debug/hotkeys")
         assert hot["enabled"]
         assert any(r["key"].startswith("dbg_") for r in hot["top"])
@@ -488,6 +504,12 @@ def test_debug_endpoints_disabled_shapes(monkeypatch):
         assert hot == {"enabled": False, "top": []}
         vars_ = _get_json(addr, "/debug/vars")
         assert "stage_budget" in vars_
+        # The PR 13/14 sections answer their shapes even when the
+        # planes are idle (single node, no replication traffic).
+        assert "multiregion" in vars_
+        assert "replication" in vars_ or h.daemon_at(
+            0
+        ).replication is None
     finally:
         h.stop()
 

@@ -146,8 +146,14 @@ KNOB_DOC_FILE = "README.md"
 METRIC_REGISTRY = "gubernator_tpu/utils/metrics.py"
 METRIC_DOC_FILES = (
     "README.md", "PERF.md", "RESILIENCE.md", "STATIC_ANALYSIS.md",
-    "scripts/bench_trend.py",
+    "OBSERVABILITY.md", "scripts/bench_trend.py",
 )
+
+# The SLI declaration file (obs/slo.py): the drift `slo` sub-rule
+# checks every SLI(...) declaration there names a metric the registry
+# actually exports — an SLI over a dropped series would silently
+# evaluate nothing.
+SLO_REGISTRY = "gubernator_tpu/obs/slo.py"
 
 # Methods known to acquire a lock at their top level: a call to one of
 # these while holding other locks creates an acquisition-order edge

@@ -26,6 +26,12 @@ The operator contract, enforced (STATIC_ANALYSIS.md):
   Deliberate twins (the sharded engine mirrors engine.py's stages
   under the same names so the tests/oracles stay backend-agnostic)
   carry reasoned suppressions at the twin site.
+- ``drift-slo-metric-unregistered`` / ``drift-slo-no-metric`` — the
+  slo sub-rule: every ``SLI(...)`` declaration in config.SLO_REGISTRY
+  (obs/slo.py) must carry a literal ``metric=`` naming a series
+  utils/metrics.py actually registers.  An SLI is an operator promise
+  ("this burn rate watches that metric"); one over a dropped or
+  mistyped series would silently evaluate nothing.
 
 Knob reads are collected from the AST (string literals used as call
 arguments), so prose/docstrings never count as reads; metric
@@ -50,6 +56,7 @@ from tools.guberlint.config import (
     KNOB_SCAN_ROOTS,
     METRIC_DOC_FILES,
     METRIC_REGISTRY,
+    SLO_REGISTRY,
 )
 from tools.guberlint.csource import CSourceFile
 
@@ -68,6 +75,7 @@ def check(repo_root: Path, csrcs: List[CSourceFile]) -> List[Finding]:
     _check_knobs(repo_root, reads, findings)
     _check_metrics(repo_root, findings)
     _check_spans(repo_root, findings)
+    _check_slo(repo_root, findings)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 
@@ -235,6 +243,62 @@ def _check_spans(repo_root: Path, findings: List[Finding]) -> None:
                     "deliberate twin with its reason",
                 )
             )
+
+
+# -- SLI surface (the slo sub-rule) ------------------------------------
+
+
+def _check_slo(repo_root: Path, findings: List[Finding]) -> None:
+    """Every SLI(...) declaration in config.SLO_REGISTRY must name a
+    registered metric via a literal ``metric=`` kwarg."""
+    path = repo_root / SLO_REGISTRY
+    if not path.exists():
+        return
+    src = SourceFile(path, SLO_REGISTRY)
+    if src.tree is None:
+        return
+    registered = {name for name, _src, _line in _registered_metrics(repo_root)}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else ""
+        )
+        if name != "SLI":
+            continue
+        metric = None
+        for kw in node.keywords:
+            if kw.arg == "metric" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                metric = kw.value.value
+        if metric is None:
+            if not src.suppressed(node.lineno, PASS):
+                findings.append(
+                    Finding(
+                        PASS, "slo-no-metric", src.rel, node.lineno,
+                        "<module>", f"SLI@{node.lineno}",
+                        "SLI declaration without a literal metric= — "
+                        "every declared SLI must name the documented "
+                        "metric backing it (the drift slo sub-rule "
+                        "cannot verify a computed name)",
+                    )
+                )
+            continue
+        if metric in registered:
+            continue
+        if src.suppressed(node.lineno, PASS):
+            continue
+        findings.append(
+            Finding(
+                PASS, "slo-metric-unregistered", src.rel, node.lineno,
+                "<module>", metric,
+                f"SLI declares metric {metric} but "
+                f"{METRIC_REGISTRY} never registers it — the burn "
+                "rate would watch a series that does not exist",
+            )
+        )
 
 
 # -- metric surface ----------------------------------------------------

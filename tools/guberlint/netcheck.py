@@ -54,6 +54,9 @@ PEER_RPC_METHODS = {
     "transfer_buckets_raw",
     "replicate_keys",
     "replicate_keys_raw",
+    # The fleet rollup scrape (obs/fleet.py): an unbudgeted
+    # ObsSnapshot would let one slow peer stall the rollup barrier.
+    "obs_snapshot_raw",
 }
 
 # Backoff-shaped calls that satisfy net-retry-no-backoff.
