@@ -292,6 +292,7 @@ class DualRingWindow:
     def acceptable(self, key: str, addr: str) -> bool:
         """True when `addr` may serve `key` during the window (it is
         the key's owner in the old OR the new ring)."""
+        # guberlint: invariant dual-window-no-third-owner
         return addr in self.owners(key)
 
     def moved(self, key: str) -> bool:

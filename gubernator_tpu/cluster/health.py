@@ -263,6 +263,7 @@ class PeerHealth:
         self._failures = 0
         self._to(BROKEN)
 
+    # guberlint: invariant circuit-legal-transitions
     def _to(self, state: str) -> None:  # guberlint: holds _lock
         if state != self._state:
             self._state = state

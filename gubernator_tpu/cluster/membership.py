@@ -271,6 +271,7 @@ class MembershipManager:
 
     def _commit(self, epoch: int) -> None:
         with self._lock:
+            # guberlint: invariant epoch-monotonic-commit
             if epoch != self._active_transition:
                 # A newer transition superseded us mid-ship; its
                 # thread owns the commit (it joined us first).

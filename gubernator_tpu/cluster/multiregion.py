@@ -378,6 +378,10 @@ class MultiRegionManager:
                             self.conf.multi_region_backoff_cap,
                         ),
                     )
+                    # Only the UNSENT tail re-queues: the delivered
+                    # prefix landed, and re-sending it would double-
+                    # count those hits at the region.
+                    # guberlint: invariant region-no-double-send
                     failed.extend(pairs[sent:])
                     # The DELIVERED prefix still clears its age
                     # entries below, even though the region push as a

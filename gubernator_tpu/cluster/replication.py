@@ -763,8 +763,6 @@ class ReplicationManager:
 
     @property
     def has_leases(self) -> bool:
-        # guberlint: ok lock — the lock-free idle gate: one stale int
-        # read per batch; a racing install is picked up next request.
         return self._n_leases > 0
 
     def try_answer(
@@ -775,7 +773,6 @@ class ReplicationManager:
         (status, remaining, reset), or None (caller forwards to the
         owner).  Exhausted credit falls through — the owner decides;
         the lease stays for the next refresh."""
-        # guberlint: ok lock — lock-free idle gate (see has_leases).
         if self._n_leases == 0:
             return None
         if (
@@ -826,7 +823,6 @@ class ReplicationManager:
         before a commit pass mutates anything, so a declined batch
         leaves the leases untouched and the pb-path replay cannot
         double-debit credit the first attempt already consumed."""
-        # guberlint: ok lock — lock-free idle gate (see has_leases).
         if self._n_leases == 0:
             return None
         rows = idx.tolist()

@@ -779,6 +779,10 @@ class DecisionLedger:
                 # until its reset (the flashcrowd canary's failure
                 # shape; big buckets are unaffected — lease_size caps
                 # first).
+                # Credit is carved from engine-confirmed remaining
+                # (minus this batch's own in-flight hits), so the sum
+                # of live lease slices never exceeds the window limit.
+                # guberlint: invariant over-admission-bound
                 avail = e.rem_hint - plan._batch_hits.get(h, 0)
                 acq = min(self.lease_size, avail // 2)
                 if acq < 1:
@@ -929,8 +933,22 @@ class DecisionLedger:
                 hs = s[4]
                 self._returning.discard(hs)
                 es = items.get(hs)
-                if es is not None and es.kind == _K_OVER and es.key == s[0]:
-                    self._demote_locked(es, hs)
+                if es is not None and es.key == s[0]:
+                    # The applied return invalidates every snapshot a
+                    # concurrent plan took of this key BEFORE it landed
+                    # (same reasoning as flush_settles' bump): a learn
+                    # racing in later with a pre-return (OVER, 0) must
+                    # fail its freshness check, or it re-installs the
+                    # starvation this loop's demote just prevented.
+                    # guberlint: invariant sticky-over-exact
+                    es.gen += 1
+                    if hs in plan.gens:
+                        # THIS plan's engine row ran after its own
+                        # prepended settles — its observation is
+                        # post-return, so its snapshot stays fresh.
+                        plan.gens[hs] = es.gen
+                    if es.kind == _K_OVER:
+                        self._demote_locked(es, hs)
             dec = plan.dec
             hh = np.asarray(dec.fnv1a)
             lim_a = np.asarray(dec.limit)
@@ -985,6 +1003,7 @@ class DecisionLedger:
                         # change): our OVER observation may describe a
                         # replaced bucket — insert nothing.
                         continue
+                    # guberlint: invariant hot-key-no-starvation
                     if h in self._pending or h in self._returning:
                         # A revoked lease's unused credit is queued or
                         # mid-apply for this key: the (OVER, 0) we saw
@@ -1063,6 +1082,7 @@ class DecisionLedger:
                     # it back.  Re-anchor the clock at every grant so
                     # offset drift stays bounded by one lease TTL.
                     self._native.set_clock_offset(now)
+                    # guberlint: invariant lease-single-tier
                     if self._native.install_lease(
                         e.key, e.limit, e.duration, e.reset,
                         e.rem, e.credit, 0, e.expiry,
@@ -1232,12 +1252,13 @@ class DecisionLedger:
                     h = s[4]
                     self._returning.discard(h)
                     e = self._items.get(h)
-                    if (
-                        e is not None
-                        and e.kind == _K_OVER
-                        and e.key == s[0]
-                    ):
-                        self._demote_locked(e, h)
+                    if e is not None and e.key == s[0]:
+                        # Stale pre-return snapshots must not learn
+                        # (see _learn's settle loop / flush_settles).
+                        # guberlint: invariant sticky-over-exact
+                        e.gen += 1
+                        if e.kind == _K_OVER:
+                            self._demote_locked(e, h)
 
     # ------------------------------------------------------------------
 

@@ -1,64 +1,73 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly ten things:
+# Runs exactly eleven things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
 #      the NATIVE tier (C guard/GIL/blocking/atomics over
 #      core/native/*.cpp), the Python<->C CONTRACT (wire layout,
-#      decision-plane constants, GUBER_* knobs), and knob/metric/doc
-#      DRIFT (STATIC_ANALYSIS.md); findings also land in
-#      guberlint.sarif so CI surfaces them as annotations, and the
-#      stage is held to a 10 s wall budget so it stays cheap enough to
-#      run first; the passes' seeded bad fixtures run inside the
-#      tier-1 pytest below (tests/test_guberlint.py);
-#   2. the trace smoke (scripts/trace_smoke.py): one in-memory-traced
+#      decision-plane constants, GUBER_* knobs), knob/metric/doc
+#      DRIFT, and PROTO invariant drift (annotations vs the gubercheck
+#      property registry vs RESILIENCE.md, STATIC_ANALYSIS.md);
+#      findings also land in guberlint.sarif so CI surfaces them as
+#      annotations, and the stage is held to a 10 s wall budget so it
+#      stays cheap enough to run first; the passes' seeded bad
+#      fixtures run inside the tier-1 pytest below
+#      (tests/test_guberlint.py);
+#   2. the gubercheck smoke (tools/gubercheck --smoke): CHESS-bounded
+#      (dpor + preemption_bound=2) interleaving exploration of every
+#      protocol scenario over the REAL lease/handoff/replication code,
+#      plus both resurrected-bug mutation fixtures (which must be
+#      CAUGHT) — jax-free, 30 s wall budget (measured: ~1 s; the
+#      exhaustive full-budget explorations are @slow in
+#      tests/test_gubercheck.py, STATIC_ANALYSIS.md);
+#   3. the trace smoke (scripts/trace_smoke.py): one in-memory-traced
 #      decision end-to-end through the real router, asserting a
 #      non-empty stitched span tree (root + engine child sharing one
 #      trace id) — jax-free, same 10 s wall budget as guberlint;
-#   3. the feeder smoke (scripts/feeder_smoke.py): the native
+#   4. the feeder smoke (scripts/feeder_smoke.py): the native
 #      columnar feeder's C-packed columns bit-equal to the Python
 #      columnar decode for a multi-RPC window, plus the ring window
 #      lifecycle and drain-then-close teardown — jax-free, 30 s wall
 #      budget (cold .so rebuild included);
-#   4. the event-front smoke (scripts/event_front_smoke.py): a few
+#   5. the event-front smoke (scripts/event_front_smoke.py): a few
 #      hundred concurrent connections through the epoll reactor plane
 #      from the connscale client — zero errors, reactor stages in the
 #      event ring, and a non-starved feeder ring wait — jax-free, 30 s
 #      wall budget (PERF.md section 26);
-#   5. the fused-kernel parity tier (tests/test_fused_parity.py,
+#   6. the fused-kernel parity tier (tests/test_fused_parity.py,
 #      GUBER_FUSED=interpret, jax CPU only, 120 s wall budget): the
 #      Pallas decision kernel bit-equal to models/spec.py + the
 #      single-dispatch-per-batch invariant — the kernel stays
 #      CI-enforced without TPU hardware (PERF.md section 24);
-#   6. the replication smoke (tests/test_replication.py promote/demote
+#   7. the replication smoke (tests/test_replication.py promote/demote
 #      round trip on a live 3-node cluster): a measured-hot key
 #      promotes to replica credit leases, answers go local, cooldown
 #      demotes and the credit reconciles — the hot-key adaptive
 #      ownership gate (RESILIENCE.md section 11), 120 s wall budget;
-#   7. the crossregion smoke (scripts/crossregion_smoke.py): a
+#   8. the crossregion smoke (scripts/crossregion_smoke.py): a
 #      jax-free 2×2 region×peer loopback harness driven through a
 #      full partition-heal-converge arc — failed cross-region deltas
 #      re-queue (counted, zero dropped), the region aggregate circuit
 #      reads `open`, and the healed region converges — the
 #      multi-region federation gate (RESILIENCE.md section 12), 30 s
 #      wall budget;
-#   8. the obs smoke (scripts/obs_smoke.py): a jax-free 2×2 loopback
+#   9. the obs smoke (scripts/obs_smoke.py): a jax-free 2×2 loopback
 #      harness through the fleet rollup merge (all four nodes, real
 #      histogram-merged quantiles), a partition that burns the
 #      degraded-fraction SLI past its fast-pair factor, and the
 #      admission-bound headroom recovering after the heal — the fleet
 #      observability gate (OBSERVABILITY.md sections 9-10), 30 s wall
 #      budget;
-#   9. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
+#  10. the tier-1 pytest line from ROADMAP.md (fuzz soaks marked `slow`
 #      are excluded so the suite stays inside its 870 s timeout) —
 #      includes the chaos fast cases (tests/test_chaos.py:
 #      kill/partition/heal invariants; tests/test_membership.py:
 #      join/drain/kill-during-handoff reshard invariants;
 #      tests/test_multiregion.py: the full-stack 2×2 federation
 #      invariants; the multi-cycle soaks are @slow);
-#  10. the `fast_capture` bench tier (scripts/bench_all.py): default +
+#  11. the `fast_capture` bench tier (scripts/bench_all.py): default +
 #      latency + herdfast with shortened knobs, writing
 #      BENCH_<round>_fast_capture.json with per-config durations.
 #
@@ -85,6 +94,24 @@ echo "guberlint: ${LINT_MS} ms (budget 10000 ms)" >&2
 if [ "${LINT_MS}" -gt 10000 ]; then
   echo "guberlint: blew its 10 s budget — it must stay cheap enough" >&2
   echo "to run as ci_fast stage one; profile the new pass" >&2
+  exit 1
+fi
+
+echo "=== gubercheck smoke (protocol interleaving exploration) ===" >&2
+GCK_T0=$(date +%s%N)
+if ! timeout -k 10 60 python -m tools.gubercheck --smoke; then
+  echo "gubercheck: a protocol scenario hit an invariant violation /" >&2
+  echo "deadlock, or a resurrected-bug mutation went UNCAUGHT — run" >&2
+  echo "'python -m tools.gubercheck --scenario <name>' for the repro" >&2
+  echo "schedule (tools/gubercheck; STATIC_ANALYSIS.md)" >&2
+  exit 1
+fi
+GCK_MS=$(( ($(date +%s%N) - GCK_T0) / 1000000 ))
+echo "gubercheck smoke: ${GCK_MS} ms (budget 30000 ms)" >&2
+if [ "${GCK_MS}" -gt 30000 ]; then
+  echo "gubercheck smoke blew its 30 s budget — trim the smoke budgets" >&2
+  echo "in scenarios.py (CHESS preemption_bound / max_runs), never the" >&2
+  echo "scenario itself; the full budgets live in the @slow suite" >&2
   exit 1
 fi
 

@@ -1,0 +1,261 @@
+"""gubercheck proves its own teeth.
+
+Three directions, mirroring tests/test_guberlint.py's seeded-bad
+philosophy (STATIC_ANALYSIS.md, gubercheck chapter):
+
+1. **Mutations are caught** — resurrecting two shipped-and-fixed bugs
+   (PR 4 duration-renewal, PR 13 lease-churn return race) in a twin
+   ledger module makes exploration find a violating schedule within
+   the ci_fast smoke budget.  If these ever stop failing, the checker
+   has gone blind.
+2. **Pristine scenarios are clean** — the smoke budgets in tier-1,
+   the committed full budgets (with exploration COMPLETE) in @slow.
+3. **The reductions are sound** — dpor agrees with full-mode ground
+   truth on verdicts, and the scheduler's structural guarantees
+   (determinism, deadlock detection) hold on minimal scenarios.
+"""
+
+import threading
+
+import pytest
+
+from tools.gubercheck import explore as explore_mod
+from tools.gubercheck import mutations as mut_mod
+from tools.gubercheck import scenarios as scn_mod
+from tools.gubercheck.explore import explore, run_once
+from tools.gubercheck.sched import DeadlockError, Scheduler, instrumented
+
+
+def _factory(name):
+    cls = scn_mod.get_scenario(name)
+    return lambda: cls()
+
+
+# ------------------------------------------------------- mutations
+
+
+def test_mutation_needles_still_match_the_ledger():
+    """Fixture-rot guard: every registered mutation's needle occurs
+    exactly once in core/ledger.py (build_mutated_ledger asserts it).
+    When a refactor moves a guard, this fails with the fixture name
+    instead of the mutation silently mutating nothing."""
+    for name in mut_mod.mutation_names():
+        mod = mut_mod.build_mutated_ledger(name)
+        assert mod.__name__ == "gubernator_tpu.core.ledger"
+        assert f"[mutated:{name}]" in mod.__file__
+
+
+def test_mutations_target_registered_scenarios_and_properties():
+    from tools.gubercheck import properties as props
+
+    registered = props.registry()
+    for name in mut_mod.mutation_names():
+        m = mut_mod.MUTATIONS[name]
+        assert m.scenario in scn_mod.scenario_names()
+        for p in m.properties:
+            assert p in registered, (
+                f"mutation {name} expects unregistered property {p}"
+            )
+
+
+@pytest.mark.parametrize("name", list(mut_mod.mutation_names()))
+def test_mutation_is_caught_within_smoke_budget(name):
+    """The acceptance gate from ISSUE 18: both resurrected historical
+    bugs are found by exploration under the ci_fast smoke budget
+    (dpor + preemption_bound=2).  Measured: pr4 at run 1, pr13 at
+    run 27 — max_runs=2000 leaves two orders of magnitude of slack."""
+    m = mut_mod.MUTATIONS[name]
+    budget = scn_mod.get_scenario(m.scenario).smoke
+    res = explore(
+        mut_mod.mutated_scenario_factory(name),
+        scenario_name=f"{m.scenario}[{name}]",
+        **budget,
+    )
+    assert res.violations, (
+        f"mutation {name} NOT caught in {res.runs} runs — "
+        "the checker lost its teeth"
+    )
+    v = res.violations[0]
+    if v.kind == "property":
+        assert v.prop in m.properties, (
+            f"caught the wrong invariant: {v.prop!r} not in "
+            f"{m.properties}"
+        )
+    assert v.schedule, "a violation must carry its repro schedule"
+
+
+def test_caught_schedule_replays_deterministically():
+    """The schedule attached to a violation is a repro: forcing it
+    through run_once re-triggers the same property violation."""
+    name = "pr4-duration-renewal-guard"
+    m = mut_mod.MUTATIONS[name]
+    factory = mut_mod.mutated_scenario_factory(name)
+    res = explore(
+        factory, scenario_name="repro",
+        **scn_mod.get_scenario(m.scenario).smoke,
+    )
+    v = res.violations[0]
+    rr = run_once(factory, v.schedule)
+    assert rr.violation is not None
+    assert rr.violation.kind == v.kind
+    assert rr.violation.prop == v.prop
+
+
+# ------------------------------------------------- clean scenarios
+
+
+@pytest.mark.parametrize("name", scn_mod.scenario_names())
+def test_clean_scenario_smoke_budget_is_clean(name):
+    """Pristine protocol code under the CHESS-bounded smoke budget:
+    no violations.  (Whole-catalog measured cost: under a second.)"""
+    cls = scn_mod.get_scenario(name)
+    res = explore(_factory(name), scenario_name=name, **cls.smoke)
+    assert res.ok, (
+        f"{name}: {res.violations[0].kind} "
+        f"{res.violations[0].detail} on {res.violations[0].schedule}"
+    )
+    assert res.runs >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", scn_mod.scenario_names())
+def test_clean_scenario_full_budget_explores_completely(name):
+    """The committed budgets in Scenario.full are real: exhaustive
+    (dpor-reduced) exploration DRAINS — complete=True, no truncation
+    — and stays clean.  Measured ceiling: ledger-native-delegation,
+    11172 runs / ~25 s; everything else well under 10 s."""
+    cls = scn_mod.get_scenario(name)
+    res = explore(
+        _factory(name), scenario_name=name,
+        stop_on_violation=False, **cls.full,
+    )
+    assert res.ok, f"{name}: {[v.detail for v in res.violations]}"
+    assert res.complete, (
+        f"{name} truncated by {res.truncated_by} after {res.runs} "
+        "runs — the committed budget in scenarios.py is stale"
+    )
+
+
+# ------------------------------------------------------ reductions
+
+
+def test_dpor_agrees_with_full_ground_truth():
+    """Cross-validation on the cheapest full-mode scenario: dpor must
+    reach the same verdict as unreduced exploration while visiting a
+    strict subset of schedules.  (Measured: 1069 vs 3774 runs.)"""
+    full = explore(
+        _factory("circuit-breaker"), mode="full",
+        max_runs=60000, max_steps=400, stop_on_violation=False,
+        scenario_name="cb-full",
+    )
+    dpor = explore(
+        _factory("circuit-breaker"), mode="dpor",
+        max_runs=60000, max_steps=400, stop_on_violation=False,
+        scenario_name="cb-dpor",
+    )
+    assert full.complete and dpor.complete
+    assert full.ok and dpor.ok
+    assert 1 < dpor.runs < full.runs, (
+        f"dpor visited {dpor.runs} vs full {full.runs} — reduction "
+        "should prune some schedules but never down to one"
+    )
+
+
+def test_dpor_still_catches_mutation_vs_full():
+    """Soundness where it matters: the reduction may not prune away
+    the violating schedule.  Both modes catch pr4."""
+    factory = mut_mod.mutated_scenario_factory(
+        "pr4-duration-renewal-guard"
+    )
+    for mode in ("full", "dpor"):
+        res = explore(
+            factory, mode=mode, max_runs=2000, max_steps=400,
+            scenario_name=f"pr4-{mode}",
+        )
+        assert res.violations, f"mode={mode} missed the mutation"
+
+
+def test_preemption_bound_zero_is_sequential_only():
+    """preemption_bound=0 explores only non-preemptive schedules — a
+    tiny space (it may still catch ordering bugs, but never races
+    needing a mid-critical-section switch)."""
+    bounded = explore(
+        _factory("circuit-breaker"), mode="full", preemption_bound=0,
+        max_runs=60000, max_steps=400, stop_on_violation=False,
+        scenario_name="cb-pb0",
+    )
+    unbounded = explore(
+        _factory("circuit-breaker"), mode="full",
+        max_runs=60000, max_steps=400, stop_on_violation=False,
+        scenario_name="cb-pb-none",
+    )
+    assert bounded.complete
+    assert bounded.runs < unbounded.runs
+
+
+def test_explore_honors_max_runs_truncation():
+    res = explore(
+        _factory("circuit-breaker"), mode="full", max_runs=3,
+        max_steps=400, stop_on_violation=False, scenario_name="cb-3",
+    )
+    assert res.runs == 3
+    assert not res.complete
+    assert res.truncated_by == "max_runs"
+
+
+# ------------------------------------------------------- scheduler
+
+
+class _DeadlockScenario(scn_mod.Scenario):
+    """Minimal AB-BA deadlock: two tasks taking two locks in opposite
+    order.  Some schedule must deadlock, and the scheduler must report
+    it as DeadlockError rather than hanging."""
+
+    name = "abba"
+
+    def build(self, sched):
+        a, b = threading.Lock(), threading.Lock()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        sched.spawn("t1", t1)
+        sched.spawn("t2", t2)
+
+
+def test_scheduler_detects_abba_deadlock():
+    res = explore(
+        lambda: _DeadlockScenario(), mode="full", max_runs=200,
+        max_steps=100, scenario_name="abba",
+    )
+    assert res.violations
+    assert res.violations[0].kind == "deadlock"
+
+
+def test_scheduler_replay_is_deterministic():
+    """Same forced schedule, same step trace — the determinism
+    contract exploration is built on."""
+    first = run_once(_factory("circuit-breaker"), [])
+    sched = [s.chosen for s in first.steps]
+    second = run_once(_factory("circuit-breaker"), sched)
+    assert [s.chosen for s in second.steps] == sched
+    assert [s.op for s in second.steps] == [s.op for s in first.steps]
+
+
+def test_instrumented_patch_is_scoped():
+    """Outside the context manager, threading primitives are the real
+    stdlib ones — the patch may not leak into the host process (the
+    test suite itself uses threading heavily)."""
+    real_lock_cls = type(threading.Lock())
+    clock = scn_mod.Clock().freeze_at(scn_mod.EPOCH_NS)
+    sched = Scheduler(clock, max_steps=10)
+    with instrumented(sched):
+        assert type(threading.Lock()) is not real_lock_cls
+    assert type(threading.Lock()) is real_lock_cls

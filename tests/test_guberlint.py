@@ -1727,3 +1727,265 @@ def test_native_event_ring_clean_write_passes(tmp_path):
     }
     """
     assert nativecheck.check_files([_csrc(tmp_path, code)]) == []
+
+
+# --------------------------------------------------------------- proto
+
+
+def _proto_repo(tmp_path: Path) -> Path:
+    """A fixture repo where every REAL registered property is both
+    anchored (source annotation) and documented (RESILIENCE.md
+    marker): clean by construction, so each test seeds exactly one
+    drift."""
+    from tools.gubercheck import properties as props
+
+    root = tmp_path / "repo"
+    (root / "gubernator_tpu").mkdir(parents=True)
+    names = sorted(props.registry())
+    (root / "gubernator_tpu" / "mod.py").write_text(
+        "\n".join(f"# guberlint: invariant {n}" for n in names) + "\n"
+    )
+    (root / "RESILIENCE.md").write_text(
+        "\n".join(f"- gubercheck: `{n}` — checked" for n in names)
+        + "\n"
+    )
+    return root
+
+
+def test_proto_pass_synced_fixture_is_clean(tmp_path):
+    from tools.guberlint import protocheck
+
+    assert protocheck.check(_proto_repo(tmp_path)) == []
+
+
+def test_proto_pass_orphan_annotation(tmp_path):
+    """A source annotation naming an unregistered property claims
+    model-checked protection that does not exist."""
+    from tools.guberlint import protocheck
+
+    root = _proto_repo(tmp_path)
+    with (root / "gubernator_tpu" / "mod.py").open("a") as f:
+        f.write("# guberlint: invariant ghost-prop\n")
+    findings = protocheck.check(root)
+    assert [(f.rule, f.detail) for f in findings] == [
+        ("proto-orphan-annotation", "ghost-prop")
+    ]
+
+
+def test_proto_pass_orphan_annotation_suppression(tmp_path):
+    from tools.guberlint import protocheck
+
+    root = _proto_repo(tmp_path)
+    with (root / "gubernator_tpu" / "mod.py").open("a") as f:
+        # Trailing annotation on a code line so the same-line
+        # suppression targets it.
+        f.write(
+            "X = 1  # guberlint: invariant ghost-prop"
+            "  # guberlint: ok proto — registry lands next PR\n"
+        )
+    assert protocheck.check(root) == []
+
+
+def test_proto_pass_doc_marker_unregistered(tmp_path):
+    """RESILIENCE.md promising a checked bound nothing checks."""
+    from tools.guberlint import protocheck
+
+    root = _proto_repo(tmp_path)
+    with (root / "RESILIENCE.md").open("a") as f:
+        f.write("- gubercheck: `ghost-bound` — totally checked\n")
+    findings = protocheck.check(root)
+    assert [(f.rule, f.detail, f.file) for f in findings] == [
+        ("proto-doc-unregistered", "ghost-bound", "RESILIENCE.md")
+    ]
+
+
+def test_proto_pass_registered_but_undocumented(tmp_path):
+    """Dropping one doc marker flags exactly that property."""
+    from tools.gubercheck import properties as props
+    from tools.guberlint import protocheck
+
+    root = _proto_repo(tmp_path)
+    victim = sorted(props.registry())[0]
+    doc = root / "RESILIENCE.md"
+    doc.write_text(
+        "\n".join(
+            ln for ln in doc.read_text().splitlines()
+            if f"`{victim}`" not in ln
+        ) + "\n"
+    )
+    findings = protocheck.check(root)
+    assert [(f.rule, f.detail) for f in findings] == [
+        ("proto-invariant-undocumented", victim)
+    ]
+
+
+def test_proto_pass_registered_but_unanchored(tmp_path):
+    """Dropping one source annotation flags exactly that property —
+    a registry row with no protected site is drift."""
+    from tools.gubercheck import properties as props
+    from tools.guberlint import protocheck
+
+    root = _proto_repo(tmp_path)
+    victim = sorted(props.registry())[-1]
+    mod = root / "gubernator_tpu" / "mod.py"
+    mod.write_text(
+        "\n".join(
+            ln for ln in mod.read_text().splitlines()
+            if not ln.endswith(f" {victim}")
+        ) + "\n"
+    )
+    findings = protocheck.check(root)
+    assert [(f.rule, f.detail) for f in findings] == [
+        ("proto-property-unanchored", victim)
+    ]
+
+
+def test_proto_registry_rows_match_scenario_claims():
+    """Every property a scenario claims to check is registered, and
+    every registered property is claimed by at least one scenario —
+    the registry carries no dead rows the model checker never
+    exercises."""
+    from tools.gubercheck import properties as props
+    from tools.gubercheck import scenarios as scn_mod
+
+    registered = set(props.registry())
+    claimed = set()
+    for name in scn_mod.scenario_names():
+        cls = scn_mod.get_scenario(name)
+        for p in cls.properties:
+            assert p in registered, f"{name} claims unregistered {p}"
+            claimed.add(p)
+    assert claimed == registered, (
+        f"registered but never checked by any scenario: "
+        f"{sorted(registered - claimed)}"
+    )
+
+
+# ---------------------------------------------- stale suppressions
+
+
+def _tracker(declared, hits=()):
+    from tools.guberlint.common import SuppressionTracker
+
+    t = SuppressionTracker()
+    for rel, line, pass_name in declared:
+        t.declared.setdefault(rel, {}).setdefault(line, set()).add(
+            pass_name
+        )
+    for rel, line, pass_name in hits:
+        t.hits.setdefault(rel, set()).add((line, pass_name))
+    return t
+
+
+def test_stale_suppression_detected():
+    t = _tracker([("gubernator_tpu/x.py", 10, "lock")])
+    findings = baseline_mod.stale_suppressions(t, ())
+    assert [(f.rule, f.file, f.line) for f in findings] == [
+        ("stale-suppression", "gubernator_tpu/x.py", 10)
+    ]
+
+
+def test_hit_suppression_is_not_stale():
+    t = _tracker(
+        [("gubernator_tpu/x.py", 10, "lock")],
+        hits=[("gubernator_tpu/x.py", 10, "lock")],
+    )
+    assert baseline_mod.stale_suppressions(t, ()) == []
+
+
+def test_native_and_contract_suppressions_exempt():
+    """The C-side passes don't consult SourceFile.suppressed(), so
+    their suppressions never register hits — they must not be
+    reported stale."""
+    t = _tracker(
+        [
+            ("gubernator_tpu/x.py", 3, "native"),
+            ("gubernator_tpu/x.py", 4, "contract"),
+        ]
+    )
+    assert baseline_mod.stale_suppressions(t, ()) == []
+
+
+def test_trace_suppression_outside_scope_exempt():
+    """trace only runs on TRACE_SCOPES files; elsewhere an unhit
+    trace suppression proves nothing."""
+    t = _tracker([("gubernator_tpu/cluster/x.py", 7, "trace")])
+    scopes = ("gubernator_tpu/models/",)
+    assert baseline_mod.stale_suppressions(t, scopes) == []
+    t2 = _tracker([("gubernator_tpu/models/x.py", 7, "trace")])
+    findings = baseline_mod.stale_suppressions(t2, scopes)
+    assert [f.rule for f in findings] == ["stale-suppression"]
+
+
+def test_live_tracker_records_declarations_and_hits(tmp_path):
+    """End-to-end through SourceFile: declaring a suppression under an
+    active tracker records it; an imminent-finding consult records a
+    hit; stale detection then distinguishes the two."""
+    from tools.guberlint.common import SuppressionTracker
+
+    code = textwrap.dedent(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guberlint: guarded-by _lock
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                return self._n  # guberlint: ok lock — racy read is fine here
+        """
+    )
+    with SuppressionTracker() as t:
+        src = _src(tmp_path, code, "y.py")
+        findings, _ = _lock_findings(src)
+        assert findings == []
+        stale = baseline_mod.stale_suppressions(t, ())
+    assert src.rel in t.declared
+    assert t.hits.get(src.rel), "the consulted suppression must hit"
+    assert stale == [], "a hit suppression is not stale"
+
+
+# ------------------------------------------------------- incremental
+
+
+def test_changed_flag_rejects_explicit_paths():
+    from tools.guberlint.__main__ import main
+
+    assert main(["--changed", "gubernator_tpu/clock.py"]) == 2
+
+
+def test_changed_lint_paths_filters_to_lint_roots():
+    """Whatever git reports, the result only ever contains existing
+    .py files under LINT_ROOTS minus EXCLUDE (or None when git can't
+    answer — never a silently-empty list standing in for 'clean')."""
+    from tools.guberlint.__main__ import changed_lint_paths
+    from tools.guberlint.config import EXCLUDE, LINT_ROOTS
+
+    paths = changed_lint_paths()
+    if paths is None:
+        pytest.skip("not a usable git checkout")
+    for p in paths:
+        rel = p.relative_to(
+            Path(__file__).resolve().parents[1]
+        ).as_posix()
+        assert rel.endswith(".py")
+        assert any(
+            rel == r or rel.startswith(r.rstrip("/") + "/")
+            for r in LINT_ROOTS
+        )
+        assert not any(rel.startswith(e) for e in EXCLUDE)
+        assert p.exists()
+
+
+def test_changed_mode_runs_clean_on_this_checkout():
+    """`--changed` end-to-end: the current working tree's changed
+    files (possibly none) lint clean — same acceptance bar as the
+    full run, a fraction of the work."""
+    from tools.guberlint.__main__ import main
+
+    assert main(["--changed"]) == 0

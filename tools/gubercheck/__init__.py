@@ -1,0 +1,22 @@
+"""gubercheck: deterministic-schedule model checking of the repo's
+concurrency protocols.
+
+The package splits along an import-weight boundary:
+
+- ``properties``  — the invariant registry + pure predicates.  Stdlib
+  only: guberlint's ``proto`` pass imports it to cross-check doc
+  claims and source annotations without dragging numpy/jax into the
+  linter.
+- ``sched``       — the cooperative scheduler (instrumented
+  ``threading`` primitives + the repo's frozen ``Clock``).
+- ``explore``     — stateless DFS over schedules with conflict-
+  directed pruning and a CHESS-style preemption bound.
+- ``scenarios``   — the scenario catalog: small fixed workloads over
+  the REAL protocol modules (ledger, health, membership, replication,
+  multiregion).
+- ``mutations``   — mechanical re-introduction of shipped-then-fixed
+  bugs, used to prove the checker has teeth.
+
+Keep this module empty of heavy imports: ``import tools.gubercheck``
+must stay cheap (the linter does it on every run).
+"""

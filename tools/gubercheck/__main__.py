@@ -1,0 +1,220 @@
+"""CLI for the gubercheck model checker.
+
+Usage:
+
+    python -m tools.gubercheck --list
+    python -m tools.gubercheck --scenario ledger-renewal [--mode full]
+    python -m tools.gubercheck --mutation pr4-duration-renewal-guard
+    python -m tools.gubercheck --smoke [--budget 30]
+    python -m tools.gubercheck --all            # full @slow budgets
+
+Exit codes: 0 = all explorations behaved as expected (clean scenarios
+clean, mutations caught); 1 = a violation on pristine code OR a
+mutation that exploration failed to catch; 2 = usage error.
+
+``--smoke`` is the ci_fast stage: every scenario under its committed
+smoke budget (DPOR + preemption bound 2) plus both mutation fixtures,
+all inside one enforced wall budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time as _walltime
+
+# Scenario runs intentionally exercise failure paths thousands of
+# times; the protocol modules' warnings are noise here.
+logging.getLogger("gubernator_tpu").setLevel(logging.CRITICAL)
+
+
+def _explore_scenario(name, *, mode, preemption_bound, max_runs,
+                      max_steps, wall_budget_s, expect_violation=False,
+                      factory=None):
+    from tools.gubercheck import scenarios as scn_mod
+    from tools.gubercheck.explore import explore
+
+    cls = scn_mod.get_scenario(name)
+    res = explore(
+        factory or cls,
+        mode=mode,
+        preemption_bound=preemption_bound,
+        max_runs=max_runs,
+        max_steps=max_steps,
+        wall_budget_s=wall_budget_s,
+        stop_on_violation=True,
+        scenario_name=name,
+    )
+    return res
+
+
+def _report(res, *, expect_violation, label=None):
+    tag = label or res.scenario
+    if res.complete:
+        state = "complete"
+    elif res.truncated_by:
+        state = f"truncated:{res.truncated_by}"
+    else:
+        state = "stopped"  # stop_on_violation exit
+    if expect_violation:
+        ok = bool(res.violations)
+        verdict = "CAUGHT" if ok else "MISSED"
+    else:
+        ok = res.ok
+        verdict = "clean" if ok else "VIOLATION"
+    print(
+        f"[gubercheck] {tag:38s} {verdict:9s} runs={res.runs:<6d} "
+        f"max_steps={res.max_steps_seen:<4d} {state} "
+        f"({res.elapsed_s:.2f}s)"
+    )
+    for v in res.violations:
+        print(f"    {v.kind} {v.prop or ''}: {v.detail}")
+        print(f"    schedule[{v.step}]: {' '.join(v.schedule)}")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.gubercheck")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios, properties, mutations")
+    ap.add_argument("--scenario", help="explore one scenario")
+    ap.add_argument("--mutation", help="explore one mutation fixture "
+                    "(exit 0 iff the bug is caught)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="ci_fast stage: smoke budgets + mutations")
+    ap.add_argument("--all", action="store_true",
+                    help="full budgets for every scenario + mutations")
+    ap.add_argument("--mode", choices=("dpor", "full"), default=None)
+    ap.add_argument("--preemption-bound", type=int, default=None)
+    ap.add_argument("--max-runs", type=int, default=None)
+    ap.add_argument("--max-steps", type=int, default=None)
+    ap.add_argument("--budget", type=float, default=None,
+                    help="overall wall budget in seconds")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from tools.gubercheck import mutations as mut_mod
+        from tools.gubercheck import properties as prop_mod
+        from tools.gubercheck import scenarios as scn_mod
+
+        print("scenarios:")
+        for name in scn_mod.scenario_names():
+            cls = scn_mod.get_scenario(name)
+            print(f"  {name:26s} {cls.summary}")
+            print(f"  {'':26s}   properties: "
+                  f"{', '.join(cls.properties)}")
+        print("properties:")
+        for pname in prop_mod.names():
+            p = prop_mod.get(pname)
+            print(f"  {pname:26s} [{p.doc}] {p.summary}")
+        print("mutations:")
+        for mname, m in mut_mod.MUTATIONS.items():
+            print(f"  {mname:34s} scenario={m.scenario} "
+                  f"expects={','.join(m.properties)}")
+        return 0
+
+    if args.scenario:
+        from tools.gubercheck import scenarios as scn_mod
+
+        cls = scn_mod.get_scenario(args.scenario)
+        budget = dict(cls.full)
+        if args.mode:
+            budget["mode"] = args.mode
+        if args.preemption_bound is not None:
+            budget["preemption_bound"] = args.preemption_bound
+        if args.max_runs is not None:
+            budget["max_runs"] = args.max_runs
+        if args.max_steps is not None:
+            budget["max_steps"] = args.max_steps
+        res = _explore_scenario(
+            args.scenario,
+            mode=budget.get("mode", "dpor"),
+            preemption_bound=budget.get("preemption_bound"),
+            max_runs=budget.get("max_runs", 20000),
+            max_steps=budget.get("max_steps", 2000),
+            wall_budget_s=args.budget,
+        )
+        return 0 if _report(res, expect_violation=False) else 1
+
+    if args.mutation:
+        from tools.gubercheck import mutations as mut_mod
+        from tools.gubercheck import scenarios as scn_mod
+
+        mut = mut_mod.MUTATIONS[args.mutation]
+        cls = scn_mod.get_scenario(mut.scenario)
+        budget = dict(cls.full)
+        if args.mode:
+            budget["mode"] = args.mode
+        res = _explore_scenario(
+            mut.scenario,
+            mode=budget.get("mode", "dpor"),
+            preemption_bound=args.preemption_bound
+            if args.preemption_bound is not None
+            else budget.get("preemption_bound"),
+            max_runs=args.max_runs or budget.get("max_runs", 20000),
+            max_steps=args.max_steps or budget.get("max_steps", 2000),
+            wall_budget_s=args.budget,
+            factory=mut_mod.mutated_scenario_factory(args.mutation),
+        )
+        ok = _report(res, expect_violation=True,
+                     label=f"{mut.scenario}[{args.mutation}]")
+        return 0 if ok else 1
+
+    if args.smoke or args.all:
+        from tools.gubercheck import mutations as mut_mod
+        from tools.gubercheck import scenarios as scn_mod
+
+        overall = args.budget if args.budget is not None else (
+            30.0 if args.smoke else None
+        )
+        t0 = _walltime.monotonic()
+
+        def left():
+            if overall is None:
+                return None
+            return max(0.5, overall - (_walltime.monotonic() - t0))
+
+        all_ok = True
+        for name in scn_mod.scenario_names():
+            cls = scn_mod.get_scenario(name)
+            budget = dict(cls.smoke if args.smoke else cls.full)
+            res = _explore_scenario(
+                name,
+                mode=budget.get("mode", "dpor"),
+                preemption_bound=budget.get("preemption_bound"),
+                max_runs=budget.get("max_runs", 20000),
+                max_steps=budget.get("max_steps", 2000),
+                wall_budget_s=left(),
+            )
+            all_ok = _report(res, expect_violation=False) and all_ok
+        for mname, mut in mut_mod.MUTATIONS.items():
+            cls = scn_mod.get_scenario(mut.scenario)
+            budget = dict(cls.smoke if args.smoke else cls.full)
+            res = _explore_scenario(
+                mut.scenario,
+                mode=budget.get("mode", "dpor"),
+                preemption_bound=budget.get("preemption_bound"),
+                max_runs=budget.get("max_runs", 20000),
+                max_steps=budget.get("max_steps", 2000),
+                wall_budget_s=left(),
+                factory=mut_mod.mutated_scenario_factory(mname),
+            )
+            all_ok = _report(
+                res, expect_violation=True,
+                label=f"{mut.scenario}[{mname}]",
+            ) and all_ok
+        elapsed = _walltime.monotonic() - t0
+        print(f"[gubercheck] total {elapsed:.1f}s"
+              + (f" (budget {overall:.0f}s)" if overall else ""))
+        if overall is not None and elapsed > overall:
+            print("[gubercheck] WALL BUDGET EXCEEDED", file=sys.stderr)
+            all_ok = False
+        return 0 if all_ok else 1
+
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Stateless schedule exploration: DFS over controller choice points.
+
+Each run re-executes the scenario from scratch (fresh objects, frozen
+clock re-seeded) following a *forced prefix* of task choices, then the
+deterministic default continuation (stay on the current task while
+enabled — minimizes preemptions).  After a run, every step at or past
+the prefix length becomes a backtrack point: each enabled-but-not-
+chosen task yields a new prefix to explore.  Schedules are uniquely
+determined by their choice sequence, so the DFS enumerates each
+maximal schedule at most once.
+
+Modes:
+
+- ``full``  — every alternative at every step.  Ground truth; the
+  budget ceiling for the @slow suite.
+- ``dpor``  — conflict-directed pruning (dynamic partial-order
+  reduction, conservative approximation): an alternative task is
+  explored at step i only if its pending operation CONFLICTS with
+  some operation another task executes at step >= i in the observed
+  run.  Independent (never-conflicting) ops commute — running the
+  alternative earlier reaches a state the observed run also reaches,
+  so the alternative schedule is redundant.  Conflict = same resource
+  (lock / event / condition), or anything against a clock tick
+  (sched.Op.conflicts).  tests/test_gubercheck.py cross-validates
+  dpor against full on the mutation scenarios.
+
+Preemption bound (CHESS): a *preemption* is choosing away from a task
+that is still enabled.  ``preemption_bound=N`` skips alternatives
+whose prefix would exceed N preemptions — the polynomial smoke budget
+for ci_fast; most shipped concurrency bugs reproduce within 2
+(Musuvathi & Qadeer, PLDI'07).
+"""
+
+from __future__ import annotations
+
+import time as _walltime
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from tools.gubercheck.properties import PropertyViolation
+from tools.gubercheck.sched import (
+    DeadlockError,
+    DivergenceError,
+    StepRecord,
+)
+
+
+@dataclass
+class Violation:
+    """One finding: which property (or structural failure), on which
+    schedule."""
+
+    kind: str  # "property" | "deadlock" | "task-exception" | "divergence"
+    prop: Optional[str]
+    detail: str
+    schedule: List[str]
+    step: int
+
+
+@dataclass
+class RunResult:
+    steps: List[StepRecord]
+    violation: Optional[Violation]
+
+
+@dataclass
+class ExplorationResult:
+    scenario: str
+    mode: str
+    runs: int = 0
+    max_steps_seen: int = 0
+    violations: List[Violation] = field(default_factory=list)
+    complete: bool = False  # every reachable schedule (mode-reduced) visited
+    truncated_by: Optional[str] = None  # "max_runs" | "wall_budget"
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class _Prefix:
+    __slots__ = ("schedule", "preemptions")
+
+    def __init__(self, schedule: List[str], preemptions: int):
+        self.schedule = schedule
+        self.preemptions = preemptions
+
+
+def run_once(
+    scenario_factory: Callable[[], "object"],
+    forced: List[str],
+    max_steps: int = 2000,
+) -> RunResult:
+    """Execute one schedule.  The factory builds a fresh scenario; the
+    scenario object drives setup/tasks/check/finish (see scenarios.py
+    Scenario protocol)."""
+    scn = scenario_factory()
+    try:
+        steps = scn.run(forced, max_steps=max_steps)
+    except PropertyViolation as e:
+        return RunResult(scn.trace(), Violation(
+            "property", e.prop, e.detail,
+            [s.chosen for s in scn.trace()], len(scn.trace()),
+        ))
+    except DeadlockError as e:
+        return RunResult(scn.trace(), Violation(
+            "deadlock", None, str(e),
+            [s.chosen for s in scn.trace()], len(scn.trace()),
+        ))
+    except DivergenceError:
+        raise  # scenario not schedule-deterministic: a checker bug
+    task_exc = scn.task_exception()
+    if task_exc is not None:
+        name, exc = task_exc
+        return RunResult(steps, Violation(
+            "task-exception", None, f"task {name!r}: {exc!r}",
+            [s.chosen for s in steps], len(steps),
+        ))
+    return RunResult(steps, None)
+
+
+def _count_preemptions(steps: List[StepRecord], upto: int) -> int:
+    return sum(1 for s in steps[:upto] if s.preempting)
+
+
+def _op_conflicts(a, b) -> bool:
+    ak, ar = a
+    bk, br = b
+    if ak in ("start", "join") or bk in ("start", "join"):
+        return False  # pure control flow commutes with everything
+    return ar == "clock" or br == "clock" or ar == br
+
+
+def _conflicts_later(steps: List[StepRecord], i: int, alt: str) -> bool:
+    """DPOR race check: is scheduling ``alt`` at step i (instead of
+    the observed choice) potentially observable?  True iff the op
+    EXECUTED at step i conflicts with anything ``alt`` is observed to
+    do from step i onward — its pending op, or any op it executes
+    later in this run.  (The pending op alone is not enough: a task
+    that has not started yet pends on ``start``, which commutes with
+    everything, yet its post-start ops may race with the op executed
+    here.  Races seeded by later steps are covered when the backtrack
+    loop reaches those i values.)"""
+    executed = steps[i].op
+    fut = steps[i].pending.get(alt)
+    if fut is None:
+        return True  # defensive: unknown pending — do not prune
+    if _op_conflicts(executed, fut):
+        return True
+    for s in steps[i + 1:]:
+        if s.chosen == alt and _op_conflicts(executed, s.op):
+            return True
+    return False
+
+
+def explore(
+    scenario_factory: Callable[[], "object"],
+    *,
+    mode: str = "dpor",
+    preemption_bound: Optional[int] = None,
+    max_runs: int = 20000,
+    max_steps: int = 2000,
+    wall_budget_s: Optional[float] = None,
+    stop_on_violation: bool = True,
+    scenario_name: str = "?",
+) -> ExplorationResult:
+    """Enumerate schedules of one scenario.  Returns the aggregate;
+    ``complete`` is True only when the DFS drained with no budget
+    truncation."""
+    if mode not in ("full", "dpor"):
+        raise ValueError(f"unknown mode {mode!r}")
+    res = ExplorationResult(scenario=scenario_name, mode=mode)
+    t0 = _walltime.monotonic()
+    stack: List[_Prefix] = [_Prefix([], 0)]
+    while stack:
+        if res.runs >= max_runs:
+            res.truncated_by = "max_runs"
+            break
+        if (
+            wall_budget_s is not None
+            and _walltime.monotonic() - t0 > wall_budget_s
+        ):
+            res.truncated_by = "wall_budget"
+            break
+        prefix = stack.pop()
+        rr = run_once(scenario_factory, prefix.schedule, max_steps)
+        res.runs += 1
+        res.max_steps_seen = max(res.max_steps_seen, len(rr.steps))
+        if rr.violation is not None:
+            res.violations.append(rr.violation)
+            if stop_on_violation:
+                res.elapsed_s = _walltime.monotonic() - t0
+                return res
+        steps = rr.steps
+        # Backtrack points: alternatives at/after the prefix boundary.
+        # Reversed push order keeps the DFS depth-first left-to-right.
+        new_prefixes: List[_Prefix] = []
+        acc = _count_preemptions(steps, len(prefix.schedule))
+        for i in range(len(prefix.schedule), len(steps)):
+            s = steps[i]
+            prev = steps[i - 1].chosen if i > 0 else None
+            for alt in s.enabled:
+                if alt == s.chosen:
+                    continue
+                alt_preempts = acc + (
+                    1 if (prev is not None and prev != alt
+                          and prev in s.enabled) else 0
+                )
+                if (
+                    preemption_bound is not None
+                    and alt_preempts > preemption_bound
+                ):
+                    continue
+                if mode == "dpor" and not _conflicts_later(steps, i, alt):
+                    continue
+                new_prefixes.append(_Prefix(
+                    [st.chosen for st in steps[:i]] + [alt], alt_preempts,
+                ))
+            acc += 1 if s.preempting else 0
+        stack.extend(reversed(new_prefixes))
+    else:
+        res.complete = True
+    res.elapsed_s = _walltime.monotonic() - t0
+    return res

@@ -1,0 +1,119 @@
+"""Resurrected historical bugs: mutation fixtures that prove teeth.
+
+A model checker that never fails is indistinguishable from one that
+checks nothing.  Each mutation here textually disables ONE guard in a
+twin copy of ``gubernator_tpu/core/ledger.py`` — re-introducing a bug
+this repo actually shipped and later fixed — and names the scenario
+whose exploration must find a schedule that violates a registered
+property.  tests/test_gubercheck.py asserts both directions: the
+mutated module is caught, the pristine module explores clean.
+
+The mutation is applied to SOURCE TEXT and executed into a fresh
+module object (never installed in ``sys.modules``), so the real ledger
+in the running process is untouched.  Each needle is asserted to occur
+exactly once — if a refactor moves or rewords the guard, the mutation
+fails loudly instead of silently testing nothing.
+"""
+
+from __future__ import annotations
+
+import types
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+from gubernator_tpu.core import ledger as _real_ledger
+
+
+@dataclass(frozen=True)
+class Mutation:
+    """One resurrected bug."""
+
+    name: str
+    summary: str
+    needle: str  # exact guard text in ledger.py (must occur once)
+    replacement: str
+    scenario: str  # scenario whose exploration must catch it
+    properties: tuple  # property names expected to fire
+
+
+MUTATIONS: "OrderedDict[str, Mutation]" = OrderedDict()
+
+
+def _register(m: Mutation) -> None:
+    MUTATIONS[m.name] = m
+
+
+_register(Mutation(
+    name="pr13-lease-churn-return-guard",
+    summary=(
+        "Drop _learn's pending/returning guard (the PR 13 fix): a "
+        "concurrent learn may insert a pre-return (OVER, 0) snapshot "
+        "while a revoked lease's credit is queued or mid-apply, "
+        "starving the bucket behind a false sticky-OVER entry."
+    ),
+    needle="if h in self._pending or h in self._returning:",
+    replacement=(
+        "if False and (h in self._pending or h in self._returning):"
+    ),
+    scenario="ledger-lease-churn",
+    properties=("sticky-over-exact", "hot-key-no-starvation"),
+))
+
+_register(Mutation(
+    name="pr4-duration-renewal-guard",
+    summary=(
+        "Drop _learn's fall_dur_ok guard (the PR 4 fix): a duration "
+        "change renews the device bucket, so an (OVER, 0) response "
+        "observed across the renewal describes the PRE-renewal bucket "
+        "— inserting it pins OVER over a bucket whose stored "
+        "remaining just became `limit`."
+    ),
+    needle="if not plan.fall_dur_ok[j]:",
+    replacement="if False and not plan.fall_dur_ok[j]:",
+    scenario="ledger-renewal",
+    properties=("sticky-over-exact",),
+))
+
+
+def mutation_names():
+    return list(MUTATIONS)
+
+
+def build_mutated_ledger(name: str) -> types.ModuleType:
+    """Compile a twin ledger module with one guard disabled."""
+    mut = MUTATIONS[name]
+    path = _real_ledger.__file__
+    with open(path, "r") as fh:
+        src = fh.read()
+    n = src.count(mut.needle)
+    if n != 1:
+        raise RuntimeError(
+            f"mutation {name!r}: needle occurs {n} times in {path} "
+            "(expected exactly 1) — the guard moved; update the fixture"
+        )
+    src = src.replace(mut.needle, mut.replacement)
+    mod = types.ModuleType("gubernator_tpu.core.ledger")
+    mod.__file__ = path + f"  [mutated:{name}]"
+    code = compile(src, mod.__file__, "exec")
+    exec(code, mod.__dict__)
+    return mod
+
+
+def mutated_scenario_factory(name: str) -> Callable[[], object]:
+    """A scenario factory wired to the mutated ledger twin.  The twin
+    module is compiled once and shared across re-executions — module
+    code is immutable; all mutable state lives in per-run objects."""
+    from tools.gubercheck import scenarios as _scn
+
+    mut = MUTATIONS[name]
+    cls = _scn.get_scenario(mut.scenario)
+    mod = build_mutated_ledger(name)
+
+    def factory():
+        scn = cls()
+        scn.ledger_mod = mod
+        return scn
+
+    factory.__name__ = f"mutated_{name.replace('-', '_')}"
+    return factory

@@ -1,0 +1,260 @@
+"""The invariant registry: every protocol claim gets a named property.
+
+This module is the three-way anchor that guberlint's ``proto`` pass
+(pass 8) cross-checks:
+
+- RESILIENCE.md states a bound   → it must carry a ``gubercheck:
+  `name` `` marker naming a property registered here;
+- source code marks the site     → ``# guberlint: invariant <name>``
+  must name a property registered here;
+- a property registered here     → must be documented AND anchored in
+  source (no dead registry rows).
+
+IMPORT-WEIGHT CONTRACT: stdlib only.  The linter imports this module
+on every run; pulling numpy/jax (or any gubernator_tpu module) in
+here would tax every lint invocation and break minimal environments.
+The predicates therefore take plain data extracted by scenarios.py,
+never live protocol objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+
+class PropertyViolation(AssertionError):
+    """An invariant failed at a schedule step.  Carries the property
+    name so the explorer can attribute the finding."""
+
+    def __init__(self, prop: str, detail: str):
+        super().__init__(f"{prop}: {detail}")
+        self.prop = prop
+        self.detail = detail
+
+
+@dataclass(frozen=True)
+class Property:
+    """One registered invariant."""
+
+    name: str
+    summary: str
+    doc: str  # where RESILIENCE.md states the bound (section ref)
+
+
+_REGISTRY: Dict[str, Property] = {}
+
+
+def register(name: str, summary: str, doc: str) -> Property:
+    p = Property(name, summary, doc)
+    _REGISTRY[name] = p
+    return p
+
+
+def get(name: str) -> Property:
+    return _REGISTRY[name]
+
+
+def names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def registry() -> Dict[str, Property]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------
+# The catalog.  Keep names kebab-case; they appear verbatim in
+# RESILIENCE.md §13, in `# guberlint: invariant <name>` annotations,
+# and in scenario `properties` tuples.
+
+register(
+    "sticky-over-exact",
+    "A ledger OVER entry is exact: whenever the ledger answers OVER "
+    "from a cached entry, the device bucket's stored remaining is 0 "
+    "(the entry was inserted from a post-settle snapshot, not a "
+    "pre-return or pre-renewal one).",
+    "RESILIENCE.md §13",
+)
+register(
+    "hot-key-no-starvation",
+    "After leases settle, a probe through the ledger answers exactly "
+    "what the sequential spec answers — returned credit is servable, "
+    "never stranded behind a stale sticky-OVER entry.",
+    "RESILIENCE.md §13",
+)
+register(
+    "over-admission-bound",
+    "Admitted hits for one key in one bucket window never exceed the "
+    "window limit on a single node (pre-debited lease credit cannot "
+    "over-admit); across a partitioned cluster the bound relaxes to "
+    "N_partitions x limit.",
+    "RESILIENCE.md §3",
+)
+register(
+    "lease-single-tier",
+    "A key's drainable lease credit lives in exactly one tier: the "
+    "Python ledger entry or the native plane's table, never both "
+    "(delegation hands off; pull linearizes before the next drain).",
+    "RESILIENCE.md §13",
+)
+register(
+    "epoch-monotonic-commit",
+    "Membership epochs commit in strictly increasing order; a "
+    "superseded transition never commits after its successor.",
+    "RESILIENCE.md §10",
+)
+register(
+    "dual-window-no-third-owner",
+    "During a dual-ring handoff window every key routes to its old "
+    "owner or its new owner — never to a third node.",
+    "RESILIENCE.md §10",
+)
+register(
+    "region-no-double-send",
+    "Requeue-and-converge never double-sends: the hits delivered to a "
+    "region never exceed the hits offered to it (a delivered batch is "
+    "not requeued; a requeued batch was not delivered).",
+    "RESILIENCE.md §12",
+)
+register(
+    "circuit-legal-transitions",
+    "Peer circuit breakers move only along the documented transition "
+    "table (healthy->suspect->broken->half-open->{healthy,broken}, "
+    "plus the racing-success broken->healthy edge).",
+    "RESILIENCE.md §1",
+)
+
+
+# ---------------------------------------------------------------------
+# Predicates.  Pure functions over plain data; raise PropertyViolation
+# with the registered name on failure.  scenarios.py extracts the data
+# from live protocol objects at quiescent points.
+
+
+def check_sticky_over_exact(
+    entries: Iterable[Tuple[bytes, int, bool]],
+) -> None:
+    """entries: (key, device_remaining, device_live) for every ledger
+    OVER entry whose recorded reset has not passed."""
+    for key, remaining, live in entries:
+        if live and remaining != 0:
+            raise PropertyViolation(
+                "sticky-over-exact",
+                f"ledger answers OVER for {key!r} while the device "
+                f"bucket holds remaining={remaining}",
+            )
+
+
+def check_probe_conformance(
+    key: bytes,
+    ledger_answer: Tuple[int, int],
+    spec_answer: Tuple[int, int],
+) -> None:
+    """(status, remaining) of a terminal hits=0 probe served through
+    the ledger vs the same probe against the spec state directly."""
+    if ledger_answer != spec_answer:
+        raise PropertyViolation(
+            "hot-key-no-starvation",
+            f"terminal probe of {key!r} diverges: ledger answers "
+            f"{ledger_answer}, spec answers {spec_answer}",
+        )
+
+
+def check_over_admission(
+    key: bytes, admitted: int, limit: int, n_nodes: int = 1
+) -> None:
+    """admitted: total hits answered UNDER for ``key`` inside one
+    bucket window (status-based counting under-counts the sticky
+    consume-while-OVER quirk, which only weakens the check — it can
+    never mask a true over-admission)."""
+    bound = n_nodes * limit
+    if admitted > bound:
+        raise PropertyViolation(
+            "over-admission-bound",
+            f"{key!r}: admitted {admitted} > {n_nodes}x{limit}",
+        )
+
+
+def check_lease_single_tier(
+    entries: Iterable[Tuple[bytes, str, bool]],
+) -> None:
+    """entries: (key, tier, plane_holds_lease) where tier is the
+    ledger entry kind name ('lease'|'native'|'over'|'counter')."""
+    for key, tier, in_plane in entries:
+        if tier == "lease" and in_plane:
+            raise PropertyViolation(
+                "lease-single-tier",
+                f"{key!r} drainable in BOTH tiers (python lease + "
+                "native plane entry)",
+            )
+        if tier == "native" and not in_plane:
+            raise PropertyViolation(
+                "lease-single-tier",
+                f"{key!r} marked delegated but the plane has no entry "
+                "(credit lives in NO tier)",
+            )
+
+
+def check_epoch_monotonic(commits: Sequence[int]) -> None:
+    """commits: epoch numbers in the order they committed."""
+    for a, b in zip(commits, commits[1:]):
+        if b <= a:
+            raise PropertyViolation(
+                "epoch-monotonic-commit",
+                f"epoch {b} committed after epoch {a}",
+            )
+
+
+def check_dual_window_routing(
+    routes: Iterable[Tuple[bytes, str, Tuple[str, str]]],
+) -> None:
+    """routes: (key, routed_addr, (old_owner, new_owner))."""
+    for key, addr, owners in routes:
+        if addr not in owners:
+            raise PropertyViolation(
+                "dual-window-no-third-owner",
+                f"{key!r} routed to {addr} outside the dual window "
+                f"owners {owners}",
+            )
+
+
+def check_region_no_double_send(
+    offered: Dict[Tuple[str, bytes], int],
+    delivered: Dict[Tuple[str, bytes], int],
+) -> None:
+    """Per (region, key): hits delivered must never exceed hits
+    offered — requeue-and-converge re-sends only what never landed."""
+    for rk, got in delivered.items():
+        if got > offered.get(rk, 0):
+            raise PropertyViolation(
+                "region-no-double-send",
+                f"region/key {rk}: delivered {got} > offered "
+                f"{offered.get(rk, 0)}",
+            )
+
+
+#: The legal circuit-breaker edges (RESILIENCE.md §1).  Self-loops are
+#: absorbed inside PeerHealth._to (no transition recorded), so every
+#: recorded edge must appear here.
+CIRCUIT_LEGAL_EDGES = frozenset({
+    ("healthy", "suspect"),      # first failure
+    ("suspect", "healthy"),      # success before threshold
+    ("suspect", "broken"),       # threshold failures
+    ("broken", "half-open"),     # open period expired, probe slot won
+    ("half-open", "healthy"),    # probe succeeded
+    ("half-open", "broken"),     # probe failed (period doubles)
+    ("broken", "healthy"),       # racing in-flight success
+})
+
+
+def check_circuit_transitions(
+    edges: Iterable[Tuple[str, str]],
+) -> None:
+    """edges: observed (from_state, to_state) transitions."""
+    for edge in edges:
+        if edge not in CIRCUIT_LEGAL_EDGES:
+            raise PropertyViolation(
+                "circuit-legal-transitions",
+                f"illegal circuit transition {edge[0]} -> {edge[1]}",
+            )

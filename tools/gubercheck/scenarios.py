@@ -1,0 +1,905 @@
+"""The scenario catalog: small fixed workloads over the REAL protocol
+modules, explored by explore.py.
+
+Each scenario is a deterministic world: a frozen Clock, a handful of
+named tasks (at most 4 — the state-space budget ISSUE 18 commits to),
+and the repo's actual protocol objects constructed under the
+instrumented ``threading`` patch so every lock acquire is a schedule
+choice point.  ``check()`` runs at every quiescent controller step
+(no managed task holds an instrumented lock), ``finish()`` runs after
+all tasks complete — both raise
+``properties.PropertyViolation`` on an invariant break.
+
+What is real and what is stubbed:
+
+- REAL: ``core/ledger.py`` (plan/learn/settle/revoke — the full
+  serve partition), ``cluster/health.py`` PeerHealth,
+  ``cluster/membership.py`` apply_view/transition/commit (including
+  its real per-epoch transition threads), ``cluster/replication.py``
+  receive/install/try_answer/expire, ``cluster/multiregion.py``
+  _push_region/_requeue_region (the requeue-and-converge core).
+- STUBBED: the decision ENGINE is ``SpecEngine`` — the sequential
+  scalar spec (models/spec.py) applied row-by-row under one lock.
+  This keeps jax off the hot path (a gubercheck run re-executes the
+  scenario thousands of times) and makes the device tier itself an
+  oracle: the ledger's cached answers are checked against exactly the
+  state a spec-conformant device holds.  Transports (peer RPC, the
+  native C plane, the interval batcher) are in-memory fakes with the
+  same contracts the protocol code drives.
+
+Scenario determinism contract: given the same forced schedule prefix,
+a scenario must make identical choices (explore.py raises
+DivergenceError otherwise).  No wall clock, no randomness that feeds
+a branch, dict iteration in insertion order only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import OrderedDict
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.clock import Clock
+from gubernator_tpu.hashing import fnv1a_64
+from gubernator_tpu.models.spec import SpecInput, apply_spec
+from gubernator_tpu.types import Algorithm, PeerInfo, Status
+
+from tools.gubercheck import properties as props
+from tools.gubercheck.properties import PropertyViolation
+from tools.gubercheck.sched import Scheduler, instrumented, virtual_time
+
+# A fixed virtual epoch: every run of every scenario starts at the
+# same instant, so TTL/expiry arithmetic is identical run to run.
+EPOCH_NS = 1_700_000_000_000_000_000
+
+_TOKEN = int(Algorithm.TOKEN_BUCKET)
+_UNDER = int(Status.UNDER_LIMIT)
+_OVER = int(Status.OVER_LIMIT)
+
+# Ledger entry kinds (core/ledger.py) — read-only mirror for the
+# invariant extractors.
+_K_COUNTER, _K_OVER, _K_LEASE, _K_NATIVE = 0, 1, 2, 3
+_KIND_NAME = {0: "counter", 1: "over", 2: "lease", 3: "native"}
+
+
+# ---------------------------------------------------------------------
+# The spec-backed engine stub.
+
+
+class _Packed:
+    """Duck-typed PackedKeys (avoids importing core.engine → jax)."""
+
+    __slots__ = ("key_buf", "key_offsets", "n")
+
+    def __init__(self, key_buf, key_offsets, n):
+        self.key_buf = key_buf
+        self.key_offsets = key_offsets
+        self.n = n
+
+
+class SpecEngine:
+    """Sequential-spec device tier with the engine's columnar calling
+    convention.  One lock around the whole apply: the real engine's
+    batch apply is atomic w.r.t. other batches, and modeling it as
+    one critical section keeps the schedule space honest."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.states: Dict[bytes, object] = {}
+        self._lock = None  # created in bind() under instrumentation
+
+    def bind_lock(self, lock) -> None:
+        self._lock = lock
+
+    def _keys(self, keys) -> List[bytes]:
+        if hasattr(keys, "key_buf"):
+            buf = bytes(bytearray(np.asarray(keys.key_buf, dtype=np.uint8)))
+            off = [int(o) for o in keys.key_offsets]
+            return [buf[off[i]:off[i + 1]] for i in range(int(keys.n))]
+        return [bytes(k) for k in keys]
+
+    def apply_columnar(
+        self, keys, algo, behavior, hits, limit, duration, burst,
+        now_ms=None, count_decisions=True,
+    ):
+        kl = self._keys(keys)
+        now = int(now_ms) if now_ms is not None else self.clock.now_ms()
+        st_o: List[int] = []
+        lim_o: List[int] = []
+        rem_o: List[int] = []
+        rst_o: List[int] = []
+        with self._lock:
+            for i, k in enumerate(kl):
+                inp = SpecInput(
+                    hits=int(hits[i]), limit=int(limit[i]),
+                    duration=int(duration[i]), burst=int(burst[i]),
+                    algorithm=int(algo[i]), behavior=int(behavior[i]),
+                )
+                new_state, resp = apply_spec(self.states.get(k), inp, now)
+                if new_state is None:
+                    self.states.pop(k, None)
+                else:
+                    self.states[k] = new_state
+                st_o.append(int(resp.status))
+                lim_o.append(int(resp.limit))
+                rem_o.append(int(resp.remaining))
+                rst_o.append(int(resp.reset_time))
+        return (
+            np.asarray(st_o, np.int32), np.asarray(lim_o, np.int64),
+            np.asarray(rem_o, np.int64), np.asarray(rst_o, np.int64),
+        )
+
+    def spec_probe(self, key: bytes, limit: int, duration: int,
+                   burst: int, now: int) -> Tuple[int, int]:
+        """(status, remaining) a hits=0 query would answer right now —
+        computed on a COPY of the state, no mutation."""
+        state = self.states.get(key)
+        if state is not None:
+            state = dataclasses.replace(state)
+        inp = SpecInput(
+            hits=0, limit=limit, duration=duration, burst=burst,
+            algorithm=_TOKEN, behavior=0,
+        )
+        _, resp = apply_spec(state, inp, now)
+        return int(resp.status), int(resp.remaining)
+
+
+def _make_dec(rows):
+    """rows: (key, algo, behavior, hits, limit, duration, burst) —
+    the DecodedBatch shape ledger.plan consumes."""
+    d = SimpleNamespace()
+    keys = [r[0] for r in rows]
+    d.n = len(rows)
+    d.key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    off = np.zeros(len(rows) + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=off[1:])
+    d.key_offsets = off
+    d.algo = np.asarray([r[1] for r in rows], np.int32)
+    d.behavior = np.asarray([r[2] for r in rows], np.int32)
+    d.hits = np.asarray([r[3] for r in rows], np.int64)
+    d.limit = np.asarray([r[4] for r in rows], np.int64)
+    d.duration = np.asarray([r[5] for r in rows], np.int64)
+    d.burst = np.asarray([r[6] for r in rows], np.int64)
+    d.fnv1a = np.asarray([fnv1a_64(k) for k in keys], np.uint64)
+    return d
+
+
+# ---------------------------------------------------------------------
+# Scenario protocol.
+
+
+class Scenario:
+    """Base: one fresh world per run (see explore.run_once)."""
+
+    name = "?"
+    summary = ""
+    #: property names this scenario checks (must all be registered).
+    properties: Tuple[str, ...] = ()
+    #: module paths whose ``time`` attribute reads the frozen Clock.
+    time_modules: Tuple[str, ...] = ()
+    #: ci_fast smoke budget (CHESS-bounded).
+    smoke = dict(mode="dpor", preemption_bound=2, max_runs=2000,
+                 max_steps=400)
+    #: committed full-exploration budget (@slow + tests assert
+    #: ``complete`` under it).
+    full = dict(mode="dpor", max_runs=60000, max_steps=400)
+
+    def __init__(self):
+        self.clock = Clock().freeze_at(EPOCH_NS)
+        self.sched: Optional[Scheduler] = None
+
+    # -- hooks ---------------------------------------------------------
+
+    def build(self, sched: Scheduler) -> None:
+        raise NotImplementedError
+
+    def check(self) -> None:  # quiescent-point invariants
+        pass
+
+    def finish(self) -> None:  # terminal probes
+        pass
+
+    # -- explore.run_once protocol -------------------------------------
+
+    def _time_mods(self):
+        import importlib
+
+        return [importlib.import_module(m) for m in self.time_modules]
+
+    def run(self, forced: List[str], max_steps: int = 2000):
+        sched = Scheduler(self.clock, max_steps=max_steps)
+        self.sched = sched
+        mods = self._time_mods()
+        with virtual_time(self.clock, mods), instrumented(sched):
+            self.build(sched)
+            sched.run(forced, check=self.check)
+            self.finish()
+        return sched.steps
+
+    def trace(self):
+        return self.sched.steps if self.sched is not None else []
+
+    def task_exception(self):
+        if self.sched is None:
+            return None
+        for t in self.sched.tasks:
+            if t.exc is not None:
+                return (t.name, t.exc)
+        return None
+
+
+# ---------------------------------------------------------------------
+# Ledger scenarios.
+
+
+class _LedgerScenario(Scenario):
+    """Shared ledger/SpecEngine plumbing.  ``ledger_mod`` defaults to
+    the real module; mutations.py points it at a mutated twin (same
+    source, one guard disabled) to prove the checker has teeth."""
+
+    ledger_mod = None
+
+    def _ledger_module(self):
+        if self.ledger_mod is None:
+            from gubernator_tpu.core import ledger as ledger_mod
+
+            self.ledger_mod = ledger_mod
+        return self.ledger_mod
+
+    def _time_mods(self):
+        return [self._ledger_module()]
+
+    def _mk_ledger(self, **kw):
+        self.ledger_mod = self._ledger_module()
+        self.engine = SpecEngine(self.clock)
+        # The engine lock is created HERE, under instrumentation, from
+        # this module (not a passthrough) — one schedule point per
+        # device batch apply.
+        import threading
+
+        self.engine.bind_lock(threading.RLock())
+        kw.setdefault("settle_interval", 0)  # no background flusher
+        self.ledger = self.ledger_mod.DecisionLedger(self.engine, **kw)
+        return self.ledger
+
+    def serve(self, rows):
+        """The exact serve partition the fronts use (tests/test_ledger
+        Harness.serve)."""
+        dec = _make_dec(rows)
+        now = self.clock.now_ms()
+        plan = self.ledger.plan(dec, now)
+        if plan.full:
+            return plan.dense_cols()
+        lane = plan.build_engine_lane()
+        st, lim, rem, rst = self.engine.apply_columnar(
+            _Packed(lane.key_buf, lane.key_offsets, lane.n),
+            lane.algo, lane.behavior, lane.hits, lane.limit,
+            lane.duration, lane.burst, now_ms=now,
+        )
+        plan.learn(st, lim, rem, rst)
+        return plan.merge_outputs(st, rem, rst)
+
+    # -- invariant extractors ------------------------------------------
+
+    def _spec_live(self, state, now: int) -> bool:
+        if state is None:
+            return False
+        if state.expire_at < now:
+            return False
+        if state.invalid_at != 0 and state.invalid_at < now:
+            return False
+        return True
+
+    def check_sticky_over_exact(self) -> None:
+        now = self.clock.now_ms()
+        entries = []
+        for e in self.ledger._items.values():
+            if e.kind != _K_OVER or now > e.reset:
+                continue
+            st = self.engine.states.get(e.key)
+            entries.append((
+                e.key,
+                int(st.remaining) if st is not None else 0,
+                self._spec_live(st, now),
+            ))
+        props.check_sticky_over_exact(entries)
+
+    def check_probe_conformance(self, key, limit, duration, burst):
+        now = self.clock.now_ms()
+        spec_ans = self.engine.spec_probe(key, limit, duration, burst, now)
+        st, _lim, rem, _rst = self.serve(
+            [(key, _TOKEN, 0, 0, limit, duration, burst)]
+        )
+        props.check_probe_conformance(
+            key, (int(st[0]), int(rem[0])), spec_ans
+        )
+
+
+class LedgerLeaseChurn(_LedgerScenario):
+    """PR 13's bug class: a small hot bucket whose lease is revoked
+    (over-ask) while other serves race the in-flight credit return.
+    A sticky-OVER insert that captures the PRE-return device snapshot
+    strands the returned credit until the window resets."""
+
+    name = "ledger-lease-churn"
+    summary = ("lease revoke vs racing serves on a small hot bucket; "
+               "the in-flight-return window must not mint sticky OVER")
+    properties = ("sticky-over-exact", "hot-key-no-starvation",
+                  "over-admission-bound")
+    K = b"churn-hot"
+    LIMIT, DUR = 4, 60_000
+
+    def build(self, sched: Scheduler) -> None:
+        led = self._mk_ledger(
+            lease_size=8, lease_ttl=0.2, hot_threshold=2, hot_window=10.0,
+        )
+        self.admitted: Dict[str, int] = {}
+        row1 = (self.K, _TOKEN, 0, 1, self.LIMIT, self.DUR, self.LIMIT)
+        # Warmup (unmanaged, atomic): make the key hot and grant the
+        # lease — 2 hits + 1 lease debit leave the device at rem=1.
+        self.serve([row1])
+        self.serve([row1])
+
+        def hit(task: str, hits: int):
+            def body():
+                row = (self.K, _TOKEN, 0, hits, self.LIMIT, self.DUR,
+                       self.LIMIT)
+                st, _lim, _rem, _rst = self.serve([row])
+                if int(st[0]) == _UNDER and hits > 0:
+                    self.admitted[task] = self.admitted.get(task, 0) + hits
+            return body
+
+        sched.spawn("revoker", hit("revoker", 2))   # over-ask → revoke
+        sched.spawn("prober-a", hit("prober-a", 1))
+        sched.spawn("prober-b", hit("prober-b", 1))
+
+    def check(self) -> None:
+        self.check_sticky_over_exact()
+
+    def finish(self) -> None:
+        # Drain: lapse the lease TTL and settle, then the terminal
+        # probe must answer exactly what the spec answers (returned
+        # credit is servable — the PR 13 starvation signature).
+        self.clock.advance(ms=300)
+        self.ledger.flush_settles()
+        self.check_sticky_over_exact()
+        # Warmup admitted 2 before the tasks ran.
+        total = 2 + sum(self.admitted.values())
+        props.check_over_admission(self.K, total, self.LIMIT)
+        self.check_probe_conformance(self.K, self.LIMIT, self.DUR,
+                                     self.LIMIT)
+
+
+class LedgerRenewal(_LedgerScenario):
+    """PR 4's bug class: a duration change renews the spec bucket
+    (remaining snaps back to limit) while the response snapshot is the
+    pre-renewal OVER — inserting sticky OVER from that snapshot caches
+    a rejection for a bucket that is actually full of credit."""
+
+    name = "ledger-renewal"
+    summary = ("duration-change renewal racing a sticky-OVER window "
+               "and the reset boundary tick")
+    properties = ("sticky-over-exact",)
+    K = b"renew"
+    LIMIT, D1, D2 = 3, 500, 300
+
+    def build(self, sched: Scheduler) -> None:
+        self._mk_ledger(hot_threshold=99)  # no leasing here
+        row = lambda h, d: (self.K, _TOKEN, 0, h, self.LIMIT, d,
+                            self.LIMIT)  # noqa: E731
+        # Setup: exhaust, flip sticky-OVER (legit: device rem=0),
+        # then move near the reset boundary.
+        self.serve([row(self.LIMIT, self.D1)])
+        self.serve([row(1, self.D1)])
+        self.clock.advance(ms=400)
+
+        sched.spawn("changer", lambda: self.serve([row(1, self.D2)]))
+        sched.spawn("prober", lambda: self.serve([row(1, self.D1)]))
+        sched.spawn("ticker", lambda: self.sched.tick(200))
+
+    def check(self) -> None:
+        self.check_sticky_over_exact()
+
+    def finish(self) -> None:
+        self.check_sticky_over_exact()
+
+
+class FakeNativePlane:
+    """In-memory native decision plane with the bridge contract the
+    ledger drives (core/native's table): install/pull/peek/clear.
+    kind 2 = lease, 1 = over — the wire-level kinds the ledger tests
+    (``res[0] == 2``)."""
+
+    def __init__(self):
+        self.table: Dict[bytes, list] = {}
+        self.offset = 0
+
+    def set_clock_offset(self, now_ms: int) -> None:
+        self.offset = now_ms
+
+    def install_lease(self, key, limit, duration, reset, rem, credit,
+                      consumed, expiry) -> bool:
+        self.table[key] = [2, int(consumed), int(credit)]
+        return True
+
+    def install_over(self, key, limit, duration, reset) -> None:
+        self.table[key] = [1, 0, 0]
+
+    def pull(self, key):
+        row = self.table.pop(key, None)
+        return None if row is None else (row[0], row[1], row[2])
+
+    def peek(self, key):
+        row = self.table.get(key)
+        return None if row is None else (row[0], row[1], row[2])
+
+    def holds_lease(self, key) -> bool:
+        row = self.table.get(key)
+        return row is not None and row[0] == 2
+
+    def clear(self) -> None:
+        self.table.clear()
+
+    def stats(self) -> dict:
+        return {"native_answered": 0}
+
+
+class LedgerNativeDelegation(_LedgerScenario):
+    """Two-tier custody: a delegated lease lives in the C plane until
+    a Python touch pulls it back.  Credit must be drainable in exactly
+    one tier at every quiescent point."""
+
+    name = "ledger-native-delegation"
+    summary = ("python touch vs drain vs TTL flush on a delegated "
+               "lease; credit lives in exactly one tier")
+    properties = ("lease-single-tier", "sticky-over-exact")
+    K = b"native-hot"
+    LIMIT, DUR = 100, 60_000
+
+    def build(self, sched: Scheduler) -> None:
+        led = self._mk_ledger(
+            lease_size=8, lease_ttl=0.2, hot_threshold=2, hot_window=10.0,
+        )
+        self.plane = FakeNativePlane()
+        led.attach_native(self.plane)
+        row = lambda h: (self.K, _TOKEN, 0, h, self.LIMIT, self.DUR,
+                         self.LIMIT)  # noqa: E731
+        self.serve([row(1)])
+        self.serve([row(1)])  # hot → lease granted → delegated
+
+        sched.spawn("toucher", lambda: self.serve([row(0)]))
+        sched.spawn("driver", lambda: self.serve([row(2)]))
+
+        def ticker():
+            self.sched.tick(250)  # past the 200ms lease TTL
+            self.ledger.flush_settles()
+
+        sched.spawn("ticker", ticker)
+
+    def check(self) -> None:
+        entries = []
+        for e in self.ledger._items.values():
+            if e.kind in (_K_LEASE, _K_NATIVE):
+                entries.append((
+                    e.key, _KIND_NAME[e.kind],
+                    self.plane.holds_lease(e.key),
+                ))
+        props.check_lease_single_tier(entries)
+        self.check_sticky_over_exact()
+
+    def finish(self) -> None:
+        self.check()
+
+
+# ---------------------------------------------------------------------
+# Circuit-breaker scenario.
+
+
+class CircuitBreaker(Scenario):
+    """Concurrent failure/success/probe reports against one real
+    PeerHealth: every observed transition must be an edge of the
+    documented table (RESILIENCE.md §1)."""
+
+    name = "circuit-breaker"
+    summary = ("racing failure/success/half-open-probe reports; "
+               "transitions stay inside the legal table")
+
+    properties = ("circuit-legal-transitions",)
+    time_modules = ("gubernator_tpu.cluster.health",)
+
+    def build(self, sched: Scheduler) -> None:
+        from gubernator_tpu.cluster.health import PeerHealth
+
+        clock = self.clock
+
+        class TracedPeerHealth(PeerHealth):
+            __slots__ = ("edges",)
+
+            def __init__(self, *a, **kw):
+                self.edges: List[Tuple[str, str]] = []
+                super().__init__(*a, **kw)
+
+            def _to(self, state):
+                prev = getattr(self, "_state", None)
+                if prev is not None and state != prev:
+                    self.edges.append((prev, state))
+                super()._to(state)
+
+        self.health = TracedPeerHealth(
+            "peer:81", failure_threshold=2, backoff=0.1,
+            now=lambda: clock.now_ms() / 1000.0,
+        )
+        h = self.health
+
+        def failer_a():
+            h.record_failure()
+            h.record_failure()
+
+        def failer_b():
+            h.record_failure()
+            h.record_success()
+
+        def prober():
+            self.sched.tick(400)  # past any doubled open period
+            if h.allow():
+                h.record_failure()
+
+        sched.spawn("failer-a", failer_a)
+        sched.spawn("failer-b", failer_b)
+        sched.spawn("prober", prober)
+
+    def check(self) -> None:
+        props.check_circuit_transitions(self.health.edges)
+
+    def finish(self) -> None:
+        self.check()
+
+
+# ---------------------------------------------------------------------
+# Membership epoch scenario.
+
+
+class MembershipEpoch(Scenario):
+    """Two racing view changes drive REAL apply_view → per-epoch
+    transition threads → commit.  Commits must be strictly epoch-
+    monotonic (a superseded transition never commits after its
+    successor) and dual-window routing never leaves the old/new owner
+    pair."""
+
+    name = "membership-epoch"
+    summary = ("concurrent apply_view transitions; epoch-monotonic "
+               "commit + dual-window routing")
+    properties = ("epoch-monotonic-commit", "dual-window-no-third-owner")
+    time_modules = ("gubernator_tpu.cluster.membership",)
+    SAMPLE_KEYS = ("alpha", "beta", "gamma", "delta")
+
+    def build(self, sched: Scheduler) -> None:
+        from gubernator_tpu.cluster.membership import MembershipManager
+
+        me = PeerInfo(grpc_address="a:81", http_address="a:80",
+                      datacenter="dc1", is_owner=True)
+        pb = PeerInfo(grpc_address="b:81", http_address="b:80",
+                      datacenter="dc1")
+        pc = PeerInfo(grpc_address="c:81", http_address="c:80",
+                      datacenter="dc1")
+        daemon = SimpleNamespace(
+            conf=SimpleNamespace(
+                data_center="dc1", hash_algorithm="fnv1a",
+                peer_picker="replicated-hash", picker_replicas=64,
+                behaviors=None,
+            ),
+            instance=None,  # no engine: transition = join prev + commit
+            peer_info=lambda: me,
+        )
+        self.mm = MembershipManager(daemon)
+        self.mm.apply_view([me])  # first view: ring only, no transition
+        self.committed: List[int] = []
+        mm, committed = self.mm, self.committed
+        real_set = mm._settled.set
+
+        def traced_set():
+            # Called only from _commit's effective path, under _lock:
+            # _active_transition IS the committing epoch.
+            committed.append(mm._active_transition)
+            real_set()
+
+        mm._settled.set = traced_set
+        sched.spawn("viewer-a", lambda: mm.apply_view([me, pb]))
+        sched.spawn("viewer-b", lambda: mm.apply_view([me, pb, pc]))
+
+    def check(self) -> None:
+        props.check_epoch_monotonic(self.committed)
+        w = self.mm._dual_window
+        if w is not None:
+            props.check_dual_window_routing([
+                (k.encode(), w.owner(k), w.owners(k))
+                for k in self.SAMPLE_KEYS
+            ])
+
+    def finish(self) -> None:
+        props.check_epoch_monotonic(self.committed)
+        if not self.committed:
+            raise PropertyViolation(
+                "epoch-monotonic-commit",
+                "no transition ever committed (lost epoch)",
+            )
+        if self.mm.phase() != "stable":
+            raise PropertyViolation(
+                "epoch-monotonic-commit",
+                f"terminal phase {self.mm.phase()!r} != stable",
+            )
+
+
+# ---------------------------------------------------------------------
+# Multi-region requeue scenario.
+
+
+class _FakeRegionPeer:
+    """send_peer_hits with a bounded failure budget; deliveries are
+    tallied per (region, key) for the double-send check."""
+
+    def __init__(self, scenario, dc: str, fail_times: int = 0):
+        self.scenario = scenario
+        self.dc = dc
+        self.fail_times = fail_times
+
+    def send_peer_hits(self, reqs, timeout=None):
+        from gubernator_tpu.cluster.peer_client import PeerError
+
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise PeerError("region unreachable", not_ready=True)
+        delivered = self.scenario.delivered
+        for r in reqs:
+            rk = (self.dc, r.key)
+            delivered[rk] = delivered.get(rk, 0) + int(r.hits)
+
+
+class _FakeBatcher:
+    """IntervalBatcher stand-in: records requeues, signals the retry
+    task (the real batcher defers by ``delay`` on its flush thread)."""
+
+    def __init__(self, event):
+        self.requeued: List[tuple] = []
+        self.event = event
+
+    def requeue_many(self, pairs, oldest_ts=0.0, delay=0.0):
+        self.requeued.extend(pairs)
+        self.event.set()
+        return len(pairs)
+
+
+class MultiregionRequeue(Scenario):
+    """REAL _push_region/_requeue_region under a partial region
+    failure: the delivered prefix must never be re-queued (no double
+    send), and the retry must converge — every offered hit delivered
+    exactly once."""
+
+    name = "multiregion-requeue"
+    summary = ("partial region push failure + retry; delivered "
+               "hits never exceed offered (requeue-and-converge)")
+    properties = ("region-no-double-send",)
+    time_modules = ("gubernator_tpu.cluster.multiregion",)
+    DC = "eu"
+
+    def build(self, sched: Scheduler) -> None:
+        import threading
+
+        from gubernator_tpu.cluster.multiregion import MultiRegionManager
+        from gubernator_tpu.utils.metrics import DurationStat
+
+        self.offered: Dict[Tuple[str, str], int] = {}
+        self.delivered: Dict[Tuple[str, str], int] = {}
+
+        # The real protocol methods on a hand-built instance: the
+        # __init__ scaffolding (RPC pool, interval batcher threads) is
+        # transport, not protocol — stubbed per the module docstring.
+        mrm = MultiRegionManager.__new__(MultiRegionManager)
+        mrm.conf = SimpleNamespace(
+            multi_region_timeout=1.0, multi_region_backoff=0.05,
+            multi_region_backoff_cap=0.5, multi_region_requeue_age=30.0,
+            multi_region_batch_limit=64,
+        )
+        mrm.instance = None
+        mrm._counter_lock = threading.Lock()
+        mrm._requeue_lock = threading.Lock()
+        mrm._region_attempts = {}
+        mrm._requeue_first = {}
+        mrm.windows = 0
+        mrm.region_sends = 0
+        mrm.region_sends_by = {}
+        mrm.hits_requeued = 0
+        mrm.hits_dropped = 0
+        mrm.region_rpc = DurationStat()
+        self.retry_ready = threading.Event()
+        mrm._hits = _FakeBatcher(self.retry_ready)
+        self.mrm = mrm
+
+        ok_peer = _FakeRegionPeer(self, self.DC)
+        flaky = _FakeRegionPeer(self, self.DC, fail_times=1)
+        self.flaky = flaky
+
+        def req(key, hits):
+            r = SimpleNamespace(key=key, hits=hits)
+            self.offered[(self.DC, key)] = hits
+            return r
+
+        pairs_a1 = [("mr-a", req("mr-a", 1))]
+        pairs_a2 = [("mr-b", req("mr-b", 2)), ("mr-c", req("mr-c", 1))]
+        pairs_b = [("mr-d", req("mr-d", 1))]
+
+        def pusher_a():
+            self.mrm._push_region(self.DC, {
+                "ok:81": (ok_peer, pairs_a1),
+                "flaky:81": (flaky, pairs_a2),
+            })
+
+        def pusher_b():
+            self.mrm._push_region(self.DC, {"ok:81": (ok_peer, pairs_b)})
+
+        def retrier():
+            if not self.retry_ready.wait(timeout=5.0):
+                return
+            items = list(self.mrm._hits.requeued)
+            del self.mrm._hits.requeued[:]
+            if not items:
+                return
+            retry_pairs = [(kk[1], r) for kk, r in items]
+            self.mrm._push_region(self.DC, {"flaky:81": (flaky, retry_pairs)})
+
+        sched.spawn("pusher-a", pusher_a)
+        sched.spawn("pusher-b", pusher_b)
+        sched.spawn("retrier", retrier)
+
+    def check(self) -> None:
+        props.check_region_no_double_send(self.offered, self.delivered)
+
+    def finish(self) -> None:
+        self.check()
+        # Convergence: nothing pending, nothing dropped → delivered
+        # must equal offered exactly once each.
+        if not self.mrm._hits.requeued and self.mrm.hits_dropped == 0:
+            for rk, want in self.offered.items():
+                got = self.delivered.get(rk, 0)
+                if got != want:
+                    raise PropertyViolation(
+                        "region-no-double-send",
+                        f"region/key {rk} failed to converge: delivered "
+                        f"{got} of {want} offered",
+                    )
+
+
+# ---------------------------------------------------------------------
+# Replication grant scenario.
+
+
+class ReplicationGrant(Scenario):
+    """REAL replica-side lease table: an epoch-racing re-grant
+    supersedes a draining lease while the TTL expirer runs.  Credit
+    conservation: drained hits never exceed granted credit, and every
+    live lease's consumed stays inside its slice."""
+
+    name = "replication-grant"
+    summary = ("re-grant vs drain vs expiry on the replica lease "
+               "table; consumed never exceeds granted credit")
+    properties = ("over-admission-bound",)
+    time_modules = ("gubernator_tpu.cluster.replication",)
+    K = b"repl-hot"
+    LIMIT, DUR = 10, 1_000
+
+    def _grant_doc(self, seq, epoch, rem, credit, expiry_ms):
+        now = self.clock.now_ms()
+        return json.dumps({
+            "op": "grant", "src": "owner:81", "boot": "boot-1",
+            "seq": seq, "epoch": epoch,
+            "grants": [[
+                self.K.decode(), self.LIMIT, self.DUR, now + self.DUR,
+                rem, credit, now + expiry_ms,
+            ]],
+        }).encode()
+
+    def build(self, sched: Scheduler) -> None:
+        from gubernator_tpu.cluster.replication import ReplicationManager
+
+        daemon = SimpleNamespace(
+            membership=None,
+            instance=SimpleNamespace(
+                engine=SimpleNamespace(clock=self.clock),
+                ledger=None, hotkeys=None,
+                get_peer=lambda k: None,
+            ),
+            peer_info=lambda: PeerInfo(grpc_address="replica:81"),
+        )
+        self.rm = ReplicationManager(daemon)  # no start(): no loop
+        self.granted = 0
+        self.admitted = 0
+        rm = self
+
+        def grant(seq, epoch, rem, credit, expiry_ms):
+            resp = json.loads(self.rm.receive(
+                self._grant_doc(seq, epoch, rem, credit, expiry_ms)
+            ))
+            if not resp.get("stale") and not resp.get("disabled"):
+                rm.granted += credit
+
+        # Seed lease installed during (unmanaged) setup: the raced
+        # part is the re-grant / duplicate / drain / expiry episode on
+        # an EXISTING lease — installing the seed under the scheduler
+        # would triple the schedule space without new orderings.
+        grant(1, 1, rem=8, credit=4, expiry_ms=500)
+
+        def regrant():
+            grant(2, 2, rem=6, credit=3, expiry_ms=500)
+
+        def stale_then_expire():
+            # Duplicate delivery of the seed grant doc: the seq guard
+            # must refuse it (accepting would resurrect the seed's
+            # credit slice AFTER drains consumed from it).  Then drive
+            # TTL expiry past the 500ms grant expiry.
+            grant(1, 1, rem=8, credit=4, expiry_ms=500)
+            self.sched.tick(600)
+            self.rm._expire_replica_leases(self.clock.now_ms() / 1000.0)
+
+        def drainer():
+            # try_answer's lock acquire is the yield point; an extra
+            # checkpoint here would double the schedule space for no
+            # new orderings.
+            for _ in range(2):
+                out = self.rm.try_answer(
+                    self.K, _TOKEN, 0, 1, self.LIMIT, self.DUR,
+                    self.clock.now_ms(),
+                )
+                if out is not None:
+                    rm.admitted += 1
+
+        sched.spawn("regrant", regrant)
+        sched.spawn("stale-expirer", stale_then_expire)
+        sched.spawn("drainer", drainer)
+
+    def check(self) -> None:
+        for lease in self.rm._leases.values():
+            if lease.consumed > lease.credit:
+                raise PropertyViolation(
+                    "over-admission-bound",
+                    f"{lease.key!r}: replica slice drained "
+                    f"{lease.consumed} > granted {lease.credit}",
+                )
+
+    def finish(self) -> None:
+        self.check()
+        if self.admitted > self.granted:
+            raise PropertyViolation(
+                "over-admission-bound",
+                f"{self.K!r}: replica admitted {self.admitted} hits "
+                f"from only {self.granted} granted credit",
+            )
+
+
+# ---------------------------------------------------------------------
+# Registry.
+
+SCENARIOS = OrderedDict(
+    (cls.name, cls)
+    for cls in (
+        LedgerLeaseChurn, LedgerRenewal, LedgerNativeDelegation,
+        CircuitBreaker, MembershipEpoch, MultiregionRequeue,
+        ReplicationGrant,
+    )
+)
+
+
+def scenario_names() -> List[str]:
+    return list(SCENARIOS)
+
+
+def get_scenario(name: str):
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; have {', '.join(SCENARIOS)}"
+        ) from None

@@ -1,0 +1,740 @@
+"""Cooperative scheduler: run real threaded code one step at a time.
+
+The model-checking substrate.  Scenario code (the REAL ledger /
+health / membership / replication modules) runs on real OS threads,
+but every ``threading.Lock/RLock/Condition/Event/Thread`` the scenario
+constructs is replaced by an instrumented twin that parks the thread
+at a *yield point* before each synchronization operation.  Exactly one
+task runs between yield points, chosen by the controller from the set
+of *enabled* tasks — so a run is fully determined by its schedule (the
+sequence of chosen task names), and the explorer (explore.py) can
+enumerate schedules.
+
+Model (CHESS-style):
+
+- Yield points sit BEFORE each sync op (lock acquire, cond/event
+  wait, thread join, explicit ``checkpoint``/``tick``).  Code between
+  two yield points executes atomically.  This is sound for
+  data-race-free code — every shared mutation in the scenario modules
+  happens under a lock (guberlint's lock pass enforces the
+  guarded-by annotations).
+- An op is *enabled* when it can complete without blocking (the lock
+  is free, the join target is done, the event is set …).  The
+  controller only schedules enabled tasks, so instrumented ops never
+  actually block at the OS level.
+- Timeouts are virtual: a timed wait fires only when NO task is
+  enabled — the controller advances the repo's frozen ``Clock`` to
+  the earliest deadline.  No runnable task + no deadline = deadlock,
+  reported as a finding.
+- Wall time is excised: the scenario freezes ``Clock`` at a fixed
+  epoch and ``virtual_time`` rebinds a module's ``time`` attribute to
+  the clock, so ``time.monotonic()`` inside the module under test is
+  schedule-deterministic.
+
+Noise filter: locks created by modules on ``_PASSTHROUGH_MODULES``
+(metrics counters, the Clock's own guard, logging) stay REAL locks —
+they guard leaf counters whose interleavings cannot affect protocol
+invariants, and instrumenting them would blow up the schedule space
+with irrelevant choice points.  STATIC_ANALYSIS.md documents this
+boundary.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Real primitives, captured at import time.  NOTE: stdlib Semaphore /
+# Thread construct Condition/Event through the *threading module
+# globals*, which the patch replaces — so the scheduler's own
+# machinery must never instantiate stdlib sync helpers while the
+# patch is active.  _RealSem below is self-contained, and real thread
+# creation is wrapped in _with_real (the factories check the guard).
+_RealThread = threading.Thread
+_RealLock = threading.Lock
+_RealRLock = threading.RLock
+_RealCondition = threading.Condition
+_RealEvent = threading.Event
+
+# Modules whose locks stay real (leaf counters / clock guard / stdlib
+# logging): no protocol state, no scheduling value.
+_PASSTHROUGH_MODULES = (
+    "logging",
+    "gubernator_tpu.clock",
+    "gubernator_tpu.utils.",
+)
+
+_UNMANAGED = "<unmanaged>"
+
+# Thread-local guard: while set, the instrumented factories return
+# REAL primitives (scheduler machinery constructing threads).
+_machinery = threading.local()
+
+
+def _with_real(fn):
+    _machinery.on = True
+    try:
+        return fn()
+    finally:
+        _machinery.on = False
+
+
+class _RealSem:
+    """Counting semaphore built only from captured real primitives —
+    safe to construct while the threading patch is active."""
+
+    __slots__ = ("_cond", "_value")
+
+    def __init__(self) -> None:
+        self._cond = _RealCondition(_RealLock())
+        self._value = 0
+
+    def acquire(self) -> None:
+        with self._cond:
+            while self._value == 0:
+                self._cond.wait()
+            self._value -= 1
+
+    def release(self) -> None:
+        with self._cond:
+            self._value += 1
+            self._cond.notify()
+
+
+class _Kill(BaseException):
+    """Raised inside a task thread to unwind it when a run aborts."""
+
+
+class DeadlockError(Exception):
+    """No task enabled, no timeout pending: the schedule deadlocked."""
+
+
+class DivergenceError(Exception):
+    """A forced schedule step named a task that is not enabled — the
+    scenario executed differently than when the prefix was recorded,
+    i.e. it is not schedule-deterministic."""
+
+
+class Op:
+    """One pending synchronization operation."""
+
+    __slots__ = ("kind", "resource", "deadline")
+
+    def __init__(self, kind: str, resource: str, deadline: Optional[int] = None):
+        self.kind = kind
+        self.resource = resource
+        self.deadline = deadline  # virtual-clock ms; None = untimed
+
+    def conflicts(self, other: "Op") -> bool:
+        """Conservative dependence: ops on the same resource, or any
+        op against a clock tick (time feeds TTL/expiry branches
+        everywhere, so reordering across a tick never commutes)."""
+        if self.resource == "clock" or other.resource == "clock":
+            return True
+        return self.resource == other.resource
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Op({self.kind},{self.resource})"
+
+
+class Task:
+    """One managed thread of the scenario."""
+
+    NEW = "new"
+    RUNNABLE = "runnable"
+    DONE = "done"
+
+    __slots__ = (
+        "sched", "name", "fn", "index", "state", "pending", "sem",
+        "exc", "thread", "timed_out",
+    )
+
+    def __init__(self, sched: "Scheduler", name: str, fn: Callable[[], None], index: int):
+        self.sched = sched
+        self.name = name
+        self.fn = fn
+        self.index = index
+        self.state = Task.NEW
+        self.pending: Optional[Op] = Op("start", f"task:{name}")
+        self.sem = _RealSem()
+        self.exc: Optional[BaseException] = None
+        self.thread = None
+        self.timed_out = False
+
+    def start_thread(self) -> None:
+        self.state = Task.RUNNABLE
+
+        def make():
+            th = _RealThread(
+                target=self._body, name=f"gubercheck-{self.name}",
+                daemon=True,
+            )
+            th.start()
+            return th
+
+        self.thread = _with_real(make)
+
+    def _body(self) -> None:
+        self.sched._tls.task = self
+        self.sem.acquire()
+        if self.sched.killed:
+            self.state = Task.DONE
+            self.sched._ctl.release()
+            return
+        try:
+            self.fn()
+        except _Kill:
+            pass
+        except BaseException as e:  # noqa: BLE001 - surfaced as a finding
+            self.exc = e
+        self.state = Task.DONE
+        self.sched._ctl.release()
+
+
+class StepRecord:
+    """One controller decision: who was enabled, what each wanted to
+    do, who ran.  The explorer derives backtrack points from these."""
+
+    __slots__ = ("enabled", "pending", "chosen", "op", "preempting")
+
+    def __init__(self, enabled, pending, chosen, op, preempting):
+        self.enabled: List[str] = enabled
+        self.pending: Dict[str, Tuple[str, str]] = pending  # name -> (kind, resource)
+        self.chosen: str = chosen
+        self.op: Tuple[str, str] = op
+        self.preempting: bool = preempting
+
+
+class Scheduler:
+    """Controller + task registry for ONE run of one scenario."""
+
+    def __init__(self, clock, max_steps: int = 2000):
+        self.clock = clock  # repo Clock, frozen at a fixed epoch
+        self.max_steps = max_steps
+        self.tasks: List[Task] = []
+        self._by_name: Dict[str, Task] = {}
+        self._ctl = _RealSem()
+        self._tls = threading.local()
+        self.killed = False
+        self.active = False
+        self._locks: List["ILock"] = []
+        self._conds: List["ICondition"] = []
+        self._events: List["IEvent"] = []
+        self._lock_by_rid: Dict[str, "ILock"] = {}
+        self._next_rid = 0
+        self.steps: List[StepRecord] = []
+        self.check: Optional[Callable[[], None]] = None
+
+    # -- registry ------------------------------------------------------
+
+    def spawn(self, name: str, fn: Callable[[], None]) -> Task:
+        if name in self._by_name:
+            raise ValueError(f"duplicate task name {name!r}")
+        t = Task(self, name, fn, len(self.tasks))
+        self.tasks.append(t)
+        self._by_name[name] = t
+        t.start_thread()
+        return t
+
+    def _rid(self, kind: str) -> str:
+        self._next_rid += 1
+        return f"{kind}:{self._next_rid}"
+
+    def current(self) -> Optional[Task]:
+        return getattr(self._tls, "task", None)
+
+    # -- task-side -----------------------------------------------------
+
+    def yield_point(self, op: Op) -> None:
+        """Park the calling task until the controller schedules it.
+        No-op outside a managed task (setup / terminal phases)."""
+        t = self.current()
+        if t is None or not self.active:
+            return
+        if self.killed:
+            raise _Kill()
+        t.pending = op
+        self._ctl.release()
+        t.sem.acquire()
+        if self.killed:
+            raise _Kill()
+        t.pending = None
+
+    def checkpoint(self, resource: str = "checkpoint") -> None:
+        """Explicit scheduling point for scenario task code."""
+        self.yield_point(Op("checkpoint", resource))
+
+    def tick(self, ms: int) -> None:
+        """Advance the virtual clock from a task — a schedulable event
+        so expiry/TTL boundaries interleave with protocol steps."""
+        self.yield_point(Op("tick", "clock"))
+        self.clock.advance(ms=ms)
+
+    # -- controller ----------------------------------------------------
+
+    def _enabled(self, t: Task) -> bool:
+        if t.state != Task.RUNNABLE:
+            return False
+        op = t.pending
+        if op is None:
+            return False
+        if t.timed_out:
+            return True  # the controller fired this op's deadline
+        if op.kind in ("start", "checkpoint", "tick", "tryacquire"):
+            return True
+        if op.kind == "acquire":
+            lock = self._lock_by_rid.get(op.resource)
+            return lock is None or lock._available_for(t)
+        if op.kind == "join":
+            target = self._by_name.get(op.resource.split(":", 1)[1])
+            return target is None or target.state == Task.DONE
+        if op.kind == "wait":  # condition: enabled once notified
+            cond = next((c for c in self._conds if c.rid == op.resource), None)
+            return cond is None or t in cond._notified
+        if op.kind == "evwait":
+            ev = next((e for e in self._events if e.rid == op.resource), None)
+            return ev is None or ev._flag
+        return True
+
+    def run(self, forced: List[str], check: Optional[Callable[[], None]] = None):
+        """Drive all spawned tasks to completion following ``forced``
+        as a schedule prefix, default continuation after it.  Returns
+        the step trace; raises DeadlockError / DivergenceError /
+        PropertyViolation (from ``check``) on findings."""
+        self.check = check
+        self.active = True
+        last: Optional[Task] = None
+        try:
+            while True:
+                if len(self.steps) > self.max_steps:
+                    raise DeadlockError(
+                        f"step budget exceeded ({self.max_steps}): "
+                        "livelock or runaway scenario"
+                    )
+                runnable = [t for t in self.tasks if self._enabled(t)]
+                if not runnable:
+                    if all(t.state == Task.DONE for t in self.tasks):
+                        break
+                    timed = [
+                        t for t in self.tasks
+                        if t.state == Task.RUNNABLE and t.pending is not None
+                        and t.pending.deadline is not None and not t.timed_out
+                    ]
+                    if not timed:
+                        blocked = [
+                            f"{t.name}@{t.pending}" for t in self.tasks
+                            if t.state != Task.DONE
+                        ]
+                        raise DeadlockError(
+                            "deadlock: no enabled task, no pending timeout; "
+                            f"blocked: {blocked}"
+                        )
+                    # Fire the earliest virtual deadline.  Deterministic:
+                    # ties broken by task index.
+                    timed.sort(key=lambda t: (t.pending.deadline, t.index))
+                    first = timed[0]
+                    now = self.clock.now_ms()
+                    if first.pending.deadline > now:
+                        self.clock.advance(ms=first.pending.deadline - now)
+                    first.timed_out = True
+                    continue
+                step_i = len(self.steps)
+                if step_i < len(forced):
+                    want = forced[step_i]
+                    chosen = self._by_name.get(want)
+                    if chosen is None or chosen not in runnable:
+                        raise DivergenceError(
+                            f"step {step_i}: forced task {want!r} not enabled "
+                            f"(enabled: {[t.name for t in runnable]})"
+                        )
+                else:
+                    chosen = last if last in runnable else runnable[0]
+                preempting = (
+                    last is not None and last is not chosen and last in runnable
+                )
+                self.steps.append(StepRecord(
+                    enabled=[t.name for t in runnable],
+                    pending={
+                        t.name: (t.pending.kind, t.pending.resource)
+                        for t in runnable
+                    },
+                    chosen=chosen.name,
+                    op=(chosen.pending.kind, chosen.pending.resource),
+                    preempting=preempting,
+                ))
+                self._switch(chosen)
+                last = chosen if chosen.state != Task.DONE else None
+                if self.check is not None and not self._lock_held_by_task():
+                    self.check()
+            return self.steps
+        finally:
+            self.active = False
+            self._reap()
+
+    def _switch(self, t: Task) -> None:
+        t.sem.release()
+        self._ctl.acquire()
+
+    def _lock_held_by_task(self) -> bool:
+        return any(isinstance(l._owner, Task) for l in self._locks)
+
+    def _reap(self) -> None:
+        """Abort: unwind every still-parked task so threads exit."""
+        if all(t.state == Task.DONE for t in self.tasks):
+            return
+        self.killed = True
+        for t in self.tasks:
+            if t.state != Task.DONE:
+                t.sem.release()
+        for t in self.tasks:
+            if t.thread is not None:
+                t.thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------
+# Instrumented primitives
+
+
+class ILock:
+    """Instrumented mutex.  Managed tasks yield before acquiring (the
+    controller only schedules them when the lock is free); unmanaged
+    contexts (setup/terminal, single-threaded by construction) take it
+    directly and assert it was uncontended."""
+
+    _reentrant = False
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.rid = sched._rid("lock")
+        self._owner = None
+        self._count = 0
+        sched._locks.append(self)
+        sched._lock_by_rid[self.rid] = self
+
+    def _available_for(self, t: Task) -> bool:
+        return self._owner is None or (self._reentrant and self._owner is t)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t = self.sched.current()
+        if t is None or not self.sched.active:
+            if isinstance(self._owner, Task):
+                raise RuntimeError(
+                    f"unmanaged acquire of task-held lock {self.rid}"
+                )
+            self._owner = _UNMANAGED
+            self._count += 1
+            return True
+        if not blocking:
+            self.sched.yield_point(Op("tryacquire", self.rid))
+            if not self._available_for(t):
+                return False
+            self._owner = t
+            self._count += 1
+            return True
+        self.sched.yield_point(Op("acquire", self.rid))
+        # Scheduled => enabled => free (or reentrant): nothing ran in
+        # between, so this cannot block.
+        assert self._available_for(t), "scheduler enabledness broken"
+        self._owner = t
+        self._count += 1
+        return True
+
+    def release(self) -> None:
+        self._count -= 1
+        if self._count <= 0:
+            self._owner = None
+            self._count = 0
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def _at_fork_reinit(self) -> None:
+        # Stdlib modules (concurrent.futures.thread) register this as
+        # an os.register_at_fork hook; scenarios never fork.
+        pass
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class IRLock(ILock):
+    _reentrant = True
+
+
+class ICondition:
+    """Instrumented condition variable over an ILock."""
+
+    def __init__(self, sched: Scheduler, lock=None):
+        self.sched = sched
+        self.rid = sched._rid("cond")
+        self._lock = lock if lock is not None else IRLock(sched)
+        self._waiters: List[Task] = []
+        self._notified: set = set()
+        sched._conds.append(self)
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        t = self.sched.current()
+        if t is None or not self.sched.active:
+            raise RuntimeError("ICondition.wait outside a managed task")
+        saved = self._lock._count
+        self._lock._count = 0
+        self._lock._owner = None
+        self._waiters.append(t)
+        deadline = None
+        if timeout is not None:
+            deadline = self.sched.clock.now_ms() + max(0, int(timeout * 1000))
+        self.sched.yield_point(Op("wait", self.rid, deadline))
+        fired = t.timed_out
+        t.timed_out = False
+        self._notified.discard(t)
+        if t in self._waiters:
+            self._waiters.remove(t)
+        # Reacquire before returning (standard condition contract).
+        self.sched.yield_point(Op("acquire", self._lock.rid))
+        self._lock._owner = t
+        self._lock._count = saved
+        return not fired
+
+    def notify(self, n: int = 1) -> None:
+        for t in self._waiters[:n]:
+            self._notified.add(t)
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters))
+
+
+class IEvent:
+    """Instrumented event."""
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.rid = sched._rid("event")
+        self._flag = False
+        sched._events.append(self)
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        t = self.sched.current()
+        if t is not None and self.sched.active:
+            self.sched.yield_point(Op("checkpoint", self.rid))
+        self._flag = True
+
+    def clear(self) -> None:
+        self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        t = self.sched.current()
+        if t is None or not self.sched.active:
+            return self._flag
+        deadline = None
+        if timeout is not None:
+            deadline = self.sched.clock.now_ms() + max(0, int(timeout * 1000))
+        self.sched.yield_point(Op("evwait", self.rid, deadline))
+        t.timed_out = False
+        return self._flag
+
+
+class IThread:
+    """Instrumented thread: code under test that spawns helpers (the
+    membership manager's per-epoch transition threads) gets a managed
+    task instead, so the helper's steps are explored too."""
+
+    _seq = 0
+
+    def __init__(self, sched: Scheduler, target=None, args=(), kwargs=None,
+                 name=None, daemon=None):
+        self.sched = sched
+        self._target = target
+        self._args = args
+        self._kwargs = kwargs or {}
+        IThread._seq += 1
+        self.name = name or f"ithread-{IThread._seq}"
+        self.daemon = bool(daemon)
+        self._task: Optional[Task] = None
+        self._started = False
+
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("threads can only be started once")
+        self._started = True
+        if self.sched.active or not self.sched.steps:
+            # Pre-run or mid-run: becomes a schedulable task.
+            name = self.name
+            if name in self.sched._by_name:
+                name = f"{name}#{len(self.sched.tasks)}"
+                self.name = name
+            self._task = self.sched.spawn(name, self._run_target)
+        else:
+            # Post-run (terminal phase): run inline, synchronously.
+            self._run_target()
+
+    def _run_target(self) -> None:
+        if self._target is not None:
+            self._target(*self._args, **self._kwargs)
+
+    def is_alive(self) -> bool:
+        return self._task is not None and self._task.state != Task.DONE
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._task is None:
+            return
+        t = self.sched.current()
+        if t is None or not self.sched.active:
+            return  # inline/terminal: target already ran or will not
+        deadline = None
+        if timeout is not None:
+            deadline = self.sched.clock.now_ms() + max(0, int(timeout * 1000))
+        self.sched.yield_point(Op("join", f"task:{self._task.name}", deadline))
+        t.timed_out = False
+
+
+# ---------------------------------------------------------------------
+# Patching
+
+
+def _caller_module(depth: int = 2) -> str:
+    try:
+        frame = sys._getframe(depth)
+        return frame.f_globals.get("__name__", "") or ""
+    except ValueError:  # pragma: no cover - shallow stack
+        return ""
+
+
+def _passthrough(mod: str) -> bool:
+    return any(
+        mod == p or mod.startswith(p) for p in _PASSTHROUGH_MODULES
+    )
+
+
+def _real_wanted() -> bool:
+    return bool(getattr(_machinery, "on", False)) or _passthrough(
+        _caller_module(3)
+    )
+
+
+class instrumented:
+    """Context manager: while active, ``threading.Lock()`` etc. return
+    instrumented twins bound to ``sched`` — EXCEPT when constructed by
+    scheduler machinery or a passthrough module (noise filter, see
+    module docstring)."""
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self._saved = {}
+
+    def __enter__(self):
+        sched = self.sched
+
+        # Stdlib modules that lazily create module-level primitives
+        # must import BEFORE the patch: an instrumented lock cached in
+        # sys.modules would outlive the scheduler that owns it.
+        import concurrent.futures.thread  # noqa: F401
+
+        def lock_factory():
+            if _real_wanted():
+                return _RealLock()
+            return ILock(sched)
+
+        def rlock_factory():
+            if _real_wanted():
+                return _RealRLock()
+            return IRLock(sched)
+
+        def cond_factory(lock=None):
+            if _real_wanted():
+                return _RealCondition(lock)
+            return ICondition(sched, lock)
+
+        def event_factory():
+            if _real_wanted():
+                return _RealEvent()
+            return IEvent(sched)
+
+        class thread_factory:
+            def __new__(cls, group=None, target=None, name=None,
+                        args=(), kwargs=None, *, daemon=None):
+                if _real_wanted():
+                    return _RealThread(
+                        group=group, target=target, name=name, args=args,
+                        kwargs=kwargs, daemon=daemon,
+                    )
+                return IThread(sched, target=target, args=args,
+                               kwargs=kwargs, name=name, daemon=daemon)
+
+        self._saved = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+            "Condition": threading.Condition,
+            "Event": threading.Event,
+            "Thread": threading.Thread,
+        }
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        threading.Condition = cond_factory
+        threading.Event = event_factory
+        threading.Thread = thread_factory
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for name, val in self._saved.items():
+            setattr(threading, name, val)
+
+
+class VirtualTime:
+    """Stand-in for a module's ``time`` attribute: monotonic/time read
+    the frozen Clock, so TTL comparisons are schedule-deterministic."""
+
+    def __init__(self, clock):
+        self._clock = clock
+
+    def monotonic(self) -> float:
+        return self._clock.now_ms() / 1000.0
+
+    def time(self) -> float:
+        return self._clock.now_ms() / 1000.0
+
+    def monotonic_ns(self) -> int:
+        return self._clock.now_ms() * 1_000_000
+
+    def time_ns(self) -> int:
+        return self._clock.now_ms() * 1_000_000
+
+    def sleep(self, seconds: float) -> None:
+        # Sleeping in a scenario is a modeling error: time only moves
+        # via Scheduler.tick.  Make it loud.
+        raise RuntimeError("time.sleep() under gubercheck — use tick()")
+
+
+class virtual_time:
+    """Context manager: rebind ``module.time`` to a VirtualTime."""
+
+    def __init__(self, clock, modules):
+        self.vt = VirtualTime(clock)
+        self.modules = modules
+        self._saved: List[Tuple[object, object]] = []
+
+    def __enter__(self):
+        for mod in self.modules:
+            self._saved.append((mod, mod.time))
+            mod.time = self.vt
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for mod, t in self._saved:
+            mod.time = t

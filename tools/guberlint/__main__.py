@@ -36,6 +36,7 @@ from tools.guberlint import (
     lockcheck,
     nativecheck,
     netcheck,
+    protocheck,
     threadcheck,
     tracecheck,
 )
@@ -43,6 +44,7 @@ from tools.guberlint.common import (
     PASS_NAMES,
     Finding,
     SourceFile,
+    SuppressionTracker,
     attr_path,
     iter_py_files,
 )
@@ -74,48 +76,115 @@ def run(
         )
 
     def want(name: str) -> bool:
-        if name in ("native", "contract", "drift"):
+        if name in ("native", "contract", "drift", "proto"):
             return only == name or (only is None and repo_scope)
         return only is None or only == name
 
+    # Stale-suppression detection needs every pass to have had its
+    # chance to consult every suppression, so it only fires on the
+    # full default suite at repo scope.
+    detect_stale = repo_scope and only is None
+
     findings: List[Finding] = []
-    edges: Set[Tuple[str, str, str, int]] = set()
-    py_passes = any(want(p) for p in ("lock", "trace", "thread", "net"))
-    if py_passes:
-        for src in iter_py_files(paths, REPO_ROOT, exclude=EXCLUDE):
-            if src.parse_error:
-                findings.append(
-                    Finding(
-                        "meta", "parse-error", src.rel, 0, "<module>",
-                        "parse", f"syntax error: {src.parse_error}",
-                    )
-                )
-                continue
-            findings.extend(src.bad_suppressions)
-            if want("lock"):
-                findings.extend(lockcheck.check_file(src, edges))
-            if want("trace") and any(
-                src.rel.startswith(s) for s in TRACE_SCOPES
-            ):
-                findings.extend(tracecheck.check_file(src))
-            if want("thread"):
-                findings.extend(threadcheck.check_file(src))
-            if want("net"):
-                findings.extend(netcheck.check_file(src))
-        if want("lock"):
-            findings.extend(lockcheck.order_findings(edges))
-    if want("native") or want("contract") or want("drift"):
-        csrcs = iter_c_files(
-            [REPO_ROOT / r for r in NATIVE_ROOTS], REPO_ROOT
+    with SuppressionTracker() as tracker:
+        edges: Set[Tuple[str, str, str, int]] = set()
+        py_passes = any(
+            want(p) for p in ("lock", "trace", "thread", "net")
         )
-        if want("native"):
-            findings.extend(nativecheck.check_files(csrcs))
-        if want("contract"):
-            findings.extend(contractcheck.check(csrcs, REPO_ROOT))
-        if want("drift"):
-            findings.extend(driftcheck.check(REPO_ROOT, csrcs))
+        if py_passes:
+            for src in iter_py_files(paths, REPO_ROOT, exclude=EXCLUDE):
+                if src.parse_error:
+                    findings.append(
+                        Finding(
+                            "meta", "parse-error", src.rel, 0,
+                            "<module>", "parse",
+                            f"syntax error: {src.parse_error}",
+                        )
+                    )
+                    continue
+                findings.extend(src.bad_suppressions)
+                if want("lock"):
+                    findings.extend(lockcheck.check_file(src, edges))
+                if want("trace") and any(
+                    src.rel.startswith(s) for s in TRACE_SCOPES
+                ):
+                    findings.extend(tracecheck.check_file(src))
+                if want("thread"):
+                    findings.extend(threadcheck.check_file(src))
+                if want("net"):
+                    findings.extend(netcheck.check_file(src))
+            if want("lock"):
+                findings.extend(lockcheck.order_findings(edges))
+        if want("native") or want("contract") or want("drift"):
+            csrcs = iter_c_files(
+                [REPO_ROOT / r for r in NATIVE_ROOTS], REPO_ROOT
+            )
+            if want("native"):
+                findings.extend(nativecheck.check_files(csrcs))
+            if want("contract"):
+                findings.extend(contractcheck.check(csrcs, REPO_ROOT))
+            if want("drift"):
+                findings.extend(driftcheck.check(REPO_ROOT, csrcs))
+        if want("proto"):
+            findings.extend(protocheck.check(REPO_ROOT))
+        if detect_stale:
+            findings.extend(
+                baseline_mod.stale_suppressions(tracker, TRACE_SCOPES)
+            )
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
+
+
+# -- --changed ---------------------------------------------------------
+
+
+def changed_lint_paths() -> Optional[List[Path]]:
+    """Lintable Python files changed vs the merge-base with the
+    upstream default branch (plus working-tree edits and untracked
+    files).  Returns None when git can't answer — the caller falls
+    back to the full-repo run, never to a silently-empty one."""
+    import subprocess
+
+    def git(*args: str) -> Optional[str]:
+        try:
+            p = subprocess.run(
+                ["git", *args], cwd=REPO_ROOT, capture_output=True,
+                text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return p.stdout if p.returncode == 0 else None
+
+    base = None
+    for ref in ("origin/main", "origin/master", "main@{upstream}"):
+        out = git("merge-base", "HEAD", ref)
+        if out and out.strip():
+            base = out.strip()
+            break
+    names: Set[str] = set()
+    committed = git("diff", "--name-only", base) if base else None
+    worktree = git("diff", "--name-only", "HEAD")
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if worktree is None and committed is None:
+        return None  # not a git checkout (or git broke): full run
+    for blob in (committed, worktree, untracked):
+        if blob:
+            names.update(ln.strip() for ln in blob.splitlines())
+    out_paths: List[Path] = []
+    for rel in sorted(names):
+        if not rel.endswith(".py"):
+            continue
+        if not any(
+            rel == r or rel.startswith(r.rstrip("/") + "/")
+            for r in LINT_ROOTS
+        ):
+            continue
+        if any(rel.startswith(e) for e in EXCLUDE):
+            continue
+        p = REPO_ROOT / rel
+        if p.exists():  # deleted files have nothing to lint
+            out_paths.append(p)
+    return out_paths
 
 
 # -- --fix-annotations -------------------------------------------------
@@ -369,6 +438,15 @@ def main(argv=None) -> int:
         "--only", choices=PASS_NAMES, default=None,
         help="run a single pass (fast local iteration)",
     )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="incremental mode: lint only files changed vs the "
+        "merge-base with the upstream default branch (plus working-"
+        "tree and untracked files); falls back to the full run when "
+        "git can't answer.  Repo-scope passes (native/contract/drift/"
+        "proto and stale-suppression detection) are skipped — run the "
+        "full suite before shipping.",
+    )
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument(
         "--sarif", nargs="?", const="-", default=None, metavar="FILE",
@@ -377,8 +455,27 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    if args.changed and args.paths:
+        print(
+            "guberlint: --changed and explicit paths are mutually "
+            "exclusive", file=sys.stderr,
+        )
+        return 2
     if args.paths:
         paths = [Path(p).resolve() for p in args.paths]
+    elif args.changed:
+        changed = changed_lint_paths()
+        if changed is None:
+            print(
+                "guberlint: --changed could not consult git — "
+                "falling back to the full-repo run", file=sys.stderr,
+            )
+            paths = [REPO_ROOT / r for r in LINT_ROOTS]
+        elif not changed:
+            print("guberlint: clean (no lintable files changed)")
+            return 0
+        else:
+            paths = changed
     else:
         paths = [REPO_ROOT / r for r in LINT_ROOTS]
     for p in paths:

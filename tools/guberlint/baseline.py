@@ -13,7 +13,7 @@ import json
 from pathlib import Path
 from typing import Iterable, List, Set, Tuple
 
-from tools.guberlint.common import Finding
+from tools.guberlint.common import Finding, SuppressionTracker
 
 _KEYS = ("pass", "rule", "file", "scope", "detail")
 
@@ -66,3 +66,48 @@ def partition(
         (accepted if fp in base else new).append(f)
     stale = sorted(base - current)
     return new, accepted, stale
+
+
+# -- stale suppressions ------------------------------------------------
+#
+# A `# guberlint: ok <pass>` whose pass no longer fires at that site
+# is leftover armor: the defect it silenced was fixed (or moved), and
+# the comment now stands ready to swallow the NEXT real finding on
+# that line.  The driver arms a SuppressionTracker for full-suite
+# runs; here the declared-minus-hit difference becomes findings.
+
+#: Passes whose Python-side suppressions the detector can adjudicate.
+#: ``trace`` only runs on config.TRACE_SCOPES files (handled by the
+#: caller passing those prefixes); ``native``/``contract`` suppressions
+#: live in C sources with their own scanner and are out of scope here.
+_DETECTABLE = ("lock", "trace", "thread", "net", "drift", "proto")
+
+
+def stale_suppressions(
+    tracker: SuppressionTracker, trace_scopes: Tuple[str, ...]
+) -> List[Finding]:
+    out: List[Finding] = []
+    for rel in sorted(tracker.declared):
+        hits = tracker.hits.get(rel, set())
+        for line in sorted(tracker.declared[rel]):
+            for pass_name in sorted(tracker.declared[rel][line]):
+                if pass_name not in _DETECTABLE:
+                    continue
+                if pass_name == "trace" and not rel.startswith(
+                    tuple(trace_scopes)
+                ):
+                    continue  # the trace pass never ran on this file
+                if (line, pass_name) in hits:
+                    continue
+                out.append(
+                    Finding(
+                        "meta", "stale-suppression", rel, line,
+                        "<module>", f"{pass_name}@{line}",
+                        f"'# guberlint: ok {pass_name}' here silenced "
+                        "nothing this run — the finding it suppressed "
+                        "is gone; delete the comment (leftover "
+                        "suppressions swallow the next real finding "
+                        "on this line)",
+                    )
+                )
+    return out

@@ -27,13 +27,50 @@ import re
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-PASS_NAMES = ("lock", "trace", "thread", "net", "native", "contract", "drift")
+PASS_NAMES = (
+    "lock", "trace", "thread", "net", "native", "contract", "drift",
+    "proto",
+)
 
 # Reason separator accepts em/en dash, hyphen, or colon.
 _SUPPRESS_RE = re.compile(
     r"#\s*guberlint:\s*ok\s+(\w+)\s*(?:[—–:-]+\s*(.*))?$"
 )
 _GUARDED_RE = re.compile(r"#\s*guberlint:\s*guarded-by\s+([A-Za-z_][\w.]*)")
+
+
+# -- suppression-usage tracking ----------------------------------------
+#
+# Every pass consults SourceFile.suppressed() only at a site where a
+# finding is otherwise imminent, so "suppressed() returned True" means
+# exactly "this suppression silenced a real finding this run".  The
+# tracker (armed by the driver for full-suite runs) collects declared
+# suppressions and those hits; baseline.stale_suppressions() turns the
+# difference into findings — a `# guberlint: ok <pass>` whose pass no
+# longer fires at that site is leftover armor that would silently
+# swallow the NEXT real finding on that line.
+
+_TRACKER: Optional["SuppressionTracker"] = None
+
+
+class SuppressionTracker:
+    """Context manager collecting declared suppressions and hits for
+    one lint run, keyed by repo-relative path."""
+
+    def __init__(self):
+        # rel -> {line -> {pass}} (post-resolution target lines)
+        self.declared: Dict[str, Dict[int, Set[str]]] = {}
+        # rel -> {(line, pass)} that silenced an imminent finding
+        self.hits: Dict[str, Set[Tuple[int, str]]] = {}
+
+    def __enter__(self) -> "SuppressionTracker":
+        global _TRACKER
+        _TRACKER = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _TRACKER
+        _TRACKER = None
 _GUARD_CLASS_RE = re.compile(
     r"#\s*guberlint:\s*guard\s+([\w,\s]+?)\s+by\s+([A-Za-z_][\w.]*)"
 )
@@ -120,6 +157,10 @@ class SourceFile:
                 # Standalone comment: applies to the next code line.
                 target = self._next_code_line(i)
             self.suppressions.setdefault(target, set()).add(pass_name)
+        if _TRACKER is not None and self.suppressions:
+            decl = _TRACKER.declared.setdefault(self.rel, {})
+            for line, passes in self.suppressions.items():
+                decl.setdefault(line, set()).update(passes)
 
     def _next_code_line(self, after: int) -> int:
         for j in range(after + 1, len(self.lines) + 1):
@@ -129,7 +170,12 @@ class SourceFile:
         return after
 
     def suppressed(self, line: int, pass_name: str) -> bool:
-        return pass_name in self.suppressions.get(line, set())
+        hit = pass_name in self.suppressions.get(line, set())
+        if hit and _TRACKER is not None:
+            _TRACKER.hits.setdefault(self.rel, set()).add(
+                (line, pass_name)
+            )
+        return hit
 
     def suppressed_span(self, node: ast.AST, pass_name: str) -> bool:
         """Suppression on the node's first line (or the `def` line of a

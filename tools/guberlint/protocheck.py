@@ -1,0 +1,158 @@
+"""Pass 8 — protocol invariant drift (``proto``).
+
+gubercheck (tools/gubercheck) model-checks the lease/handoff/
+replication protocols against a registry of named invariants
+(tools/gubercheck/properties.py).  That registry is only trustworthy
+while three surfaces stay in sync, and this pass pins them pairwise:
+
+- ``proto-orphan-annotation`` — a ``# guberlint: invariant <name>``
+  source annotation names a property the registry does not register:
+  the code claims model-checked protection that does not exist.
+- ``proto-doc-unregistered`` — a RESILIENCE.md ``gubercheck: `name` ``
+  marker names an unregistered property: the doc promises a checked
+  bound nothing checks.
+- ``proto-invariant-undocumented`` — a registered property has no
+  RESILIENCE.md marker: the checker enforces a bound operators can't
+  read about (every checked invariant is part of the resilience
+  contract).
+- ``proto-property-unanchored`` — a registered property has no
+  ``# guberlint: invariant`` annotation anywhere in the package: a
+  registry row with no protected site is dead weight (or the guard it
+  described was deleted — either way, drift).
+
+Annotation grammar (STATIC_ANALYSIS.md):
+
+- source:  ``# guberlint: invariant <kebab-name>`` — trailing or
+  standalone comment at the guard/commit site the property protects.
+- doc:     ``gubercheck: `kebab-name` `` anywhere in RESILIENCE.md
+  prose (backticks required: they keep the marker greppable and
+  unambiguous vs ordinary text).
+
+The registry import is cheap by contract: properties.py is stdlib-only
+(no jax/numpy/package imports), so this pass adds no measurable weight
+to the 10 s guberlint budget.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from tools.guberlint.common import Finding, iter_py_files
+from tools.guberlint.config import EXCLUDE, LINT_ROOTS
+
+PASS = "proto"
+
+#: Where the prose contract lives (the doc side of the drift check).
+PROTO_DOC_FILE = "RESILIENCE.md"
+#: The registry module (the anchor for registry-side findings).
+PROTO_REGISTRY = "tools/gubercheck/properties.py"
+
+_ANNOTATION_RE = re.compile(
+    r"#\s*guberlint:\s*invariant\s+([A-Za-z0-9][A-Za-z0-9-]*)"
+)
+_DOC_MARKER_RE = re.compile(r"gubercheck:\s*`([A-Za-z0-9][A-Za-z0-9-]*)`")
+
+
+def _registry() -> Dict[str, object]:
+    from tools.gubercheck import properties as props
+
+    return props.registry()
+
+
+def _register_line(repo_root: Path, name: str) -> int:
+    """Line of the property's register(...) call, for anchoring
+    registry-side findings somewhere a human can act on."""
+    path = repo_root / PROTO_REGISTRY
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        return 0
+    for i, raw in enumerate(lines, start=1):
+        if f'"{name}"' in raw or f"'{name}'" in raw:
+            return i
+    return 0
+
+
+def check(repo_root: Path, paths=None) -> List[Finding]:
+    findings: List[Finding] = []
+    registered = _registry()
+
+    # -- source annotations -------------------------------------------
+    anchored: Dict[str, List[Tuple[str, int]]] = {}
+    roots = paths if paths is not None else [
+        repo_root / r for r in LINT_ROOTS
+    ]
+    for src in iter_py_files(roots, repo_root, exclude=EXCLUDE):
+        for lineno, raw in enumerate(src.lines, start=1):
+            m = _ANNOTATION_RE.search(raw)
+            if not m:
+                continue
+            name = m.group(1)
+            anchored.setdefault(name, []).append((src.rel, lineno))
+            if name not in registered and not src.suppressed(
+                lineno, PASS
+            ):
+                findings.append(
+                    Finding(
+                        PASS, "proto-orphan-annotation", src.rel,
+                        lineno, "<module>", name,
+                        f"invariant annotation {name!r} matches no "
+                        "property registered in "
+                        f"{PROTO_REGISTRY} — the code claims "
+                        "model-checked protection that does not exist "
+                        "(register it, or fix the name)",
+                    )
+                )
+
+    # -- doc markers ---------------------------------------------------
+    documented: Dict[str, int] = {}
+    doc_path = repo_root / PROTO_DOC_FILE
+    if doc_path.exists():
+        for lineno, raw in enumerate(
+            doc_path.read_text().splitlines(), start=1
+        ):
+            for m in _DOC_MARKER_RE.finditer(raw):
+                name = m.group(1)
+                documented.setdefault(name, lineno)
+                if name not in registered:
+                    findings.append(
+                        Finding(
+                            PASS, "proto-doc-unregistered",
+                            PROTO_DOC_FILE, lineno, "<module>", name,
+                            f"{PROTO_DOC_FILE} promises a checked "
+                            f"bound `{name}` but no such property is "
+                            f"registered in {PROTO_REGISTRY} — the "
+                            "doc claims coverage nothing checks",
+                        )
+                    )
+
+    # -- registry completeness ----------------------------------------
+    for name in sorted(registered):
+        if name not in documented:
+            findings.append(
+                Finding(
+                    PASS, "proto-invariant-undocumented",
+                    PROTO_REGISTRY, _register_line(repo_root, name),
+                    "<module>", name,
+                    f"property {name!r} is registered and checked but "
+                    f"{PROTO_DOC_FILE} has no 'gubercheck: `{name}`' "
+                    "marker — every checked invariant is part of the "
+                    "documented resilience contract",
+                )
+            )
+        if name not in anchored:
+            findings.append(
+                Finding(
+                    PASS, "proto-property-unanchored",
+                    PROTO_REGISTRY, _register_line(repo_root, name),
+                    "<module>", name,
+                    f"property {name!r} has no '# guberlint: "
+                    f"invariant {name}' annotation anywhere under "
+                    f"{'/'.join(LINT_ROOTS)} — a registry row with no "
+                    "protected site is drift (annotate the guard it "
+                    "checks, or delete the row)",
+                )
+            )
+    return findings
