@@ -266,6 +266,15 @@ def main() -> int:
             # serving overhead < 2% and captures the live burn-rate /
             # admission-headroom columns for the trend.
             result = _run_fleetobs(np, platform)
+        elif MODE == "zipfpaged":
+            # Paged-state A/B (ROADMAP item 1, PERF.md §30): zipf over
+            # a key space ≥10x the resident page budget through the
+            # page-table plane (fault rate + spill p99 from the
+            # plane's counters), a same-session GUBER_PAGED=0 dense
+            # control at equal resident load (the ≤10% hot-path bar),
+            # and the dense arm's capacity wall recorded under the
+            # full key space.
+            result = _run_zipfpaged(np, platform)
         elif MODE == "herdtrace":
             # Same-session tracing A/B: the herdfast workload once with
             # tracing disabled and once with the in-memory recorder +
@@ -421,6 +430,215 @@ def _run_engine(np, platform: str) -> dict:
         "p50_ms": round(p50_ms, 3),
         "p99_ms": round(p99_ms, 3),
         "platform": platform,
+    }
+
+
+def _run_zipfpaged(np, platform: str) -> dict:
+    """Paged-state A/B (PERF.md §30, ROADMAP item 1): zipf traffic
+    over a key space ≥10× the resident page budget through the
+    GUBER_PAGED plane, with a same-session GUBER_PAGED=0 dense
+    control.
+
+    Phases (each MEASURE_SECONDS):
+      1. paged fill — populate the whole key space once (sequential:
+         ascending slots pack pages contiguously, so the fill pays
+         ~1 fault per page, not per key);
+      2. paged zipf — the headline number: decisions/s with the tail
+         faulting cold pages in and out, fault-rate and spill-p99
+         recorded from the plane's own counters (never silent);
+      3. hot A/B — a resident-sized working set through BOTH arms at
+         equal resident load (the ≤10% acceptance bar);
+      4. dense churn — the dense arm faced with the full key space:
+         it cannot hold it (device array fixed at boot), so the
+         intern table evicts and every evicted bucket's state is
+         FORGOTTEN — the capacity wall this plane removes, recorded.
+    """
+    batch = min(BATCH, int(os.environ.get("BENCH_PAGED_BATCH", 1024)))
+    page_size = int(os.environ.get("BENCH_PAGED_PAGE", 64))
+    frames = batch  # a full batch of unique keys never segments
+    resident_rows = frames * page_size
+    ratio = max(10, int(os.environ.get("BENCH_PAGED_RATIO", 10)))
+    n_keys = resident_rows * ratio
+    alpha = ZIPF if ZIPF > 0 else 1.2
+    from gubernator_tpu.core.engine import DecisionEngine
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("GUBER_PAGED", "GUBER_PAGE_SIZE", "GUBER_PAGED_RESIDENT")
+    }
+
+    def _engine(paged: bool) -> DecisionEngine:
+        if paged:
+            os.environ["GUBER_PAGED"] = "1"
+            os.environ["GUBER_PAGE_SIZE"] = str(page_size)
+            os.environ["GUBER_PAGED_RESIDENT"] = str(frames)
+        else:
+            os.environ["GUBER_PAGED"] = "0"
+        try:
+            return DecisionEngine(
+                capacity=n_keys if paged else resident_rows,
+                max_kernel_width=max(8192, batch),
+            )
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def _cols():
+        return dict(
+            behavior=np.zeros(batch, dtype=np.int32),
+            hits=np.ones(batch, dtype=np.int64),
+            limit=np.full(batch, 1_000_000, dtype=np.int64),
+            duration=np.full(batch, 3_600_000, dtype=np.int64),
+            burst=np.full(batch, 1_000_000, dtype=np.int64),
+        )
+
+    def _batches(idx_list):
+        return [
+            dict(
+                keys=[b"pg_k%d" % i for i in idx.tolist()],
+                algo=(np.asarray(idx) % 2).astype(np.int32),
+                **_cols(),
+            )
+            for idx in idx_list
+        ]
+
+    rng = np.random.default_rng(0)
+    zipf_batches = _batches(
+        (rng.zipf(alpha, batch) - 1) % n_keys for _ in range(64)
+    )
+    hot_keys = resident_rows // 2  # well inside the frames, both arms
+    hot_batches = _batches(
+        (np.arange(batch, dtype=np.int64) + b * batch) % hot_keys
+        for b in range(hot_keys // batch)
+    )
+
+    def _measure(engine, batches, seconds) -> tuple[float, int]:
+        from collections import deque
+
+        pending = deque()
+        n_done = 0
+        start = time.perf_counter()
+        i = 0
+        while True:
+            pending.append(
+                engine.apply_columnar(
+                    **batches[i % len(batches)], want_async=True
+                )
+            )
+            i += 1
+            if len(pending) > PIPELINE_DEPTH:
+                pending.popleft().get()
+                n_done += batch
+            if time.perf_counter() - start >= seconds:
+                break
+        while pending:
+            pending.popleft().get()
+            n_done += batch
+        return n_done / (time.perf_counter() - start), n_done
+
+    errors = 0
+    paged = _engine(paged=True)
+    assert paged.paging is not None and paged.capacity == resident_rows
+
+    # Phase 1: fill the whole key space once, sequentially.
+    t_fill = time.perf_counter()
+    for lo in range(0, n_keys, batch):
+        idx = np.arange(lo, min(lo + batch, n_keys), dtype=np.int64)
+        b = _batches([idx % n_keys])[0]
+        for col in b:
+            if col != "keys":
+                b[col] = b[col][: len(idx)]
+        paged.apply_columnar(**b)
+    fill_s = time.perf_counter() - t_fill
+    fill_faults = paged.paging.faults
+
+    # Phase 2: zipf over the full key space (latency sync, then
+    # pipelined throughput).  Warm the duplicate-collapse program
+    # family first — zipf batches repeat hot keys, a shape the
+    # sequential fill never compiled.
+    for i in range(WARMUP_BATCHES):
+        paged.apply_columnar(**zipf_batches[i % len(zipf_batches)])
+    lat_n = min(LATENCY_BATCHES, 50)
+    lat = np.empty(lat_n, dtype=np.float64)
+    for i in range(lat_n):
+        t0 = time.perf_counter()
+        paged.apply_columnar(**zipf_batches[i % len(zipf_batches)])
+        lat[i] = time.perf_counter() - t0
+    d0 = paged.paging.faults
+    n0 = paged.requests_total
+    zipf_rate, zipf_done = _measure(paged, zipf_batches, MEASURE_SECONDS)
+    zipf_faults = paged.paging.faults - d0
+    assert paged.requests_total - n0 == zipf_done
+
+    # Phase 3a: paged hot path (first pass faults the working set in,
+    # then measure resident-only).
+    for b in hot_batches:
+        paged.apply_columnar(**b)
+    f_hot0 = paged.paging.faults
+    hot_paged_rate, _ = _measure(paged, hot_batches, MEASURE_SECONDS)
+    hot_phase_faults = paged.paging.faults - f_hot0
+
+    plane = paged.paging
+    paged_stats = {
+        "page_size": page_size,
+        "frames": frames,
+        "resident_rows": resident_rows,
+        "logical_keys": n_keys,
+        "keyspace_ratio": ratio,
+        "resident_ratio": round(resident_rows / n_keys, 4),
+        "fill_seconds": round(fill_s, 2),
+        "fill_faults": fill_faults,
+        "zipf_faults": zipf_faults,
+        "fault_rate": round(zipf_faults / max(zipf_done, 1), 6),
+        "faults": plane.faults,
+        "spills": plane.spills,
+        "refills": plane.refills,
+        "spill_p99_ms": round(plane.spill_duration.p99() * 1e3, 3),
+        "refill_p99_ms": round(plane.refill_wait.p99() * 1e3, 3),
+        "fault_p99_ms": round(plane.fault_duration.p99() * 1e3, 3),
+        "hot_phase_faults": hot_phase_faults,
+    }
+
+    # Phase 3b + 4: the dense arm — equal resident footprint.
+    dense = _engine(paged=False)
+    assert dense.paging is None and dense.capacity == resident_rows
+    for b in hot_batches:
+        dense.apply_columnar(**b)
+    hot_dense_rate, _ = _measure(dense, hot_batches, MEASURE_SECONDS)
+    churn_rate, _ = _measure(dense, zipf_batches, MEASURE_SECONDS)
+
+    hot_delta_pct = round(
+        100.0 * (hot_paged_rate - hot_dense_rate) / hot_dense_rate, 2
+    )
+    return {
+        "metric": "rate-limit decisions/sec, paged device state, zipf "
+        f"alpha={alpha} over {n_keys} keys ({ratio}x the "
+        f"{resident_rows} resident rows; batch={batch})",
+        "value": round(zipf_rate, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(zipf_rate / BASELINE_DECISIONS_PER_SEC, 2),
+        "p50_ms": round(float(np.percentile(lat, 50) * 1e3), 3),
+        "p99_ms": round(float(np.percentile(lat, 99) * 1e3), 3),
+        "platform": platform,
+        "errors": errors,
+        "paged": paged_stats,
+        "hot": {
+            "working_set": hot_keys,
+            "paged_value": round(hot_paged_rate, 1),
+            "dense_value": round(hot_dense_rate, 1),
+            "delta_pct": hot_delta_pct,
+        },
+        "dense": {
+            "keyspace_bound": resident_rows,
+            "churn_value": round(churn_rate, 1),
+            "note": "dense arm's device array is fixed at boot: under "
+            f"the full {n_keys}-key space the intern table evicts and "
+            "every evicted bucket is forgotten (state loss), the "
+            "capacity wall the paged plane removes",
+        },
     }
 
 

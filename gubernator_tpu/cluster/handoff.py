@@ -39,6 +39,14 @@ moved key is implicitly forfeited and the bound still holds — the old
 owner admitted ≤ limit before dying, the new owner admits ≤ limit
 fresh.  tests/test_membership.py pins both the zero-forfeit drain and
 the kill-during-handoff bound deterministically.
+
+Paged state (GUBER_PAGED, core/paging.py) changes neither side of the
+wire: `export_items` streams resident rows from the device snapshot
+and cold rows straight from the host page store (same leaky 32.32
+fidelity — the host copy IS the packed words), and the receiver's
+bulk-load restore splits per row — resident pages scatter on device,
+cold pages pack host-side — so a handoff of a mostly-cold key range
+never faults the whole range through the receiver's resident frames.
 """
 
 from __future__ import annotations

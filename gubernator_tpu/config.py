@@ -201,6 +201,15 @@ KNOWN_ENV_KNOBS = (
     "GUBER_MULTI_THREADS",    # core/native.py: native scheduler threads
     "GUBER_SHARDS_SINGLE_PROGRAM",  # parallel/sharded_engine.py: one
                               # pjit program across shards vs per-shard
+    # Paged device bucket state (core/paging.py; PERF.md §30).
+    "GUBER_PAGED",            # config.env_paged → core/engine.py: page
+                              # the bucket state behind a page table
+                              # (0 keeps the dense plane, the A/B arm)
+    "GUBER_PAGE_SIZE",        # config.env_page_size → core/engine.py:
+                              # bucket rows per device page (pow2 ≥ 16)
+    "GUBER_PAGED_RESIDENT",   # config.env_paged_resident →
+                              # core/engine.py: resident device frames
+                              # (pages); 0 = every page resident
     # Build / test infra.
     "GUBER_NATIVE_SAN",       # core/native_build.py: TSan/ASan build tag
     # Process bootstrap (read before config loads).
@@ -264,6 +273,37 @@ def env_window_depth(default: int = 2) -> int:
     cannot drift."""
     try:
         return int(os.environ.get("GUBER_WINDOW_DEPTH", "") or default)
+    except ValueError:
+        return default
+
+
+def env_paged() -> bool:
+    """GUBER_PAGED: page the device bucket state behind a page table
+    with LRU host spill (core/paging.py).  Default off — the dense
+    plane is the A/B control arm (PERF.md §30)."""
+    return os.environ.get("GUBER_PAGED", "").strip() == "1"
+
+
+def env_page_size(default: int = 512) -> int:
+    """GUBER_PAGE_SIZE: bucket rows per device page.  Must be a power
+    of two ≥ 16 (slot→(page,row) splits are shift/mask on the
+    translate hot path; the clear/restore scatter floor is 16);
+    anything else falls back to the default."""
+    try:
+        v = int(os.environ.get("GUBER_PAGE_SIZE", "") or default)
+    except ValueError:
+        return default
+    if v < 16 or v & (v - 1):
+        return default
+    return v
+
+
+def env_paged_resident(default: int = 0) -> int:
+    """GUBER_PAGED_RESIDENT: device frames (resident pages).  0 keeps
+    every page resident — paged layout, dense footprint; a smaller
+    value is what buys the 10-100x key space over device memory."""
+    try:
+        return max(0, int(os.environ.get("GUBER_PAGED_RESIDENT", "") or default))
     except ValueError:
         return default
 

@@ -80,6 +80,25 @@ def _pad_size(n: int, floor: int = 64) -> int:
     return size
 
 
+def _segments_by_unique_keys(keys: List, budget: int) -> List[tuple]:
+    """Split a batch into contiguous arrival-order segments of at most
+    `budget` UNIQUE keys each (paged mode: unique pages ≤ unique keys,
+    so every segment's working set fits the resident frames).  Returns
+    [(lo, hi)] half-open ranges covering the batch."""
+    segs: List[tuple] = []
+    lo = 0
+    seen: set = set()
+    for i, k in enumerate(keys):
+        if k not in seen:
+            if len(seen) >= budget:
+                segs.append((lo, i))
+                lo = i
+                seen = set()
+            seen.add(k)
+    segs.append((lo, len(keys)))
+    return segs
+
+
 class _ZerosCache:
     """Reusable zero arrays (columnar no-greg fast path)."""
 
@@ -327,16 +346,42 @@ class DecisionEngine:
         from gubernator_tpu.platform_guard import disable_cpu_persistent_cache
 
         disable_cpu_persistent_cache()
+        # Paged device state (GUBER_PAGED; core/paging.py, PERF.md
+        # §30): `capacity` becomes the LOGICAL key capacity — the
+        # intern table's size — while the device array shrinks to the
+        # resident frames.  Everything below this block that says
+        # `capacity` means DEVICE capacity: kernel shapes, padding
+        # sentinels, pump no-op buffers, and the sweep all keep their
+        # dense-plane contracts at the (smaller) resident size, and
+        # the host translates logical slots → device rows per batch.
+        from gubernator_tpu.config import (
+            env_page_size,
+            env_paged,
+            env_paged_resident,
+        )
+
+        self.logical_capacity = capacity
+        if env_paged():
+            from gubernator_tpu.core.paging import PagePlane
+
+            self.paging: Optional["PagePlane"] = PagePlane(
+                capacity, env_page_size(), env_paged_resident()
+            )
+            capacity = self.paging.device_capacity
+        else:
+            self.paging = None
         self.capacity = capacity
         self.clock = clock
         self._device = device
         self.max_kernel_width = max_kernel_width
         # Native C++ table when buildable (batch schedule() fast path),
         # Python InternTable otherwise — behaviorally identical
-        # (fuzz-tested in tests/test_native_table.py).
+        # (fuzz-tested in tests/test_native_table.py).  Sized at the
+        # LOGICAL capacity: key↔slot lives entirely on the host, so in
+        # paged mode it grows 10-100x past the device array.
         from gubernator_tpu.core.native import make_intern_table
 
-        self.table = make_intern_table(capacity)
+        self.table = make_intern_table(self.logical_capacity)
         self.store = store
         with jax.default_device(device) if device else nullcontext():
             self._state: BucketState = make_state(capacity)  # guberlint: guarded-by _lock
@@ -508,6 +553,21 @@ class DecisionEngine:
             return
         keys = [requests[i].hash_key() for i in valid_idx]
 
+        # Paged mode: a batch's working set must fit the resident
+        # frames (unique pages ≤ unique keys).  Oversized batches
+        # split into contiguous arrival-order segments processed
+        # sequentially — per-slot ordering holds because each
+        # segment's responses materialize before the next dispatches.
+        if self.paging is not None and len(valid_idx) > self.paging.frames:
+            segs = _segments_by_unique_keys(keys, self.paging.frames)
+            if len(segs) > 1:
+                for lo, hi in segs:
+                    self._apply_valid(
+                        requests, valid_idx[lo:hi], greg_dur, greg_exp,
+                        now_ms, responses,
+                    )
+                return
+
         # Split into rounds: the k-th operation on a slot → round k, so
         # each device step touches a slot at most once (see module
         # docstring).  Eviction clears participate in the same per-slot
@@ -554,6 +614,14 @@ class DecisionEngine:
                     if item is not None and item.value is not None:
                         restore_rounds.setdefault(k, []).append((slot, item))
 
+        # Paged translation: fault the batch's pages resident, then
+        # hand the dispatch machinery DEVICE rows — the kernels (XLA,
+        # interpret, and Pallas alike) see the same dense indexing
+        # they always did.  The intern table keeps LOGICAL slots.
+        lslots = slots
+        if self.paging is not None:
+            slots = self.paging.translate(self, slots)
+
         host_expire = np.zeros(len(valid_idx), dtype=_I64)
         with span(
             "engine.batch", batch=len(valid_idx), rounds=len(rounds)
@@ -566,7 +634,7 @@ class DecisionEngine:
                     responses, host_expire, clear_rounds,
                 )
             ):
-                self.table.set_expiry(slots, host_expire)
+                self.table.set_expiry(lslots, host_expire)
                 return
             for k in sorted(rounds):
                 members = rounds[k]
@@ -596,7 +664,7 @@ class DecisionEngine:
                     self.rounds_total += 1
 
         # Refresh the host TTL mirror for eviction ordering.
-        self.table.set_expiry(slots, host_expire)
+        self.table.set_expiry(lslots, host_expire)
 
         if self.store is not None:
             self._write_through(
@@ -671,7 +739,23 @@ class DecisionEngine:
 
     def _apply_clears(self, cleared: np.ndarray) -> None:  # guberlint: holds _lock
         """Eviction clears: a separate tiny scatter so the apply
-        kernel's compiled shapes never depend on eviction pressure."""
+        kernel's compiled shapes never depend on eviction pressure.
+        `cleared` holds LOGICAL slots in paged mode — resident pages
+        clear on device, non-resident ones drop the occupied bit in
+        the host page store (no device work, no fault)."""
+        if self.paging is not None:
+            resident = (
+                self.paging.frame_of[cleared >> self.paging.page_shift] >= 0
+            )
+            cold = cleared[~resident]
+            if len(cold):
+                self._flush_pump()
+                self.paging.clear_host_slots(cold.astype(np.int64))
+            cleared = self.paging.resident_rows(
+                cleared[resident].astype(np.int64)
+            )
+            if len(cleared) == 0:
+                return
         self._flush_pump()
         csize = _pad_size(len(cleared), floor=16)
         c = np.arange(
@@ -685,8 +769,30 @@ class DecisionEngine:
 
     def _apply_restores(self, restores: List[tuple]) -> None:  # guberlint: holds _lock
         """Hydrate store-provided bucket values into fresh slots —
-        one batched device scatter (see build_restore_record)."""
+        one batched device scatter (see build_restore_record).  Slots
+        are LOGICAL in paged mode: rows landing in resident pages
+        scatter on device as before; rows whose page is cold pack
+        straight into the host page store, so a bulk restore
+        (checkpoint load, handoff receive) never faults the whole key
+        space through the resident frames just to spill it again."""
         self._flush_pump()
+        if self.paging is not None:
+            lslots = np.asarray([s for s, _ in restores], dtype=np.int64)
+            resident = (
+                self.paging.frame_of[lslots >> self.paging.page_shift] >= 0
+            )
+            cold = [r for r, ok in zip(restores, resident) if not ok]
+            if cold:
+                self.paging.host_restore(cold)
+            hot = [r for r, ok in zip(restores, resident) if ok]
+            if not hot:
+                return
+            dev = self.paging.resident_rows(
+                np.asarray([s for s, _ in hot], dtype=np.int64)
+            )
+            restores = [
+                (int(d), item) for d, (_s, item) in zip(dev, hot)
+            ]
         rec = build_restore_record(restores, self.capacity)
         self._state = load_slots(
             self._state,
@@ -821,12 +927,24 @@ class DecisionEngine:
             c = int(count)
             if c:
                 freed_slots = np.asarray(order[:c]).astype(np.int64) + start
+                if self.paging is not None:
+                    # Device rows → logical slots: the intern table
+                    # only ever sees the logical space.
+                    freed_slots = self.paging.logical_of_device(freed_slots)
                 self.table.release_slots(freed_slots)
             return c
 
         with self._lock, span("engine.sweep") as s:
             self._flush_pump()
             freed = windowed_sweep(self, self.capacity, now_ms, max_windows, release)
+            if self.paging is not None:
+                # Non-resident pages never reach the device sweep; the
+                # host copy tracks their TTLs (core/paging.sweep_host)
+                # so cold expired rows free WITHOUT faulting in.
+                host_freed = self.paging.sweep_host(now_ms)
+                if len(host_freed):
+                    self.table.release_slots(host_freed)
+                    freed += len(host_freed)
             if s is not None:
                 s.set_attribute("freed", freed)
             return freed
@@ -905,6 +1023,27 @@ class DecisionEngine:
         greg_dur, greg_exp, greg_mask, now_ms,
     ):
         n = len(keys)
+        # Paged mode: segment oversized batches so each segment's
+        # working set fits the resident frames (mirrors _apply_valid;
+        # pieces from sub-batches re-offset into the caller's lanes).
+        if self.paging is not None and n > self.paging.frames:
+            key_list = keys.to_list() if isinstance(keys, PackedKeys) else keys
+            segs = _segments_by_unique_keys(key_list, self.paging.frames)
+            if len(segs) > 1:
+                pieces: List[tuple] = []
+                for lo, hi in segs:
+                    sub = self._apply_columnar_locked(
+                        key_list[lo:hi], algo[lo:hi], behavior[lo:hi],
+                        hits[lo:hi], limit[lo:hi], duration[lo:hi],
+                        burst[lo:hi],
+                        None if greg_dur is None else greg_dur[lo:hi],
+                        None if greg_exp is None else greg_exp[lo:hi],
+                        greg_mask[lo:hi], now_ms,
+                    )
+                    for p in sub._pieces:
+                        pieces.append((p[0], p[1] + lo) + p[2:])
+                return PendingColumnar(self, pieces, limit, n)
+
         if isinstance(keys, PackedKeys) and hasattr(self.table, "schedule_packed"):
             slots, rounds_arr, evicted, evict_rounds = self.table.schedule_packed(
                 keys.buf, keys.offsets, now_ms
@@ -940,6 +1079,13 @@ class DecisionEngine:
             greg_dur = _ZEROS_CACHE.get(n)
             greg_exp = greg_dur
 
+        # Paged translation (see _apply_valid): collapse/dispatch pack
+        # DEVICE rows; the intern table keeps LOGICAL slots.  Eviction
+        # clears stay logical — _apply_clears owns that split.
+        lslots = slots
+        if self.paging is not None:
+            slots = self.paging.translate(self, slots)
+
         max_round = int(rounds_arr.max()) if n else 0
         pieces: Optional[List[tuple]] = None
         if max_round > 0:
@@ -959,7 +1105,7 @@ class DecisionEngine:
             )
 
         expires = np.where(greg_mask, greg_exp, now_ms + duration)
-        self.table.set_expiry(slots, expires.astype(_I64))
+        self.table.set_expiry(lslots, expires.astype(_I64))
         return PendingColumnar(self, pieces, limit, n)
 
     def _uniform_params(
@@ -1310,38 +1456,45 @@ class DecisionEngine:
             from gubernator_tpu.ops.bucket_kernel import unpack_state_host
 
             u = unpack_state_host(self._state)
-            occ = u["occupied"]
-            algo = u["algo"]
-            status = u["status"]
-            limit = u["limit"]
-            remaining = u["remaining"]
-            remf_hi = u["remf_hi"]
-            remf_lo = u["remf_lo"]
-            duration = u["duration"]
-            t0 = u["t0"]
-            expire = u["expire"]
-            burst = u["burst"]
-            invalid = u["invalid"]
-            slots = np.nonzero(occ)[0]
-            keys = [self.table.key_for_slot(int(sl)) for sl in slots]
+            slots = np.nonzero(u["occupied"])[0]
+            if self.paging is not None:
+                lsl = self.paging.logical_of_device(slots.astype(np.int64))
+            else:
+                lsl = slots
+            rows = [
+                (u, int(sl), self.table.key_for_slot(int(ls)))
+                for sl, ls in zip(slots, lsl)
+            ]
+            if self.paging is not None:
+                # Cold pages export straight from the host copy (bit-
+                # identical words) — a full-cache export must never
+                # fault the whole key space through resident frames.
+                for page in self.paging.nonresident_used_pages():
+                    hu = self.paging.host_rows(page)
+                    base = page << self.paging.page_shift
+                    for r in np.nonzero(hu["occupied"])[0]:
+                        rows.append(
+                            (hu, int(r),
+                             self.table.key_for_slot(base + int(r)))
+                        )
         from gubernator_tpu.store import item_from_record
 
-        for sl, key in zip(slots, keys):
+        for u, sl, key in rows:
             if key is None:
                 continue
             yield item_from_record(
                 key=key,
-                algorithm=int(algo[sl]),
-                status=int(status[sl]),
-                limit=int(limit[sl]),
-                remaining=int(remaining[sl]),
-                remf_hi=int(remf_hi[sl]),
-                remf_lo=int(remf_lo[sl]),
-                duration=int(duration[sl]),
-                t0=int(t0[sl]),
-                expire_at=int(expire[sl]),
-                burst=int(burst[sl]),
-                invalid_at=int(invalid[sl]),
+                algorithm=int(u["algo"][sl]),
+                status=int(u["status"][sl]),
+                limit=int(u["limit"][sl]),
+                remaining=int(u["remaining"][sl]),
+                remf_hi=int(u["remf_hi"][sl]),
+                remf_lo=int(u["remf_lo"][sl]),
+                duration=int(u["duration"][sl]),
+                t0=int(u["t0"][sl]),
+                expire_at=int(u["expire"][sl]),
+                burst=int(u["burst"][sl]),
+                invalid_at=int(u["invalid"][sl]),
             )
 
     def save(self, loader) -> None:

@@ -56,6 +56,15 @@ Enable/disable with GUBER_LEDGER (default on); knobs:
 GUBER_LEDGER_LEASE (credit budget), GUBER_LEDGER_LEASE_TTL,
 GUBER_LEDGER_HOT_THRESHOLD (hits/1s window before a key leases),
 GUBER_LEDGER_KEYS (entry LRU capacity), GUBER_LEDGER_SETTLE_INTERVAL.
+
+Paged state (GUBER_PAGED, core/paging.py) is invisible here by
+construction: the ledger addresses buckets by KEY (grants, returns,
+and invalidations all flow through engine batches keyed by hash key),
+never by slot, so a leased key whose page spills cold simply pays one
+fault when its return row next reaches the engine — the credit
+algebra is untouched.  Better: a leased hot key sends NO per-hit
+engine traffic, which keeps its page's clock-hand ref bit cold only
+while the device genuinely isn't needed.
 """
 
 from __future__ import annotations

@@ -12,6 +12,11 @@ mutex and a lease lives in exactly one tier at a time.
 The .so is the combined h2_server build (native_build._EXTRA_SOURCES):
 the server calls dp_try_serve in-image; Python talks to the same table
 through these entry points.
+
+Like the Python ledger, the plane is paged-state-agnostic
+(GUBER_PAGED, core/paging.py): its table is keyed by hash key and its
+traffic reaches the engine as keyed batch rows, so device page
+residency never appears in this interface.
 """
 
 from __future__ import annotations

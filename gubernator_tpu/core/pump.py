@@ -21,6 +21,13 @@ Queued work is applied lazily: every observation of engine state
 (ticket fetch, sweep, save) forces a flush, so results are never
 stale; `now_ms` rides inside each packed buffer, so delayed
 application cannot shift timestamps.
+
+Paged state (GUBER_PAGED, core/paging.py) rides this contract
+unchanged: packed buffers carry DEVICE rows (the engine translates
+logical slots before packing), and a page fault's spill/refill counts
+as "other state access" — PagePlane.translate flushes the queue
+before moving any page, so queued rounds never read a frame after its
+page was swapped out from under them.
 """
 
 from __future__ import annotations

@@ -23,6 +23,13 @@ next to the per-RPC fixed cost), so the program universe is
 The reference has no analog: its decisions are host-memory reads
 (lrucache.go); this is the TPU-first replacement for "the cache is in
 HBM on the far side of a high-latency link".
+
+Page spills (GUBER_PAGED, core/paging.py) ride the same combiner: a
+cold page's [12, page_size] word gather registers a Ticket like any
+step output, so an eviction that lands while decision readbacks are
+outstanding shares their transfer RPC instead of paying its own
+25-40ms (the spill is itself one more same-shape handle in the
+stack).
 """
 
 from __future__ import annotations

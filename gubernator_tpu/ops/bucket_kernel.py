@@ -1575,6 +1575,60 @@ def _load_slots_impl(state: BucketState, rec: SlotRecord) -> BucketState:
 load_slots = jax.jit(_load_slots_impl, donate_argnums=(0,))
 
 
+# ----------------------------------------------------------------------
+# Paged-state page transfer helpers (core/paging.py; PERF.md §30).
+#
+# A page is `page_size` consecutive rows of every state column.  Spill
+# and refill move the RAW packed words — the same 12 int32/uint32
+# columns the kernels read — so an evict→spill→refill roundtrip is
+# bit-exact by construction (including the leaky 32.32 remaining and
+# the folded hi-word packings; no decode/re-encode on the path).  One
+# [PAGE_WORD_ROWS, page_size] int32 block per page keeps it to ONE d2h
+# (spill, via the readback combiner) or one h2d + one donated in-place
+# update (refill).  `start` is a traced device-row scalar, so each
+# page size compiles exactly one gather and one load program.
+
+PAGE_WORD_ROWS = len(BucketState._fields)  # 12 — one row per column
+
+
+# guberlint: shapes state fixed at device capacity; start scalar device row; page_size static — one program per page size
+@functools.partial(jax.jit, static_argnums=(2,))
+def gather_page_words(
+    state: BucketState, start: jax.Array, page_size: int
+) -> jax.Array:
+    """One page's raw column words as [PAGE_WORD_ROWS, page_size]
+    int32 (uint32 columns bitcast, not converted)."""
+    rows = []
+    for name in BucketState._fields:
+        col = getattr(state, name)
+        sl = jax.lax.dynamic_slice_in_dim(col, start, page_size)
+        if sl.dtype != jnp.int32:
+            sl = jax.lax.bitcast_convert_type(sl, jnp.int32)
+        rows.append(sl)
+    return jnp.stack(rows)
+
+
+# guberlint: shapes words fixed [PAGE_WORD_ROWS, page_size] per plane; state fixed at device capacity
+def _load_page_words_impl(
+    state: BucketState, start: jax.Array, words: jax.Array
+) -> BucketState:
+    """Write a page's raw words back into the state columns at device
+    row `start` — the refill half of the spill roundtrip."""
+    new = {}
+    for i, name in enumerate(BucketState._fields):
+        col = getattr(state, name)
+        row = words[i]
+        if col.dtype != jnp.int32:
+            row = jax.lax.bitcast_convert_type(row, col.dtype)
+        new[name] = jax.lax.dynamic_update_slice_in_dim(
+            col, row, start, axis=0
+        )
+    return BucketState(**new)
+
+
+load_page_words = jax.jit(_load_page_words_impl, donate_argnums=(0,))
+
+
 def batch_input_from_numpy(
     slot: np.ndarray,
     algo: np.ndarray,

@@ -29,6 +29,14 @@ same math.  In interpret mode (`interpret=True`) the kernel runs as
 traced jax ops under jit on ANY backend, which is how CPU CI pins the
 kernel bit-equal to `models/spec.py` (tests/test_fused_parity.py)
 without TPU hardware.  `GUBER_FUSED` selects the mode (core/engine).
+
+Paged state (GUBER_PAGED, core/paging.py) needs NO kernel changes:
+the engine translates logical slots to device rows (frame<<shift|row)
+on the host before packing, so the packed buffer this kernel gathers
+through already indexes the resident frame array — XLA, interpret,
+and Pallas tiers all lower through the page table's indirection by
+construction, exactly the paged-KV discipline of the attention kernel
+this program is shaped after.
 """
 
 from __future__ import annotations

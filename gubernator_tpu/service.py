@@ -421,6 +421,12 @@ class V1Instance:
         pump = getattr(engine, "_pump", None)
         if pump is not None:
             self.stage_timers["device.window_wait"] = pump.window_wait
+        # Paged plane (GUBER_PAGED; PERF.md §30): device.page_fault is
+        # the per-fault spill+refill wall time a non-resident key pays
+        # before its round can dispatch.
+        paging = getattr(engine, "paging", None)
+        if paging is not None:
+            self.stage_timers["device.page_fault"] = paging.fault_duration
         # Optional group-commit window for client wire batches
         # (net/wire_window.py; conf.local_batch_wait > 0 enables).
         self._wire_window = None
@@ -464,6 +470,32 @@ class V1Instance:
         from gubernator_tpu.utils import hotkeys as _hotkeys
 
         self.hotkeys = _hotkeys.from_env()
+        # Feed the paged plane's clock-hand heat ranking from the same
+        # sketch (core/paging._maybe_refresh_hot): pages holding top-K
+        # keys get one eviction grace pass.  The provider runs under
+        # the engine lock, so the contains→intern pair is atomic (the
+        # native table has no read-only key→slot lookup; intern on a
+        # present key is a pure lookup).
+        if paging is not None and self.hotkeys is not None:
+            _sketch = self.hotkeys
+            _table = engine.table
+            _clock = engine.clock
+
+            def _hot_slots() -> List[int]:
+                out: List[int] = []
+                now = _clock.now_ms()
+                for key, rate, _lim, _dur in _sketch.top_rates(32):
+                    if rate <= 0:
+                        break
+                    try:
+                        ks = key.decode()
+                    except UnicodeDecodeError:
+                        continue
+                    if _table.contains(ks):
+                        out.append(_table.intern(ks, now, []))
+                return out
+
+            paging.hot_slots_provider = _hot_slots
         # Hot-key replication plane (cluster/replication.py), attached
         # by the daemon: peer-owned keys with a live replica lease
         # answer locally from pre-debited credit — zero forward hops.

@@ -593,6 +593,44 @@ class InstanceCollector(Collector):
         c.add_metric([], eng.rounds_total)
         yield c
 
+        # Paged device state (GUBER_PAGED; core/paging.py, PERF.md
+        # §30).  Absent on dense engines — the scrape stays drift-free
+        # both ways because the whole family is gated on the plane.
+        paging = getattr(eng, "paging", None)
+        if paging is not None:
+            g = GaugeMetricFamily(
+                "gubernator_paged_pages_resident",
+                "Device frames resident (pages the clock hand ranks); "
+                "total pages = ceil(logical capacity / page size).",
+            )
+            g.add_metric([], paging.frames)
+            yield g
+
+            c = CounterMetricFamily(
+                "gubernator_paged_faults",
+                "Page faults: batches touching a non-resident key "
+                "paid a spill+refill before their round dispatched.",
+            )
+            c.add_metric([], paging.faults)
+            yield c
+
+            c = CounterMetricFamily(
+                "gubernator_paged_spills",
+                "Cold pages spilled to the host store (one d2h gather "
+                "of the page's raw words each).",
+            )
+            c.add_metric([], paging.spills)
+            yield c
+
+            s = SummaryMetricFamily(
+                "gubernator_paged_refill_wait",
+                "Seconds a faulting batch waited for its page refill "
+                "scatter (h2d + donated update).",
+                count_value=paging.refill_wait.count,
+                sum_value=paging.refill_wait.total,
+            )
+            yield s
+
         # Queue-depth gauges (reference: guber_queue_length /
         # guber_pool_queue_length, gubernator.go:70-84).
         g = GaugeMetricFamily(

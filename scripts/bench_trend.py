@@ -229,6 +229,29 @@ def _extract(data: dict) -> dict | None:
         fl = data.get("fleet")
         if isinstance(fl, dict) and fl.get("scrape_ok") is not None:
             out["fleet_scrape_ok"] = fl["scrape_ok"]
+    # Paged-state artifacts (zipfpaged mode): fold the fault economy
+    # (fault rate, spill p99), the residency footprint, and the hot
+    # A/B against the dense arm (the ≤10% acceptance bar), so the
+    # trend shows what serving 10x the resident key space costs.
+    pg = data.get("paged")
+    if isinstance(pg, dict):
+        for src, dst in (
+            ("fault_rate", "fault_rate"),
+            ("spill_p99_ms", "spill_p99_ms"),
+            ("resident_ratio", "resident_ratio"),
+            ("keyspace_ratio", "keyspace_ratio"),
+        ):
+            if pg.get(src) is not None:
+                out[dst] = pg[src]
+        hot = data.get("hot")
+        if isinstance(hot, dict):
+            if hot.get("delta_pct") is not None:
+                out["hot_delta_pct"] = hot["delta_pct"]
+            if hot.get("dense_value") is not None:
+                out["hot_dense_value"] = hot["dense_value"]
+        dense = data.get("dense")
+        if isinstance(dense, dict) and dense.get("churn_value") is not None:
+            out["dense_churn_value"] = dense["churn_value"]
     # Tracing A/B artifacts (herdtrace mode): fold the off-arm value,
     # the delta (the < 2% acceptance bar), and the event-ring drop
     # count so the trend shows observability's cost alongside its

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci_fast.sh — the fast correctness + capture gate for one host.
 #
-# Runs exactly eleven things:
+# Runs exactly twelve things:
 #   1. guberlint (tools/guberlint): fails on static-analysis findings
 #      not in the committed guberlint_baseline.json — lock discipline,
 #      JAX trace hygiene, thread lifecycle, peer-network discipline,
@@ -41,6 +41,11 @@
 #      Pallas decision kernel bit-equal to models/spec.py + the
 #      single-dispatch-per-batch invariant — the kernel stays
 #      CI-enforced without TPU hardware (PERF.md section 24);
+#   6b. the paged smoke (scripts/paged_smoke.py): the GUBER_PAGED
+#      plane's fault-then-hit roundtrip — cold keys past the resident
+#      frames fault (counted), spill a victim, and answer from the
+#      refilled page with the spilled bucket's exact remaining —
+#      jax CPU, 30 s wall budget (PERF.md section 30);
 #   7. the replication smoke (tests/test_replication.py promote/demote
 #      round trip on a live 3-node cluster): a measured-hot key
 #      promotes to replica credit leases, answers go local, cooldown
@@ -180,6 +185,22 @@ if [ "${PAR_MS}" -gt 120000 ]; then
   echo "fused parity blew its 120 s wall budget — the interpret-mode" >&2
   echo "kernel must stay cheap enough to gate every commit without" >&2
   echo "TPU hardware" >&2
+  exit 1
+fi
+
+echo "=== paged smoke (page-table fault-then-hit roundtrip) ===" >&2
+PGD_T0=$(date +%s%N)
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python scripts/paged_smoke.py; then
+  echo "paged smoke: the paged state plane stopped translating, lost a" >&2
+  echo "spilled bucket across the refill roundtrip, or faulted silently" >&2
+  echo "(scripts/paged_smoke.py; PERF.md section 30)" >&2
+  exit 1
+fi
+PGD_MS=$(( ($(date +%s%N) - PGD_T0) / 1000000 ))
+echo "paged smoke: ${PGD_MS} ms (budget 30000 ms)" >&2
+if [ "${PGD_MS}" -gt 30000 ]; then
+  echo "paged smoke blew its 30 s budget — the fault path must stay" >&2
+  echo "cheap enough to gate every engine edit on CPU" >&2
   exit 1
 fi
 

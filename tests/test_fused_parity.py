@@ -294,6 +294,57 @@ def test_pallas_vs_spec_vs_ledger_three_way(seed, monkeypatch):
         ledger.close()
 
 
+@pytest.mark.parametrize("seed", [7])
+def test_paged_vs_spec_vs_ledger_three_way(seed, monkeypatch):
+    """The three-way harness with the PAGED plane underneath
+    (GUBER_PAGED, core/paging.py): ledger-fronted answers through a
+    paged Pallas-interpret engine squeezed to 64 resident rows under a
+    2048-slot key space still match the scalar spec row for row —
+    eviction→spill→refill roundtrips land mid-fuzz (asserted via the
+    fault counters), so residency is exercised, not incidental."""
+    monkeypatch.setenv("GUBER_FUSED", "interpret")
+    monkeypatch.setenv("GUBER_PUMP", "0")
+    monkeypatch.setenv("GUBER_PAGED", "1")
+    monkeypatch.setenv("GUBER_PAGE_SIZE", "16")
+    monkeypatch.setenv("GUBER_PAGED_RESIDENT", "4")
+    rng = np.random.default_rng(seed)
+    clock = Clock().freeze()
+    engine, ledger, serve = _ledger_harness(clock)
+    assert engine.paging is not None
+    assert engine.capacity == 64 and engine.logical_capacity == 2048
+    oracle = SpecShadow()
+    # 7x more keys than resident rows: cold keys keep faulting pages.
+    keys = [b"pgl_%d" % i for i in range(420)]
+    try:
+        for step in range(60):
+            clock.advance(ms=int(rng.integers(0, 60)))
+            rows = []
+            for _ in range(int(rng.integers(1, 8))):
+                key = keys[int(rng.integers(0, len(keys)))]
+                algo = int(key[-1] % 2)
+                rows.append(
+                    (
+                        key, algo, 0,
+                        int(rng.choice([0, 1, 1, 2, 4])),
+                        int(rng.choice([2, 5, 9])),
+                        int(rng.choice([40, 90, 400])),
+                        0,
+                    )
+                )
+            st, rem, rst = serve(rows)
+            now = clock.now_ms()
+            want = oracle.apply(rows, now)
+            for i, (es, _el, er, et) in enumerate(want):
+                got = (int(st[i]), int(rem[i]), int(rst[i]))
+                assert got == (es, er, et), (
+                    f"seed {seed} step {step} row {i} {rows[i]}: "
+                    f"ledger+paged={got} spec={(es, er, et)}"
+                )
+        assert engine.paging.faults > 0 and engine.paging.spills > 0
+    finally:
+        ledger.close()
+
+
 def test_fused_steady_state_is_single_dispatch(monkeypatch):
     """ISSUE 10 acceptance: in steady state one batch = ONE device
     dispatch (unique keys, no evictions, fused step), and the split
